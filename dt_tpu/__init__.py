@@ -1,0 +1,37 @@
+"""dt_tpu — a TPU-native elastic training framework.
+
+A brand-new JAX/XLA/pjit/Pallas framework with the capabilities of
+``awslabs/dynamic-training-with-apache-mxnet-on-aws`` (see ``SURVEY.md``):
+elastic synchronous data-parallel training where worker hosts are added or
+removed at epoch boundaries while the job keeps running.
+
+Layer map (TPU-native; reference analog in parens — citations point at
+``/root/reference``):
+
+- ``dt_tpu.ops``       — op surface on jnp/lax + Pallas (src/operator/*, 109K LoC CUDA)
+- ``dt_tpu.models``    — model zoo (example/image-classification symbols, gluon model_zoo)
+- ``dt_tpu.optim``     — optimizers + LR schedulers (python/mxnet/optimizer/, lr_scheduler.py)
+- ``dt_tpu.data``      — data iterators w/ num_parts/part_index sharding (src/io/)
+- ``dt_tpu.parallel``  — mesh, kvstore facade, collectives, gradient compression
+                         (src/kvstore/, 3rdparty/ps-lite)
+- ``dt_tpu.training``  — Module/fit loop, metrics, callbacks, checkpoint
+                         (python/mxnet/module/, metric.py, callback.py)
+- ``dt_tpu.elastic``   — membership-change control plane (ps-lite elastic_training.cc)
+- ``dt_tpu.launcher``  — job launcher (tools/launch.py)
+
+The reference's ps-lite push/aggregate/update/pull data plane collapses into a
+pjit-sharded train step: gradients are ``psum`` over the mesh's data axis (ICI),
+the optimizer runs sharded on-device. The elastic control plane (host_worker
+file watcher, epoch-boundary membership barrier, host_worker_log audit trail,
+new-worker bootstrap from a live snapshot) is rebuilt explicitly in
+``dt_tpu.elastic``.
+"""
+
+__version__ = "0.1.0"
+
+from dt_tpu import config as config
+from dt_tpu import ops as ops
+
+# Heavier subpackages (models/optim/data/parallel/training) are imported lazily
+# by user code: `import dt_tpu.models` etc.  Keeping top-level import light
+# mirrors the reference's `import mxnet` cost discipline.

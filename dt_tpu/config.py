@@ -1,0 +1,159 @@
+"""Typed configuration system.
+
+The reference spreads configuration over three mechanisms (SURVEY.md §5.6):
+dmlc ``GetEnv`` env vars (reference ``src/kvstore/kvstore_dist.h:59``,
+``ps-lite/src/postoffice.cc:18-31``), dmlc parameter structs
+(``DMLC_DECLARE_FIELD``), and argparse in examples.  Here there is ONE typed
+config system (frozen dataclasses) plus a small env layer used only for
+distributed bootstrap — mirroring the env contract the reference's elastic fit
+loop depends on (``python/mxnet/module/base_module.py:503-506``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Mapping, Tuple
+
+# ---------------------------------------------------------------------------
+# Env contract (distributed bootstrap only).
+#
+# The reference reads these in base_module.py:503-506 and
+# ps-lite/src/postoffice.cc:18-31; we keep the same names so reference-style
+# launch scripts work unmodified.
+# ---------------------------------------------------------------------------
+
+ENV_NEW_WORKER = "NEW_WORKER"
+ENV_EPOCH_BEGIN = "EPOCH_BEGIN"
+ENV_ELASTIC_ENABLED = "ELASTIC_TRAINING_ENABLED"
+ENV_ROLE = "DMLC_ROLE"
+ENV_NUM_WORKER = "DMLC_NUM_WORKER"
+ENV_WORKER_HOST_FILE = "WORKER_HOST_FILE"
+ENV_TRAINING_CMD = "TRAINING_CMD"
+ENV_SCHEDULER_URI = "DMLC_PS_ROOT_URI"
+ENV_SCHEDULER_PORT = "DMLC_PS_ROOT_PORT"
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Parse a boolean env var the way the reference's fit loop does
+    (string compare against "1"/"true", base_module.py:503-506)."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes")
+
+
+def env_int(name: str, default: int = 0) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+# ---------------------------------------------------------------------------
+# Typed configs
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout.
+
+    Replaces the reference's implicit topology (N workers × G GPUs each,
+    ps-lite node groups) with an explicit ``jax.sharding.Mesh``.  Axes:
+
+    - ``data``: data parallelism (the reference's worker dimension —
+      gradients psum over this axis instead of push/pull to servers).
+    - ``model``: tensor parallelism (reference has only manual ``group2ctx``
+      model parallelism; here it is a first-class mesh axis).
+    """
+
+    data: int = 1
+    model: int = 1
+    axis_names: Tuple[str, str] = ("data", "model")
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.model
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "sgd"
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    # Multi-precision: keep fp32 master weights when params are bf16/fp16,
+    # mirroring the server-side `store_realt_` copies
+    # (reference src/kvstore/kvstore_dist_server.h:240-273).
+    multi_precision: bool = True
+    extra: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class LRSchedulerConfig:
+    name: str = "constant"  # constant|factor|multifactor|poly|cosine
+    base_lr: float = 0.1
+    step: int = 1
+    steps: Tuple[int, ...] = ()
+    factor: float = 1.0
+    final_lr: float = 0.0
+    power: float = 2.0
+    max_update: int = 0
+    warmup_steps: int = 0
+    warmup_begin_lr: float = 0.0
+    warmup_mode: str = "linear"  # linear|constant
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    batch_size: int = 128  # GLOBAL batch size (Lin et al. policy: fixed
+    # across membership changes; per-worker batch = global/num_workers,
+    # reference example/dynamic-training/train_resnet.py:315-317).
+    shuffle: bool = True
+    num_parts: int = 1
+    part_index: int = 0
+    image_shape: Tuple[int, ...] = (3, 224, 224)
+    num_classes: int = 1000
+    dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic-training control-plane knobs (reference README.md:28-70,
+    ps-lite/src/elastic_training.cc)."""
+
+    enabled: bool = False
+    worker_host_file: str = ""
+    # Hosts present at launch can never be removed (reference README.md:54-61).
+    base_workers: Tuple[str, ...] = ()
+    heartbeat_interval_s: float = 1.0
+    dead_node_timeout_s: float = 60.0
+    scheduler_uri: str = "127.0.0.1"
+    scheduler_port: int = 9091
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    num_epochs: int = 1
+    kvstore: str = "local"  # local | device | tpu_sync | dist_sync (alias)
+    eval_every: int = 1
+    checkpoint_prefix: str = ""
+    checkpoint_period: int = 1
+    log_every: int = 50
+    seed: int = 0
+    compute_dtype: str = "float32"  # bfloat16 for TPU perf runs
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    lr_scheduler: LRSchedulerConfig = dataclasses.field(default_factory=LRSchedulerConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    elastic: ElasticConfig = dataclasses.field(default_factory=ElasticConfig)
+
+
+def replace(cfg, **kw):
+    """Functional update helper for frozen configs."""
+    return dataclasses.replace(cfg, **kw)

@@ -1,0 +1,15 @@
+"""Op surface.
+
+The reference implements ~109K LoC of C++/CUDA operators under
+``src/operator/`` (SURVEY.md §2.2).  On TPU, XLA lowers and fuses almost all
+of them from ``jax.numpy``/``lax`` — the value-add here is (a) a functional op
+layer with the reference's *semantics* (shape/dtype behavior, training/eval
+modes, sparse-grad optimizer update ops) and (b) Pallas kernels for the few
+paths the reference hand-wrote CUDA for (fused BN, 2-bit gradient
+compression, fused RNN cells) in ``dt_tpu.ops.pallas``.
+"""
+
+from dt_tpu.ops import nn as nn
+from dt_tpu.ops import losses as losses
+from dt_tpu.ops import tensor as tensor
+from dt_tpu.ops import rnn as rnn

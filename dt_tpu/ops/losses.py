@@ -1,0 +1,123 @@
+"""Loss ops.
+
+Reference analogs: ``src/operator/softmax_output.cc`` (SoftmaxOutput — the
+symbol-era classification head), ``src/operator/regression_output.cc``
+(LinearRegressionOutput / LogisticRegressionOutput / MAERegressionOutput),
+``src/operator/make_loss.cc``, gluon losses (``python/mxnet/gluon/loss.py``).
+All return per-batch scalars (mean) unless noted.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def softmax_cross_entropy(logits: Array, labels: Array,
+                          *, smoothing: float = 0.0,
+                          ignore_label: Optional[int] = None) -> Array:
+    """Softmax + CE, integer labels.  Reference: SoftmaxOutput
+    (``src/operator/softmax_output.cc``); ``smoothing`` matches the
+    ``smooth_alpha`` attr, ``ignore_label`` the masking attr.
+    """
+    num_classes = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=logp.dtype)
+    if smoothing > 0.0:
+        onehot = onehot * (1.0 - smoothing) + smoothing / num_classes
+    nll = -jnp.sum(onehot * logp, axis=-1)
+    if ignore_label is not None:
+        mask = (labels != ignore_label).astype(nll.dtype)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def l2_loss(pred: Array, label: Array) -> Array:
+    """Reference: LinearRegressionOutput (0.5*(p-y)^2 mean)."""
+    return 0.5 * jnp.mean(jnp.square(pred.astype(jnp.float32) - label))
+
+
+def l1_loss(pred: Array, label: Array) -> Array:
+    """Reference: MAERegressionOutput."""
+    return jnp.mean(jnp.abs(pred.astype(jnp.float32) - label))
+
+
+def logistic_loss(pred: Array, label: Array) -> Array:
+    """Reference: LogisticRegressionOutput (sigmoid BCE)."""
+    p = pred.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(p, 0) - p * label + jnp.log1p(jnp.exp(-jnp.abs(p))))
+
+
+def huber_loss(pred: Array, label: Array, rho: float = 1.0) -> Array:
+    """Reference: gluon HuberLoss."""
+    d = jnp.abs(pred.astype(jnp.float32) - label)
+    return jnp.mean(jnp.where(d <= rho, 0.5 * d * d / rho, d - 0.5 * rho))
+
+
+def hinge_loss(pred: Array, label: Array, margin: float = 1.0) -> Array:
+    """Reference: ``src/operator/svm_output.cc`` (SVMOutput, L1 hinge)."""
+    return jnp.mean(jnp.maximum(0.0, margin - pred.astype(jnp.float32) * label))
+
+
+def kl_divergence(logp_pred: Array, p_label: Array) -> Array:
+    """Reference: gluon KLDivLoss (inputs are log-probs, probs).  Like the
+    reference (``python/mxnet/gluon/loss.py`` KLDivLoss: mean over all
+    non-batch axes), the class axis is averaged, not summed."""
+    return jnp.mean(p_label * (jnp.log(jnp.maximum(p_label, 1e-12))
+                               - logp_pred))
+
+
+def ctc_loss(logits: Array, logit_lengths: Array, labels: Array,
+             label_lengths: Array, blank: int = 0) -> Array:
+    """CTC loss via the standard log-alpha forward recursion under lax.scan.
+
+    Reference: ``src/operator/nn/ctc_loss.cc`` (warp-ctc/cuDNN backed).
+    ``logits``: (B, T, V); ``labels``: (B, L) padded with anything beyond
+    ``label_lengths``.  Returns mean loss over batch.
+    """
+    b, t, v = logits.shape
+    l = labels.shape[1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # Extended label sequence with blanks: length 2L+1.
+    ext = jnp.full((b, 2 * l + 1), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    s = 2 * l + 1
+    neg_inf = -1e30
+    # alpha init
+    alpha0 = jnp.full((b, s), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(
+        logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0])
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((b, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, logp_t):
+        a_shift1 = jnp.concatenate([jnp.full((b, 1), neg_inf), alpha[:, :-1]], 1)
+        a_shift2 = jnp.concatenate([jnp.full((b, 2), neg_inf), alpha[:, :-2]], 1)
+        a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        return merged + emit, None
+
+    # scan over time, masking steps beyond each sequence's length
+    def masked_step(carry, inp):
+        alpha, t_idx = carry
+        logp_t = inp
+        new_alpha, _ = step(alpha, logp_t)
+        keep = (t_idx < logit_lengths)[:, None]
+        alpha = jnp.where(keep, new_alpha, alpha)
+        return (alpha, t_idx + 1), None
+
+    (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.ones((), jnp.int32)),
+                                 jnp.swapaxes(logp, 0, 1)[1:])
+    end = 2 * label_lengths  # index of last blank
+    last = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+    # Empty label sequence (end==0): only the all-blank path exists.
+    last2 = jnp.where(end == 0, -jnp.inf, last2)
+    return jnp.mean(-jnp.logaddexp(last, last2))

@@ -1,0 +1,338 @@
+"""Neural-net ops on lax/jnp, TPU-first.
+
+Covers the reference's ``src/operator/nn/`` family (Convolution, Deconvolution,
+FullyConnected, BatchNorm, LayerNorm, LRN, Pooling, Activation, Softmax,
+Dropout, Concat, UpSampling — reference ``src/operator/nn/*.cc``, SURVEY.md
+§2.2) as pure functions.  Design differences from the reference, on purpose:
+
+- NHWC layout by default (TPU/XLA native; the reference is NCHW+cuDNN).
+- No im2col/col2im staging buffers: ``lax.conv_general_dilated`` maps convs
+  straight onto the MXU; XLA fuses the elementwise epilogues the reference
+  hand-fused in CUDA.
+- Everything is shape-static and jit-traceable; training/eval mode is a
+  Python-level bool (static under jit), not a runtime flag.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+# ---------------------------------------------------------------------------
+# Linear / conv (MXU ops)
+# ---------------------------------------------------------------------------
+
+
+def fully_connected(x: Array, weight: Array, bias: Optional[Array] = None,
+                    flatten: bool = True) -> Array:
+    """Dense layer.  Reference: FullyConnected (``src/operator/nn/fully_connected.cc``).
+
+    ``weight`` is ``(in_features, out_features)`` — transposed from the
+    reference's ``(num_hidden, input_dim)`` so the matmul hits the MXU without
+    a transpose.  With ``flatten`` (reference default), leading dims beyond
+    batch are collapsed.
+    """
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    # No explicit accumulation dtype: the TPU MXU accumulates bf16 matmuls in
+    # f32 natively, and preferred_element_type+downcast breaks the conv/dot
+    # transpose rules under autodiff (mixed-dtype cotangents).
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+def conv2d(x: Array, weight: Array, bias: Optional[Array] = None,
+           stride: Union[int, Tuple[int, int]] = 1,
+           padding: Union[str, int, Tuple[int, int]] = 0,
+           dilation: Union[int, Tuple[int, int]] = 1,
+           groups: int = 1) -> Array:
+    """2-D convolution, NHWC/HWIO.  Reference: Convolution
+    (``src/operator/nn/convolution.cc``; cuDNN path ``nn/cudnn/``).
+
+    ``x``: (N, H, W, C); ``weight``: (kh, kw, C // groups, out_c).
+    Depthwise conv (reference ``depthwise_convolution_tf.cuh``) is
+    ``groups == C``; XLA lowers grouped convs onto the MXU directly.
+    """
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        ph, pw = _pair(padding)
+        pad = ((ph, ph), (pw, pw))
+    y = lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def deconv2d(x: Array, weight: Array, bias: Optional[Array] = None,
+             stride: Union[int, Tuple[int, int]] = 1,
+             padding: Union[int, Tuple[int, int]] = 0,
+             groups: int = 1) -> Array:
+    """Transposed convolution.  Reference: Deconvolution
+    (``src/operator/nn/deconvolution.cc``).  Implemented as the gradient conv
+    (lhs-dilated), which XLA maps to the MXU like a forward conv.
+    """
+    stride = _pair(stride)
+    ph, pw = _pair(padding)
+    kh, kw = weight.shape[0], weight.shape[1]
+    # Transposed conv = conv with lhs dilation and spatially flipped kernel.
+    # ``weight``: (kh, kw, in_c, out_c), same HWIO convention as conv2d.
+    y = lax.conv_general_dilated(
+        x, jnp.flip(weight, (0, 1)),
+        window_strides=(1, 1),
+        padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+        lhs_dilation=stride,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+def _pool(x: Array, init, reduce_fn, kernel, stride, padding, count_include_pad=True):
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        ph, pw = _pair(padding)
+        pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    dims = (1, kh, kw, 1)
+    strides = (1, sh, sw, 1)
+    return lax.reduce_window(x, init, reduce_fn, dims, strides, pad)
+
+
+def max_pool2d(x: Array, kernel, stride=None, padding=0) -> Array:
+    """Max pooling.  Reference: Pooling pool_enum::kMaxPooling
+    (``src/operator/nn/pooling.cc``, CUDA ``nn/pool.cuh``)."""
+    return _pool(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                 else jnp.iinfo(x.dtype).min, lax.max, kernel, stride, padding)
+
+
+def avg_pool2d(x: Array, kernel, stride=None, padding=0,
+               count_include_pad: bool = True) -> Array:
+    """Average pooling.  Reference: Pooling kAvgPooling; the
+    ``count_include_pad`` attr matches ``src/operator/nn/pooling.cc``."""
+    kh, kw = _pair(kernel)
+    summed = _pool(x, 0.0, lax.add, kernel, stride, padding)
+    if count_include_pad or (isinstance(padding, int) and padding == 0):
+        return summed / (kh * kw)
+    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    counts = _pool(ones, 0.0, lax.add, kernel, stride, padding)
+    return summed / counts
+
+
+def global_avg_pool2d(x: Array) -> Array:
+    """Global average pooling (reference ``global_pool=True`` attr)."""
+    return jnp.mean(x, axis=(1, 2), keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+def batch_norm(x: Array, gamma: Array, beta: Array,
+               moving_mean: Array, moving_var: Array,
+               *, training: bool, momentum: float = 0.9, eps: float = 1e-5,
+               axis: int = -1) -> Tuple[Array, Array, Array]:
+    """Batch normalization.
+
+    Reference: BatchNorm (``src/operator/nn/batch_norm.cc``); running stats
+    update uses the reference's convention
+    ``moving = moving * momentum + batch * (1 - momentum)``
+    (``batch_norm-inl.h``).  Returns ``(y, new_mean, new_var)``; in eval mode
+    the moving stats pass through unchanged.
+
+    The moving stats are *aux params* in reference terms: in distributed
+    training they are excluded from the optimizer and averaged across workers
+    (server keys >= 10M, ``src/kvstore/kvstore_dist_server.h:356-360``) —
+    handled here by ``dt_tpu.parallel`` via cross-replica ``pmean`` on sync.
+    """
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    if training:
+        mean = jnp.mean(x.astype(jnp.float32), axis=reduce_axes)
+        var = jnp.var(x.astype(jnp.float32), axis=reduce_axes)
+        new_mean = moving_mean * momentum + mean * (1.0 - momentum)
+        new_var = moving_var * momentum + var * (1.0 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+    inv = lax.rsqrt(var + eps) * gamma
+    y = (x - mean.reshape(shape).astype(x.dtype)) * inv.reshape(shape).astype(x.dtype) \
+        + beta.reshape(shape).astype(x.dtype)
+    return y, new_mean, new_var
+
+
+def layer_norm(x: Array, gamma: Array, beta: Array, *, axis: int = -1,
+               eps: float = 1e-5) -> Array:
+    """Layer normalization.  Reference: ``src/operator/nn/layer_norm.cc``."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * gamma + beta).astype(x.dtype)
+
+
+def instance_norm(x: Array, gamma: Array, beta: Array, eps: float = 1e-5) -> Array:
+    """Instance norm over spatial dims, per-sample per-channel (NHWC).
+    Reference: ``src/operator/instance_norm.cc``."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+    var = jnp.var(x32, axis=(1, 2), keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * gamma + beta).astype(x.dtype)
+
+
+def l2_normalize(x: Array, axis=-1, eps: float = 1e-10) -> Array:
+    """Reference: ``src/operator/l2_normalization.cc`` (mode=instance≈axis)."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axis,
+                            keepdims=True) + eps)
+    return (x / norm.astype(x.dtype))
+
+
+def lrn(x: Array, nsize: int = 5, alpha: float = 1e-4, beta: float = 0.75,
+        knorm: float = 2.0) -> Array:
+    """Local response normalization across channels (NHWC).
+    Reference: ``src/operator/nn/lrn.cc`` (AlexNet-era)."""
+    sq = jnp.square(x.astype(jnp.float32))
+    # Sum over a channel window of size nsize centered at each channel.
+    pad = nsize // 2
+    sq = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(pad, pad)])
+    win = sum(
+        lax.dynamic_slice_in_dim(sq, i, x.shape[-1], axis=x.ndim - 1)
+        for i in range(nsize)
+    )
+    return (x * jnp.power(knorm + alpha * win / nsize, -beta).astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Activations / softmax / dropout
+# ---------------------------------------------------------------------------
+
+
+def activation(x: Array, act_type: str) -> Array:
+    """Activation dispatch matching the reference's act_type strings
+    (``src/operator/nn/activation.cc``: relu|sigmoid|tanh|softrelu|softsign)."""
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x)
+    raise ValueError(f"unknown act_type {act_type!r}")
+
+
+def leaky_relu(x: Array, slope: float = 0.25) -> Array:
+    """Reference: ``src/operator/leaky_relu.cc`` (mode=leaky)."""
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def prelu(x: Array, alpha: Array) -> Array:
+    """Reference: ``src/operator/leaky_relu.cc`` (mode=prelu)."""
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def softmax(x: Array, axis: int = -1, temperature: float = 1.0) -> Array:
+    """Reference: ``src/operator/nn/softmax.cc``."""
+    if temperature != 1.0:
+        x = x / temperature
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x: Array, axis: int = -1) -> Array:
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def dropout(x: Array, rate: float, *, training: bool, rng: Optional[Array] = None,
+            mode: str = "training") -> Array:
+    """Inverted dropout.  Reference: ``src/operator/nn/dropout.cc``
+    (mode 'training' skips at eval; 'always' applies at eval too)."""
+    if rate <= 0.0 or (not training and mode != "always"):
+        return x
+    if rng is None:
+        raise ValueError(
+            "dropout is active (training=True or mode='always') and requires "
+            "an rng key")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+# ---------------------------------------------------------------------------
+# Shape ops used by model zoo
+# ---------------------------------------------------------------------------
+
+
+def flatten(x: Array) -> Array:
+    """Reference: Flatten (``src/operator/tensor/matrix_op.cc``)."""
+    return x.reshape(x.shape[0], -1)
+
+
+def concat(xs: Sequence[Array], axis: int = -1) -> Array:
+    """Reference: Concat (``src/operator/nn/concat.cc``)."""
+    return jnp.concatenate(xs, axis=axis)
+
+
+def upsample_nearest(x: Array, scale: int) -> Array:
+    """Nearest-neighbor upsampling (NHWC).  Reference: UpSampling
+    (``src/operator/nn/upsampling.cc``, sample_type=nearest)."""
+    n, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (n, h, scale, w, scale, c))
+    return x.reshape(n, h * scale, w * scale, c)
+
+
+def bilinear_resize(x: Array, out_h: int, out_w: int) -> Array:
+    """Reference: ``src/operator/contrib/bilinear_resize.cc``."""
+    return jax.image.resize(x, (x.shape[0], out_h, out_w, x.shape[3]),
+                            method="bilinear")
+
+
+def pad2d(x: Array, pad_width: Tuple[int, int, int, int], mode: str = "constant",
+          value: float = 0.0) -> Array:
+    """Spatial pad (NHWC).  Reference: ``src/operator/pad.cc``."""
+    t, b, l, r = pad_width
+    cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    if mode == "edge":
+        return jnp.pad(x, cfg, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, cfg, mode="reflect")
+    raise ValueError(mode)

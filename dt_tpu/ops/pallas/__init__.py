@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the paths the reference hand-wrote CUDA for.
+
+Planned contents (SURVEY.md §7 translation table):
+- fused batch-norm variants (reference ``src/operator/nn/batch_norm.cu``)
+- 2-bit stochastic gradient quantize/dequantize with error-feedback residual
+  (reference ``src/kvstore/gradient_compression.cu``)
+- fused LSTM/GRU cell (reference ``cudnn_rnn-inl.h``)
+
+Kernels land incrementally; each has an interpreter-mode test against the
+jnp oracle in ``dt_tpu.ops``.
+"""

@@ -1,0 +1,125 @@
+"""Tensor ops with reference semantics worth preserving.
+
+The reference's ``src/operator/tensor/`` (~30K LoC, SURVEY.md §2.2) is almost
+entirely subsumed by ``jax.numpy``; this module keeps only the ops whose
+*semantics* differ from numpy or that models/training code calls by the
+reference's names (sequence ops, topk with MXNet conventions, one_hot,
+embedding with sparse-grad discipline, clip-by-global-norm used by RNN
+training).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def topk(x: Array, k: int, axis: int = -1, ret_typ: str = "indices",
+         is_ascend: bool = False):
+    """Reference: ``src/operator/tensor/ordering_op.cc`` (topk).
+    ``ret_typ`` in {value, indices, both}."""
+    v = -x if is_ascend else x
+    vals, idx = lax.top_k(jnp.moveaxis(v, axis, -1), k)
+    vals = jnp.moveaxis(-vals if is_ascend else vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    return vals, idx
+
+
+def one_hot(indices: Array, depth: int, on_value: float = 1.0,
+            off_value: float = 0.0, dtype=jnp.float32) -> Array:
+    """Reference: ``src/operator/tensor/indexing_op.cc`` (one_hot)."""
+    oh = jax.nn.one_hot(indices, depth, dtype=jnp.float32)
+    return (oh * (on_value - off_value) + off_value).astype(dtype)
+
+
+def embedding(indices: Array, weight: Array) -> Array:
+    """Embedding lookup.  Reference: ``src/operator/tensor/indexing_op.cc``
+    (Embedding, with row_sparse gradient).  On TPU the gradient is a dense
+    scatter-add XLA handles natively; the reference's row_sparse lazy-update
+    path is covered by ``dt_tpu.optim`` sparse-aware updates."""
+    return jnp.take(weight, indices, axis=0)
+
+
+def take(x: Array, indices: Array, axis: int = 0, mode: str = "clip") -> Array:
+    """Reference: take with mode clip|wrap (``indexing_op.cc``)."""
+    return jnp.take(x, indices, axis=axis, mode=mode)
+
+
+def gather_nd(x: Array, indices: Array) -> Array:
+    """Reference: ``src/operator/tensor/indexing_op.cc`` (gather_nd).
+    ``indices``: (M, N) selecting along first M axes."""
+    return x[tuple(indices[i] for i in range(indices.shape[0]))]
+
+
+def sequence_mask(x: Array, lengths: Array, value: float = 0.0,
+                  time_axis: int = 0) -> Array:
+    """Reference: ``src/operator/sequence_mask.cc``.  ``x`` has time on
+    ``time_axis``, batch on the other leading axis."""
+    t = x.shape[time_axis]
+    steps = jnp.arange(t)
+    if time_axis == 0:
+        mask = steps[:, None] < lengths[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    else:
+        mask = steps[None, :] < lengths[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mask, x, jnp.asarray(value, x.dtype))
+
+
+def sequence_last(x: Array, lengths: Array, time_axis: int = 0) -> Array:
+    """Reference: ``src/operator/sequence_last.cc``."""
+    idx = jnp.maximum(lengths - 1, 0)
+    if time_axis == 0:
+        return x[idx, jnp.arange(x.shape[1])]
+    return x[jnp.arange(x.shape[0]), idx]
+
+
+def sequence_reverse(x: Array, lengths: Optional[Array] = None,
+                     time_axis: int = 0) -> Array:
+    """Reference: ``src/operator/sequence_reverse.cc``."""
+    if lengths is None:
+        return jnp.flip(x, axis=time_axis)
+    t = x.shape[time_axis]
+    steps = jnp.arange(t)
+    if time_axis == 0:
+        rev_idx = jnp.where(steps[:, None] < lengths[None, :],
+                            lengths[None, :] - 1 - steps[:, None],
+                            steps[:, None])
+        return x[rev_idx, jnp.arange(x.shape[1])[None, :]]
+    rev_idx = jnp.where(steps[None, :] < lengths[:, None],
+                        lengths[:, None] - 1 - steps[None, :], steps[None, :])
+    return x[jnp.arange(x.shape[0])[:, None], rev_idx]
+
+
+def clip_global_norm(tree, max_norm: float):
+    """Clip a gradient pytree by global L2 norm; returns (clipped, norm).
+    Reference: ``mx.gluon.utils.clip_global_norm``
+    (``python/mxnet/gluon/utils.py``), used by RNN examples."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), tree), norm
+
+
+def swapaxes(x: Array, dim1: int, dim2: int) -> Array:
+    """Reference: ``src/operator/swapaxis.cc``."""
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+def slice_channel(x: Array, num_outputs: int, axis: int = 1,
+                  squeeze_axis: bool = False) -> Tuple[Array, ...]:
+    """Reference: SliceChannel/split (``src/operator/slice_channel.cc``)."""
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)

@@ -1,0 +1,272 @@
+"""Op-surface unit tests.
+
+Modeled on the reference's ``tests/python/unittest/test_operator.py``
+(SURVEY.md §4): numeric checks against numpy oracles, plus finite-difference
+gradient checks for the hand-written pieces.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dt_tpu.ops import nn, losses, tensor
+
+
+def test_fully_connected_matches_numpy():
+    x = np.random.randn(4, 10).astype(np.float32)
+    w = np.random.randn(10, 6).astype(np.float32)
+    b = np.random.randn(6).astype(np.float32)
+    y = nn.fully_connected(jnp.array(x), jnp.array(w), jnp.array(b))
+    np.testing.assert_allclose(np.array(y), x @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_fully_connected_flatten():
+    x = jnp.ones((2, 3, 4))
+    w = jnp.ones((12, 5))
+    y = nn.fully_connected(x, w)
+    assert y.shape == (2, 5)
+
+
+def test_conv2d_identity_kernel():
+    x = np.random.randn(1, 8, 8, 3).astype(np.float32)
+    # 1x1 identity conv
+    w = np.zeros((1, 1, 3, 3), np.float32)
+    for i in range(3):
+        w[0, 0, i, i] = 1.0
+    y = nn.conv2d(jnp.array(x), jnp.array(w))
+    np.testing.assert_allclose(np.array(y), x, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_shapes_stride_pad():
+    x = jnp.zeros((2, 32, 32, 3))
+    w = jnp.zeros((3, 3, 3, 16))
+    assert nn.conv2d(x, w, stride=1, padding=1).shape == (2, 32, 32, 16)
+    assert nn.conv2d(x, w, stride=2, padding=1).shape == (2, 16, 16, 16)
+
+
+def test_depthwise_conv():
+    x = jnp.ones((1, 8, 8, 4))
+    w = jnp.ones((3, 3, 1, 4))
+    y = nn.conv2d(x, w, padding=1, groups=4)
+    assert y.shape == (1, 8, 8, 4)
+    # Interior pixels see 9 ones.
+    assert np.isclose(np.array(y)[0, 4, 4, 0], 9.0)
+
+
+def test_deconv2d_upsamples():
+    x = jnp.ones((1, 4, 4, 2))
+    w = jnp.ones((2, 2, 2, 3))
+    y = nn.deconv2d(x, w, stride=2)
+    assert y.shape == (1, 8, 8, 3)
+
+
+def test_max_avg_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    mp = nn.max_pool2d(jnp.array(x), 2, 2)
+    ap = nn.avg_pool2d(jnp.array(x), 2, 2)
+    np.testing.assert_allclose(np.array(mp)[0, :, :, 0], [[5, 7], [13, 15]])
+    np.testing.assert_allclose(np.array(ap)[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_global_avg_pool():
+    x = jnp.ones((2, 7, 7, 64)) * 3.0
+    y = nn.global_avg_pool2d(x)
+    assert y.shape == (2, 1, 1, 64)
+    np.testing.assert_allclose(np.array(y), 3.0, rtol=1e-6)
+
+
+def test_batch_norm_train_normalizes():
+    x = np.random.randn(64, 4, 4, 8).astype(np.float32) * 5 + 3
+    g = jnp.ones(8)
+    b = jnp.zeros(8)
+    y, nm, nv = nn.batch_norm(jnp.array(x), g, b, jnp.zeros(8), jnp.ones(8),
+                              training=True, momentum=0.9)
+    ya = np.array(y)
+    assert abs(ya.mean()) < 1e-3
+    assert abs(ya.std() - 1.0) < 1e-2
+    # moving update convention: moving*m + batch*(1-m)
+    np.testing.assert_allclose(np.array(nm),
+                               0.9 * 0 + 0.1 * x.mean(axis=(0, 1, 2)), rtol=1e-4)
+
+
+def test_batch_norm_eval_uses_moving_stats():
+    x = np.random.randn(8, 2, 2, 4).astype(np.float32)
+    mm = np.random.randn(4).astype(np.float32)
+    mv = np.abs(np.random.randn(4)).astype(np.float32) + 0.5
+    y, _, _ = nn.batch_norm(jnp.array(x), jnp.ones(4), jnp.zeros(4),
+                            jnp.array(mm), jnp.array(mv), training=False)
+    expect = (x - mm) / np.sqrt(mv + 1e-5)
+    np.testing.assert_allclose(np.array(y), expect, rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm():
+    x = np.random.randn(4, 16).astype(np.float32)
+    y = nn.layer_norm(jnp.array(x), jnp.ones(16), jnp.zeros(16))
+    ya = np.array(y)
+    np.testing.assert_allclose(ya.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(ya.std(-1), 1, atol=1e-2)
+
+
+def test_lrn_matches_direct():
+    x = np.random.rand(2, 3, 3, 7).astype(np.float32)
+    y = np.array(nn.lrn(jnp.array(x), nsize=5, alpha=1e-4, beta=0.75, knorm=2.0))
+    # direct computation
+    sq = x ** 2
+    out = np.zeros_like(x)
+    for c in range(7):
+        lo, hi = max(0, c - 2), min(7, c + 3)
+        s = sq[..., lo:hi].sum(-1)
+        out[..., c] = x[..., c] * (2.0 + 1e-4 * s / 5) ** -0.75
+    np.testing.assert_allclose(y, out, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu", "softsign"])
+def test_activations(act):
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    y = np.array(nn.activation(jnp.array(x), act))
+    oracle = {
+        "relu": np.maximum(x, 0),
+        "sigmoid": 1 / (1 + np.exp(-x)),
+        "tanh": np.tanh(x),
+        "softrelu": np.log1p(np.exp(x)),
+        "softsign": x / (1 + np.abs(x)),
+    }[act]
+    np.testing.assert_allclose(y, oracle, rtol=1e-5, atol=1e-6)
+
+
+def test_leaky_prelu():
+    x = jnp.array([-2.0, 3.0])
+    np.testing.assert_allclose(np.array(nn.leaky_relu(x, 0.1)), [-0.2, 3.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.array(nn.prelu(x, jnp.array([0.5, 0.5]))), [-1.0, 3.0], rtol=1e-6)
+
+
+def test_dropout_modes(rng):
+    x = jnp.ones((1000,))
+    # eval: identity
+    np.testing.assert_array_equal(np.array(nn.dropout(x, 0.5, training=False)), 1.0)
+    y = np.array(nn.dropout(x, 0.5, training=True, rng=rng))
+    kept = y > 0
+    assert 0.35 < kept.mean() < 0.65
+    np.testing.assert_allclose(y[kept], 2.0, rtol=1e-6)  # inverted scaling
+
+
+def test_softmax_temperature():
+    x = jnp.array([[1.0, 2.0, 3.0]])
+    y = np.array(nn.softmax(x))
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-6)
+    yt = np.array(nn.softmax(x, temperature=100.0))
+    assert np.abs(yt - 1 / 3).max() < 1e-2
+
+
+def test_upsample_bilinear_pad():
+    x = jnp.arange(4.0).reshape(1, 2, 2, 1)
+    up = nn.upsample_nearest(x, 2)
+    assert up.shape == (1, 4, 4, 1)
+    np.testing.assert_allclose(np.array(up)[0, :2, :2, 0], [[0, 0], [0, 0]])
+    br = nn.bilinear_resize(x, 4, 4)
+    assert br.shape == (1, 4, 4, 1)
+    p = nn.pad2d(x, (1, 1, 1, 1))
+    assert p.shape == (1, 4, 4, 1)
+
+
+def test_softmax_cross_entropy_basics():
+    logits = jnp.array([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+    labels = jnp.array([0, 1])
+    loss = losses.softmax_cross_entropy(logits, labels)
+    assert float(loss) < 1e-3
+    # label smoothing raises the floor
+    ls = losses.softmax_cross_entropy(logits, labels, smoothing=0.1)
+    assert float(ls) > float(loss)
+
+
+def test_softmax_cross_entropy_ignore_label():
+    logits = jnp.array([[10.0, 0.0], [0.0, 10.0]])
+    labels = jnp.array([0, -1])
+    loss = losses.softmax_cross_entropy(logits, labels, ignore_label=-1)
+    assert float(loss) < 1e-3
+
+
+def test_ctc_loss_trivial():
+    # Single label, logits hard on [blank, label] alternation -> low loss.
+    t, v = 5, 4
+    logits = np.full((1, t, v), -5.0, np.float32)
+    logits[0, :, 1] = 5.0  # always emit label 1
+    loss = losses.ctc_loss(jnp.array(logits), jnp.array([t]),
+                           jnp.array([[1]]), jnp.array([1]))
+    assert float(loss) < 0.2
+    # Uniform logits -> higher loss
+    loss2 = losses.ctc_loss(jnp.zeros((1, t, v)), jnp.array([t]),
+                            jnp.array([[1]]), jnp.array([1]))
+    assert float(loss2) > float(loss)
+
+
+def test_regression_losses():
+    p = jnp.array([1.0, 2.0])
+    y = jnp.array([0.0, 0.0])
+    np.testing.assert_allclose(float(losses.l2_loss(p, y)), 0.5 * (1 + 4) / 2)
+    np.testing.assert_allclose(float(losses.l1_loss(p, y)), 1.5)
+    h = float(losses.huber_loss(p, y, rho=1.0))
+    np.testing.assert_allclose(h, (0.5 + 1.5) / 2)
+
+
+def test_topk():
+    x = jnp.array([[3.0, 1.0, 2.0]])
+    idx = tensor.topk(x, 2)
+    np.testing.assert_array_equal(np.array(idx), [[0, 2]])
+    v, i = tensor.topk(x, 2, ret_typ="both", is_ascend=True)
+    np.testing.assert_array_equal(np.array(i), [[1, 2]])
+    np.testing.assert_allclose(np.array(v), [[1.0, 2.0]])
+
+
+def test_sequence_ops():
+    x = jnp.arange(12.0).reshape(3, 2, 2)  # (T=3, B=2, D=2)
+    lengths = jnp.array([2, 3])
+    m = tensor.sequence_mask(x, lengths, value=-1.0)
+    assert np.array(m)[2, 0, 0] == -1.0
+    assert np.array(m)[2, 1, 0] == x[2, 1, 0]
+    last = tensor.sequence_last(x, lengths)
+    np.testing.assert_allclose(np.array(last)[0], np.array(x)[1, 0])
+    np.testing.assert_allclose(np.array(last)[1], np.array(x)[2, 1])
+    rev = tensor.sequence_reverse(x, lengths)
+    np.testing.assert_allclose(np.array(rev)[0, 0], np.array(x)[1, 0])
+    np.testing.assert_allclose(np.array(rev)[2, 0], np.array(x)[2, 0])
+
+
+def test_clip_global_norm():
+    tree = {"a": jnp.array([3.0, 4.0])}  # norm 5
+    clipped, norm = tensor.clip_global_norm(tree, 1.0)
+    np.testing.assert_allclose(float(norm), 5.0, rtol=1e-6)
+    np.testing.assert_allclose(np.array(clipped["a"]), [0.6, 0.8], rtol=1e-5)
+
+
+def test_embedding_and_grad():
+    w = jnp.eye(5)
+    idx = jnp.array([1, 3])
+    out = tensor.embedding(idx, w)
+    np.testing.assert_allclose(np.array(out), np.eye(5)[[1, 3]])
+    # gradient is scatter-add of upstream: each selected row gets sum of ones
+    g = jax.grad(lambda w: tensor.embedding(idx, w).sum())(w)
+    np.testing.assert_allclose(np.array(g[1]), np.ones(5))
+    np.testing.assert_allclose(np.array(g[3]), np.ones(5))
+    np.testing.assert_allclose(np.array(g).sum(), 10.0)
+
+
+def test_conv_grad_check():
+    """Finite-difference gradient check, modeled on the reference's
+    check_numeric_gradient (python/mxnet/test_utils.py)."""
+    x = np.random.randn(1, 5, 5, 2).astype(np.float32)
+    w = np.random.randn(3, 3, 2, 3).astype(np.float32)
+
+    def f(w):
+        return jnp.sum(nn.conv2d(jnp.array(x), w, padding=1) ** 2)
+
+    g = np.array(jax.grad(f)(jnp.array(w)))
+    eps = 1e-3
+    for idx in [(0, 0, 0, 0), (1, 2, 1, 2), (2, 1, 0, 1)]:
+        wp = w.copy(); wp[idx] += eps
+        wm = w.copy(); wm[idx] -= eps
+        fd = (float(f(jnp.array(wp))) - float(f(jnp.array(wm)))) / (2 * eps)
+        np.testing.assert_allclose(g[idx], fd, rtol=2e-2, atol=1e-2)
