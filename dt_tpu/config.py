@@ -101,12 +101,38 @@ class LRSchedulerConfig:
     step: int = 1
     steps: Tuple[int, ...] = ()
     factor: float = 1.0
+    stop_factor_lr: float = 1e-8
     final_lr: float = 0.0
-    power: float = 2.0
+    pwr: int = 2  # field names match dt_tpu.optim.lr_scheduler kwargs so the
+    # config can be splatted straight into lr_scheduler.make()
     max_update: int = 0
     warmup_steps: int = 0
     warmup_begin_lr: float = 0.0
     warmup_mode: str = "linear"  # linear|constant
+
+    def make(self):
+        """Build the scheduler this config describes."""
+        from dt_tpu.optim import lr_scheduler
+        kw = dict(base_lr=self.base_lr, warmup_steps=self.warmup_steps,
+                  warmup_begin_lr=self.warmup_begin_lr,
+                  warmup_mode=self.warmup_mode)
+        if self.name == "constant":
+            return lr_scheduler.make("constant", **kw)
+        if self.name == "factor":
+            return lr_scheduler.make("factor", step=self.step,
+                                     factor=self.factor,
+                                     stop_factor_lr=self.stop_factor_lr, **kw)
+        if self.name == "multifactor":
+            return lr_scheduler.make("multifactor", steps=self.steps,
+                                     factor=self.factor, **kw)
+        if self.name == "poly":
+            return lr_scheduler.make("poly", max_update=self.max_update,
+                                     final_lr=self.final_lr, pwr=self.pwr,
+                                     **kw)
+        if self.name == "cosine":
+            return lr_scheduler.make("cosine", max_update=self.max_update,
+                                     final_lr=self.final_lr, **kw)
+        raise ValueError(f"unknown scheduler {self.name!r}")
 
 
 @dataclasses.dataclass(frozen=True)

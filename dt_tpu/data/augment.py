@@ -1,0 +1,145 @@
+"""Host-side image augmentation (numpy, HWC uint8/float).
+
+Reference: ``src/io/image_aug_default.cc`` (DefaultImageAugmenter: resize,
+random crop, random mirror, HSL jitter, mean/std normalize) and the Python
+augmenters in ``python/mxnet/image/image.py``.  Augmentation runs on host
+(like the reference's OMP decode threads); normalization math mirrors the
+reference's ``mean_r/g/b``/``std_r/g/b`` params.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Augmenter:
+    """Composable augmenter: call with HWC array -> HWC array."""
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class Compose(Augmenter):
+    def __init__(self, *augs: Augmenter):
+        self.augs = augs
+
+    def __call__(self, img):
+        for a in self.augs:
+            img = a(img)
+        return img
+
+
+class RandomCrop(Augmenter):
+    """Pad-then-random-crop (the reference CIFAR recipe: pad 4, crop 32)."""
+
+    def __init__(self, size: Tuple[int, int], pad: int = 0, seed: int = 0):
+        self.size = size
+        self.pad = pad
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        if self.pad:
+            img = np.pad(img, ((self.pad, self.pad), (self.pad, self.pad),
+                               (0, 0)), mode="reflect")
+        h, w = img.shape[:2]
+        th, tw = self.size
+        y = self._rng.randint(0, h - th + 1)
+        x = self._rng.randint(0, w - tw + 1)
+        return img[y:y + th, x:x + tw]
+
+
+class CenterCrop(Augmenter):
+    def __init__(self, size: Tuple[int, int]):
+        self.size = size
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        th, tw = self.size
+        y = (h - th) // 2
+        x = (w - tw) // 2
+        return img[y:y + th, x:x + tw]
+
+
+class RandomMirror(Augmenter):
+    """Horizontal flip with p=0.5 (reference ``rand_mirror``)."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        if self._rng.rand() < 0.5:
+            return img[:, ::-1]
+        return img
+
+
+class Resize(Augmenter):
+    """Bilinear resize via PIL (reference ``resize`` augmenter)."""
+
+    def __init__(self, size: Tuple[int, int]):
+        self.size = size
+
+    def __call__(self, img):
+        from PIL import Image
+        mode = Image.fromarray(img.astype(np.uint8))
+        return np.asarray(mode.resize((self.size[1], self.size[0]),
+                                      Image.BILINEAR), img.dtype)
+
+
+class Normalize(Augmenter):
+    """(img - mean) / std per channel (reference mean_r/g/b, std_r/g/b)."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float]):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, img):
+        return (img.astype(np.float32) - self.mean) / self.std
+
+
+class ColorJitter(Augmenter):
+    """Random brightness/contrast/saturation (reference
+    ``random_color_jitter``)."""
+
+    def __init__(self, brightness: float = 0.0, contrast: float = 0.0,
+                 saturation: float = 0.0, seed: int = 0):
+        self.b, self.c, self.s = brightness, contrast, saturation
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        img = img.astype(np.float32)
+        if self.b:
+            img = img * (1.0 + self._rng.uniform(-self.b, self.b))
+        if self.c:
+            coef = np.array([0.299, 0.587, 0.114], np.float32)
+            alpha = 1.0 + self._rng.uniform(-self.c, self.c)
+            gray_mean = (img * coef).sum(-1, keepdims=True).mean()
+            img = img * alpha + gray_mean * (1 - alpha)
+        if self.s:
+            coef = np.array([0.299, 0.587, 0.114], np.float32)
+            alpha = 1.0 + self._rng.uniform(-self.s, self.s)
+            gray = (img * coef).sum(-1, keepdims=True)
+            img = img * alpha + gray * (1 - alpha)
+        return img
+
+
+def cifar_train_augmenter(seed: int = 0) -> Augmenter:
+    """The reference's CIFAR-10 training recipe (``train_cifar10.py``:
+    pad 4 + crop 32 + mirror, /255 normalize)."""
+    return Compose(
+        RandomCrop((32, 32), pad=4, seed=seed),
+        RandomMirror(seed=seed + 1),
+        Normalize([127.5] * 3, [127.5] * 3),
+    )
+
+
+def imagenet_train_augmenter(size: int = 224, seed: int = 0) -> Augmenter:
+    """ImageNet training recipe (random crop + mirror + normalize),
+    matching ``fit.py`` defaults."""
+    return Compose(
+        Resize((size + 32, size + 32)),
+        RandomCrop((size, size), seed=seed),
+        RandomMirror(seed=seed + 1),
+        Normalize([123.68, 116.779, 103.939], [58.393, 57.12, 57.375]),
+    )

@@ -1,0 +1,343 @@
+"""Core data iterators.
+
+Reference: ``python/mxnet/io/io.py`` (DataIter/DataBatch/NDArrayIter/
+ResizeIter/PrefetchingIter) and the C++ iterators in ``src/io/``.  Iterators
+yield numpy host batches; device placement happens in the training loop (so
+the same iterator drives a sharded `jax.make_array_from_process_local_data`
+path under data parallelism).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class DataBatch:
+    """One batch.  Reference: ``mx.io.DataBatch`` — ``pad`` counts the fake
+    trailing examples appended to fill the batch (last_batch_handle='pad')."""
+
+    __slots__ = ("data", "label", "pad")
+
+    def __init__(self, data: np.ndarray, label: Optional[np.ndarray] = None,
+                 pad: int = 0):
+        self.data = data
+        self.label = label
+        self.pad = pad
+
+
+class DataIter:
+    """Iterator base.  Reference: ``mx.io.DataIter`` (reset/next/iter).
+
+    ``num_parts``/``part_index`` sharding is part of the base contract here
+    (in the reference it is per-iterator param plumbing,
+    ``src/io/image_iter_common.h:127-162``).
+    """
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def next(self) -> DataBatch:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[DataBatch]:
+        self.reset()
+        while True:
+            try:
+                yield self.next()
+            except StopIteration:
+                return
+
+    @property
+    def steps_per_epoch(self) -> Optional[int]:
+        return None
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator with sharding + shuffle + pad semantics.
+
+    Reference: ``mx.io.NDArrayIter``; ``last_batch_handle`` in
+    {'pad','discard','roll_over'} with reference behavior.  Sharding: this
+    part sees ``data[part_index::num_parts]`` (the reference's RecordIO
+    sharding is also strided by part).
+    """
+
+    def __init__(self, data: np.ndarray, label: Optional[np.ndarray] = None,
+                 batch_size: int = 32, shuffle: bool = False,
+                 last_batch_handle: str = "pad", num_parts: int = 1,
+                 part_index: int = 0, seed: int = 0):
+        super().__init__(batch_size)
+        if not 0 <= part_index < num_parts:
+            raise ValueError(f"part_index {part_index} not in [0, {num_parts})")
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise ValueError(last_batch_handle)
+        self._data = data
+        self._label = label
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_parts = num_parts
+        self.part_index = part_index
+        self._epoch = 0
+        self._seed = seed
+        self._leftover: Optional[np.ndarray] = None
+        self._setup_epoch()
+
+    def _setup_epoch(self):
+        n = len(self._data)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self._seed + self._epoch)
+            rng.shuffle(idx)
+        # strided shard: every part gets ceil/floor(n/num_parts) examples
+        idx = idx[self.part_index::self.num_parts]
+        if self._leftover is not None:
+            idx = np.concatenate([self._leftover, idx])
+            self._leftover = None
+        self._order = idx
+        self._cursor = 0
+
+    def reset(self):
+        self._epoch += 1
+        self._setup_epoch()
+
+    @property
+    def num_examples(self) -> int:
+        return len(self._order)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        n = len(self._order)
+        if self.last_batch_handle == "discard":
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def next(self) -> DataBatch:
+        n = len(self._order)
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        sel = self._order[self._cursor:end]
+        pad = 0
+        if end > n:
+            if self.last_batch_handle == "discard":
+                self._cursor = n
+                raise StopIteration
+            if self.last_batch_handle == "roll_over":
+                self._leftover = sel
+                self._cursor = n
+                raise StopIteration
+            pad = end - n
+            sel = np.concatenate([sel, self._order[:pad]])  # wrap like reference
+        self._cursor = end
+        data = self._data[sel]
+        label = self._label[sel] if self._label is not None else None
+        return DataBatch(data, label, pad)
+
+
+class CSVIter(NDArrayIter):
+    """CSV-backed iterator.  Reference: ``src/io/iter_csv.cc`` — here a thin
+    numpy.loadtxt front-end over NDArrayIter (same batch semantics)."""
+
+    def __init__(self, data_csv: str, data_shape: Sequence[int],
+                 label_csv: Optional[str] = None, batch_size: int = 32, **kw):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+        super().__init__(data, label, batch_size, **kw)
+
+
+class ResizeIter(DataIter):
+    """Clamp an underlying iterator to exactly ``size`` batches per epoch,
+    refilling from a fresh pass when the inner iterator is exhausted.
+
+    Reference: ``mx.io.ResizeIter`` — the elastic fit loop wraps every
+    worker's iterator in this so all workers run the SAME number of batches
+    (``example/image-classification/common/fit.py:38-43``): unequal counts
+    would hang the synchronous allreduce exactly like they hang the
+    reference's synchronous push/pull.
+    """
+
+    def __init__(self, data_iter: DataIter, size: int,
+                 reset_internal: bool = True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch: Optional[DataBatch] = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.size
+
+    def next(self) -> DataBatch:
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread double buffering.
+
+    Reference: ``mx.io.PrefetchingIter`` / the C++ ``PrefetcherIter``
+    (``src/io/iter_prefetcher.h``, dmlc ThreadedIter) — overlaps host batch
+    prep with device compute, which on TPU hides input time behind the
+    async-dispatched train step.
+    """
+
+    def __init__(self, data_iter: DataIter, prefetch_depth: int = 2):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.depth = prefetch_depth
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._exhausted = False
+
+    def _worker(self, q: "queue.Queue", stop: threading.Event):
+        # q/stop are captured per-generation: a straggler worker from a
+        # previous epoch can only ever touch its own (discarded) queue,
+        # never the queue a later reset() created.
+        try:
+            while not stop.is_set():
+                try:
+                    batch = self.data_iter.next()
+                except StopIteration:
+                    q.put(None)
+                    return
+                q.put(batch)
+        except Exception as e:  # propagate errors to consumer
+            q.put(e)
+
+    def reset(self):
+        self._shutdown()
+        self.data_iter.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self.depth)
+        self._exhausted = False
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._stop), daemon=True)
+        self._thread.start()
+
+    def _shutdown(self):
+        if self._thread is not None:
+            self._stop.set()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    @property
+    def steps_per_epoch(self):
+        return self.data_iter.steps_per_epoch
+
+    def next(self) -> DataBatch:
+        if self._thread is None:
+            if getattr(self, "_exhausted", False):
+                # keep raising after exhaustion like every other DataIter
+                raise StopIteration
+            self.reset()
+        item = self._queue.get()
+        if item is None:
+            self._thread = None
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._thread = None
+            self._exhausted = True
+            raise item
+        return item
+
+
+class SyntheticImageIter(DataIter):
+    """Deterministic synthetic image batches (benchmark-mode input).
+
+    Reference: the ``--benchmark 1`` path in
+    ``example/image-classification/common/fit.py`` (random synthetic data so
+    input IO can't mask compute throughput)."""
+
+    def __init__(self, image_shape: Sequence[int], num_classes: int,
+                 batch_size: int, num_batches: int = 100, seed: int = 0,
+                 dtype: str = "float32"):
+        super().__init__(batch_size)
+        rng = np.random.RandomState(seed)
+        self._data = rng.uniform(-1, 1, (batch_size,) + tuple(image_shape)) \
+            .astype(dtype)
+        self._label = rng.randint(0, num_classes, (batch_size,)) \
+            .astype("int32")
+        self.num_batches = num_batches
+        self._cur = 0
+
+    def reset(self):
+        self._cur = 0
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.num_batches
+
+    def next(self) -> DataBatch:
+        if self._cur >= self.num_batches:
+            raise StopIteration
+        self._cur += 1
+        return DataBatch(self._data, self._label, 0)
+
+
+class ElasticDataIterator:
+    """The elastic re-sharding contract.
+
+    Reference: ``BaseDataIterator`` (``python/mxnet/module/
+    base_data_iterator.py``) + its implementation in
+    ``example/dynamic-training/train_resnet.py:353-377``: after a membership
+    change the fit loop calls ``get_data_iterator(kv)`` and the user rebuilds
+    iterators with ``num_parts=kv.num_workers``, ``part_index=kv.rank``,
+    wrapped in ResizeIter to equalize batch counts.
+
+    ``factory(num_parts, part_index, batch_size)`` must return
+    ``(train_iter, eval_iter_or_None)``.  ``global_batch_size`` fixed =>
+    per-worker batch rescales (Lin et al. policy, ``train_resnet.py:315-317``);
+    set ``fixed_per_worker_batch=True`` for the alternative policy shipped in
+    ``fit.py:28-44``.
+    """
+
+    def __init__(self, factory: Callable[[int, int, int], tuple],
+                 global_batch_size: int,
+                 fixed_per_worker_batch: bool = False):
+        self.factory = factory
+        self.global_batch_size = global_batch_size
+        self.fixed_per_worker_batch = fixed_per_worker_batch
+
+    def per_worker_batch(self, num_workers: int) -> int:
+        if self.fixed_per_worker_batch:
+            return self.global_batch_size
+        if self.global_batch_size % num_workers != 0:
+            raise ValueError(
+                f"global batch {self.global_batch_size} not divisible by "
+                f"{num_workers} workers")
+        return self.global_batch_size // num_workers
+
+    def get_data_iterator(self, kv) -> tuple:
+        """``kv`` exposes ``num_workers`` and ``rank`` (KVStore facade)."""
+        bs = self.per_worker_batch(kv.num_workers)
+        return self.factory(kv.num_workers, kv.rank, bs)

@@ -1,0 +1,44 @@
+"""AlexNet.  Reference: ``example/image-classification/symbols/alexnet.py``
+(the single-tower variant with LRN, BASELINE row 'AlexNet 457 img/s')."""
+
+from typing import Any
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.ops import nn as ops
+
+
+class AlexNet(linen.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        x = linen.Conv(96, (11, 11), (4, 4), padding=[(2, 2), (2, 2)],
+                       dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = ops.lrn(x, nsize=5)
+        x = ops.max_pool2d(x, 3, 2)
+        x = linen.Conv(256, (5, 5), padding=[(2, 2), (2, 2)], dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = ops.lrn(x, nsize=5)
+        x = ops.max_pool2d(x, 3, 2)
+        x = linen.Conv(384, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = linen.Conv(384, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = linen.Conv(256, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = ops.max_pool2d(x, 3, 2)
+        x = ops.flatten(x)
+        x = linen.Dense(4096, dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = ops.dropout(x, 0.5, training=training,
+                        rng=self.make_rng("dropout") if training else None)
+        x = linen.Dense(4096, dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = ops.dropout(x, 0.5, training=training,
+                        rng=self.make_rng("dropout") if training else None)
+        return linen.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,50 @@
+"""Shared building blocks for the model zoo (flax.linen, NHWC)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from dt_tpu.ops import nn as nn_ops
+
+Dtype = Any
+
+# BN running-stat convention follows the reference
+# (moving = moving*momentum + batch*(1-momentum), src/operator/nn/batch_norm.cc).
+# flax BatchNorm's `momentum` has the same meaning.
+BN_MOMENTUM = 0.9
+BN_EPS = 1e-5
+
+
+def bn(training: bool, dtype: Dtype = jnp.float32, name: Optional[str] = None
+       ) -> linen.BatchNorm:
+    """The one BatchNorm construction every model uses (keeps momentum/eps
+    conventions in a single place)."""
+    return linen.BatchNorm(use_running_average=not training,
+                           momentum=BN_MOMENTUM, epsilon=BN_EPS, dtype=dtype,
+                           name=name)
+
+
+class ConvBN(linen.Module):
+    """Conv → BN → activation, the fused triple the reference's CUDA BN paths
+    optimize (``src/operator/nn/batch_norm.cu``); XLA fuses it from this."""
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    act: Optional[str] = "relu"
+    groups: int = 1
+    dtype: Dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        x = linen.Conv(self.features, self.kernel, self.strides,
+                       padding=self.padding, use_bias=False,
+                       feature_group_count=self.groups, dtype=self.dtype)(x)
+        x = bn(training, self.dtype)(x)
+        if self.act is not None:
+            x = nn_ops.activation(x, self.act)
+        return x

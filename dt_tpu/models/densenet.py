@@ -1,0 +1,69 @@
+"""DenseNet 121/161/169/201.
+
+Reference: ``python/mxnet/gluon/model_zoo/vision/densenet.py``."""
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.models.common import bn as _bn
+from dt_tpu.ops import nn as ops
+
+_SPECS: Dict[int, Tuple[int, int, Tuple[int, ...]]] = {
+    # depth: (init_features, growth_rate, block_config)
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
+
+
+class DenseLayer(linen.Module):
+    growth_rate: int
+    bn_size: int = 4
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        y = _bn(training, self.dtype)(x)
+        y = jax.nn.relu(y)
+        y = linen.Conv(self.bn_size * self.growth_rate, (1, 1), use_bias=False,
+                       dtype=self.dtype)(y)
+        y = _bn(training, self.dtype)(y)
+        y = jax.nn.relu(y)
+        y = linen.Conv(self.growth_rate, (3, 3), padding="SAME", use_bias=False,
+                       dtype=self.dtype)(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class DenseNet(linen.Module):
+    depth: int = 121
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        init_f, growth, blocks = _SPECS[self.depth]
+        x = linen.Conv(init_f, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                       use_bias=False, dtype=self.dtype)(x)
+        x = _bn(training, self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = ops.max_pool2d(x, 3, 2, padding=1)
+        features = init_f
+        for i, nlayers in enumerate(blocks):
+            for _ in range(nlayers):
+                x = DenseLayer(growth, dtype=self.dtype)(x, training)
+                features += growth
+            if i != len(blocks) - 1:
+                features //= 2
+                x = _bn(training, self.dtype)(x)
+                x = jax.nn.relu(x)
+                x = linen.Conv(features, (1, 1), use_bias=False,
+                               dtype=self.dtype)(x)
+                x = ops.avg_pool2d(x, 2, 2)
+        x = _bn(training, self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return linen.Dense(self.num_classes, dtype=self.dtype)(x)

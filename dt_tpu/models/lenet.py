@@ -1,0 +1,27 @@
+"""LeNet-5.  Reference: ``example/image-classification/symbols/lenet.py``
+(and the distributed convergence gate ``tests/nightly/dist_lenet.py``)."""
+
+from typing import Any
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from dt_tpu.ops import nn as ops
+
+
+class LeNet(linen.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        x = linen.Conv(20, (5, 5), dtype=self.dtype)(x)
+        x = jnp.tanh(x)
+        x = ops.max_pool2d(x, 2, 2)
+        x = linen.Conv(50, (5, 5), dtype=self.dtype)(x)
+        x = jnp.tanh(x)
+        x = ops.max_pool2d(x, 2, 2)
+        x = ops.flatten(x)
+        x = linen.Dense(500, dtype=self.dtype)(x)
+        x = jnp.tanh(x)
+        return linen.Dense(self.num_classes, dtype=self.dtype)(x)

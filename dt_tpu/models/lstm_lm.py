@@ -1,0 +1,71 @@
+"""LSTM word-level language model.
+
+Reference: ``example/rnn/word_lm/`` (PTB LSTM LM — BASELINE config #5,
+the elastic RNN workload) and the bucketing LM in ``example/rnn/bucketing/``.
+Embedding -> multi-layer LSTM (scan-fused, ``dt_tpu.ops.rnn``) -> tied or
+untied softmax head.
+"""
+
+from typing import Any, Tuple
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.ops import nn as ops
+from dt_tpu.ops import rnn as rnn_ops
+
+
+class LSTMLanguageModel(linen.Module):
+    vocab_size: int = 10000
+    embed_dim: int = 200
+    hidden: int = 200
+    num_layers: int = 2
+    dropout: float = 0.2
+    tie_weights: bool = False
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, tokens, state: Tuple[jax.Array, jax.Array] = None,
+                 training: bool = True):
+        """``tokens``: (T, B) int32.  Returns (logits (T,B,V), (hT, cT))."""
+        t, b = tokens.shape
+        embed = linen.Embed(self.vocab_size, self.embed_dim,
+                            dtype=self.dtype, name="embed")
+        x = embed(tokens)
+        if training and self.dropout > 0:
+            x = ops.dropout(x, self.dropout, training=True,
+                            rng=self.make_rng("dropout"))
+        # Symmetric ±1/sqrt(H) init (cuDNN/PTB-LM convention, same as
+        # ops.rnn.init_lstm_weights); linen.uniform(s) samples [0, s) only.
+        scale = 1.0 / float(self.hidden) ** 0.5
+
+        def sym_uniform(key, shape, dtype):
+            return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+        weights = [
+            rnn_ops.LSTMWeights(
+                wx=self.param(f"l{i}_wx", sym_uniform,
+                              (self.embed_dim if i == 0 else self.hidden,
+                               4 * self.hidden), self.dtype),
+                wh=self.param(f"l{i}_wh", sym_uniform,
+                              (self.hidden, 4 * self.hidden), self.dtype),
+                b=self.param(f"l{i}_b", linen.initializers.zeros,
+                             (4 * self.hidden,), self.dtype),
+            )
+            for i in range(self.num_layers)
+        ]
+        if state is None:
+            h0 = jnp.zeros((self.num_layers, b, self.hidden), self.dtype)
+            c0 = jnp.zeros((self.num_layers, b, self.hidden), self.dtype)
+        else:
+            h0, c0 = state
+        y, hT, cT = rnn_ops.lstm(x, h0, c0, weights)
+        if training and self.dropout > 0:
+            y = ops.dropout(y, self.dropout, training=True,
+                            rng=self.make_rng("dropout"))
+        if self.tie_weights:
+            logits = y @ embed.embedding.T.astype(self.dtype)
+        else:
+            logits = linen.Dense(self.vocab_size, dtype=self.dtype)(y)
+        return logits, (hT, cT)

@@ -1,0 +1,24 @@
+"""MLP.  Reference: ``example/image-classification/symbols/mlp.py``
+(128-64-num_classes with relu)."""
+
+from typing import Any, Sequence
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.ops import nn as ops
+
+
+class MLP(linen.Module):
+    num_classes: int = 10
+    hidden: Sequence[int] = (128, 64)
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        x = ops.flatten(x)
+        for h in self.hidden:
+            x = linen.Dense(h, dtype=self.dtype)(x)
+            x = jax.nn.relu(x)
+        return linen.Dense(self.num_classes, dtype=self.dtype)(x)

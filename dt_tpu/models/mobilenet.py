@@ -1,0 +1,91 @@
+"""MobileNet v1/v2.
+
+Reference: ``example/image-classification/symbols/mobilenet.py`` (v1
+depthwise-separable) and ``python/mxnet/gluon/model_zoo/vision/mobilenet.py``
+(v2 inverted residuals).  Depthwise convs lower to XLA grouped convs (the
+reference hand-wrote ``depthwise_convolution_tf.cuh``)."""
+
+from typing import Any
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.models.common import ConvBN
+
+
+class DWSep(linen.Module):
+    """Depthwise 3x3 + pointwise 1x1, both BN+relu (v1 block)."""
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        in_ch = x.shape[-1]
+        x = ConvBN(in_ch, (3, 3), (self.strides, self.strides), "SAME",
+                   groups=in_ch, dtype=self.dtype)(x, training)
+        return ConvBN(self.features, (1, 1), dtype=self.dtype)(x, training)
+
+
+class MobileNetV1(linen.Module):
+    num_classes: int = 1000
+    multiplier: float = 1.0
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        m = self.multiplier
+        c = lambda f: max(8, int(f * m))
+        x = ConvBN(c(32), (3, 3), (2, 2), dtype=self.dtype)(x, training)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+               (1024, 1)]
+        for f, s in cfg:
+            x = DWSep(c(f), s, self.dtype)(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        return linen.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class InvertedResidual(linen.Module):
+    features: int
+    strides: int = 1
+    expand: int = 6
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        y = x
+        if self.expand != 1:
+            y = ConvBN(hidden, (1, 1), act="relu", dtype=self.dtype)(y, training)
+        y = ConvBN(hidden, (3, 3), (self.strides, self.strides), "SAME",
+                   groups=hidden, dtype=self.dtype)(y, training)
+        y = ConvBN(self.features, (1, 1), act=None, dtype=self.dtype)(y, training)
+        if self.strides == 1 and in_ch == self.features:
+            return x + y
+        return y
+
+
+class MobileNetV2(linen.Module):
+    num_classes: int = 1000
+    multiplier: float = 1.0
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        m = self.multiplier
+        c = lambda f: max(8, int(f * m))
+        x = ConvBN(c(32), (3, 3), (2, 2), dtype=self.dtype)(x, training)
+        # (expand, out, repeats, stride)
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        for t, f, n, s in cfg:
+            for i in range(n):
+                x = InvertedResidual(c(f), s if i == 0 else 1, t,
+                                     self.dtype)(x, training)
+        x = ConvBN(c(1280) if m <= 1.0 else int(1280 * m), (1, 1),
+                   dtype=self.dtype)(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        return linen.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,53 @@
+"""SqueezeNet 1.1.
+
+Reference: ``python/mxnet/gluon/model_zoo/vision/squeezenet.py``."""
+
+from typing import Any
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.ops import nn as ops
+
+
+class Fire(linen.Module):
+    squeeze: int
+    expand1: int
+    expand3: int
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x):
+        x = linen.Conv(self.squeeze, (1, 1), dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        e1 = jax.nn.relu(linen.Conv(self.expand1, (1, 1), dtype=self.dtype)(x))
+        e3 = jax.nn.relu(linen.Conv(self.expand3, (3, 3), padding="SAME",
+                                    dtype=self.dtype)(x))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeNet(linen.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        x = linen.Conv(64, (3, 3), (2, 2), dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = ops.max_pool2d(x, 3, 2)
+        x = Fire(16, 64, 64, self.dtype)(x)
+        x = Fire(16, 64, 64, self.dtype)(x)
+        x = ops.max_pool2d(x, 3, 2)
+        x = Fire(32, 128, 128, self.dtype)(x)
+        x = Fire(32, 128, 128, self.dtype)(x)
+        x = ops.max_pool2d(x, 3, 2)
+        x = Fire(48, 192, 192, self.dtype)(x)
+        x = Fire(48, 192, 192, self.dtype)(x)
+        x = Fire(64, 256, 256, self.dtype)(x)
+        x = Fire(64, 256, 256, self.dtype)(x)
+        x = ops.dropout(x, 0.5, training=training,
+                        rng=self.make_rng("dropout") if training else None)
+        x = linen.Conv(self.num_classes, (1, 1), dtype=self.dtype)(x)
+        x = jax.nn.relu(x)
+        return jnp.mean(x, axis=(1, 2))

@@ -1,0 +1,46 @@
+"""VGG 11/13/16/19 with optional BN.
+
+Reference: ``example/image-classification/symbols/vgg.py`` and
+``python/mxnet/gluon/model_zoo/vision/vgg.py`` (BASELINE config #4 is
+VGG-16+BN)."""
+
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.models.common import bn
+from dt_tpu.ops import nn as ops
+
+_LAYERS: Dict[int, Tuple[Sequence[int], Sequence[int]]] = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+class VGG(linen.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    batch_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        layers, filters = _LAYERS[self.depth]
+        for nblk, f in zip(layers, filters):
+            for _ in range(nblk):
+                x = linen.Conv(f, (3, 3), padding="SAME", dtype=self.dtype)(x)
+                if self.batch_norm:
+                    x = bn(training, self.dtype)(x)
+                x = jax.nn.relu(x)
+            x = ops.max_pool2d(x, 2, 2)
+        x = ops.flatten(x)
+        for _ in range(2):
+            x = linen.Dense(4096, dtype=self.dtype)(x)
+            x = jax.nn.relu(x)
+            x = ops.dropout(x, 0.5, training=training,
+                            rng=self.make_rng("dropout") if training else None)
+        return linen.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,40 @@
+"""Optimizers and LR schedulers.
+
+Reference: ``python/mxnet/optimizer/optimizer.py`` (registry + 14 optimizers),
+``python/mxnet/lr_scheduler.py``, and the fused C++ update kernels in
+``src/operator/optimizer_op.cc`` (SURVEY.md §2.5).  TPU-native design: each
+optimizer is an ``optax.GradientTransformation`` so the update runs as one
+fused XLA program sharded with the params (the reference ran updates on the
+parameter *servers*; here the mesh shards them on-device — the
+"automatic cross-replica sharding of weight update" pattern).
+"""
+
+from dt_tpu.optim.optimizers import (
+    create as create,
+    register as register,
+    sgd as sgd,
+    nag as nag,
+    adam as adam,
+    adagrad as adagrad,
+    rmsprop as rmsprop,
+    adadelta as adadelta,
+    ftrl as ftrl,
+    adamax as adamax,
+    nadam as nadam,
+    signum as signum,
+    ftml as ftml,
+    sgld as sgld,
+    dcasgd as dcasgd,
+    lbsgd as lbsgd,
+    lamb as lamb,
+    with_multi_precision as with_multi_precision,
+)
+from dt_tpu.optim.lr_scheduler import (
+    LRScheduler as LRScheduler,
+    FactorScheduler as FactorScheduler,
+    MultiFactorScheduler as MultiFactorScheduler,
+    PolyScheduler as PolyScheduler,
+    CosineScheduler as CosineScheduler,
+    constant as constant,
+    make as make,
+)

@@ -1,0 +1,143 @@
+"""LR schedulers with the reference's semantics.
+
+Reference: ``python/mxnet/lr_scheduler.py`` — FactorScheduler,
+MultiFactorScheduler, PolyScheduler, CosineScheduler, each with linear/constant
+warmup.  Schedulers are jit-friendly callables ``step -> lr`` (jnp math, no
+Python branches on traced values), so they can live inside the compiled train
+step — the reference recomputed LR on the Python side every update.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    """Base: warmup handling shared by all schedulers
+    (reference ``LRScheduler.get_warmup_lr``)."""
+
+    def __init__(self, base_lr: float = 0.01, warmup_steps: int = 0,
+                 warmup_begin_lr: float = 0.0, warmup_mode: str = "linear"):
+        self.base_lr = base_lr
+        self.warmup_steps = warmup_steps
+        self.warmup_begin_lr = warmup_begin_lr
+        self.warmup_final_lr = base_lr
+        if warmup_mode not in ("linear", "constant"):
+            raise ValueError(f"warmup_mode {warmup_mode!r}")
+        self.warmup_mode = warmup_mode
+
+    def _warmup_lr(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.warmup_mode == "linear":
+            inc = (self.warmup_final_lr - self.warmup_begin_lr) / \
+                max(self.warmup_steps, 1)
+            return self.warmup_begin_lr + inc * step
+        return jnp.asarray(self.warmup_begin_lr, jnp.float32)
+
+    def _main_lr(self, step):
+        raise NotImplementedError
+
+    def __call__(self, step):
+        step = jnp.asarray(step)
+        if self.warmup_steps <= 0:
+            return self._main_lr(step)
+        return jnp.where(step < self.warmup_steps, self._warmup_lr(step),
+                         self._main_lr(step))
+
+
+class ConstantScheduler(LRScheduler):
+    def _main_lr(self, step):
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+
+def constant(base_lr: float, **kw) -> ConstantScheduler:
+    return ConstantScheduler(base_lr, **kw)
+
+
+class FactorScheduler(LRScheduler):
+    """lr = base_lr * factor^(step // step_size), floored at stop_factor_lr.
+    Reference: FactorScheduler."""
+
+    def __init__(self, step: int, factor: float = 1.0,
+                 stop_factor_lr: float = 1e-8, base_lr: float = 0.01, **kw):
+        super().__init__(base_lr, **kw)
+        if step < 1:
+            raise ValueError("step must be >= 1")
+        if factor > 1.0:
+            raise ValueError("factor must be <= 1")
+        self.step = step
+        self.factor = factor
+        self.stop_factor_lr = stop_factor_lr
+
+    def _main_lr(self, step):
+        n = (step // self.step).astype(jnp.float32)
+        lr = self.base_lr * jnp.power(self.factor, n)
+        return jnp.maximum(lr, self.stop_factor_lr)
+
+
+class MultiFactorScheduler(LRScheduler):
+    """Drop by ``factor`` at each step in ``steps``.
+    Reference: MultiFactorScheduler."""
+
+    def __init__(self, steps: Sequence[int], factor: float = 1.0,
+                 base_lr: float = 0.01, **kw):
+        super().__init__(base_lr, **kw)
+        if sorted(steps) != list(steps):
+            raise ValueError("steps must be increasing")
+        self.steps = jnp.asarray(steps)
+        self.factor = factor
+
+    def _main_lr(self, step):
+        n = jnp.sum(step >= self.steps).astype(jnp.float32)
+        return self.base_lr * jnp.power(self.factor, n)
+
+
+class PolyScheduler(LRScheduler):
+    """Polynomial decay base_lr -> final_lr over max_update steps.
+    Reference: PolyScheduler (pwr=2 default)."""
+
+    def __init__(self, max_update: int, base_lr: float = 0.01,
+                 final_lr: float = 0.0, pwr: int = 2, **kw):
+        super().__init__(base_lr, **kw)
+        self.max_update = max_update
+        self.final_lr = final_lr
+        self.pwr = pwr
+
+    def _main_lr(self, step):
+        max_steps = max(self.max_update - self.warmup_steps, 1)
+        frac = jnp.clip((step - self.warmup_steps) / max_steps, 0.0, 1.0)
+        return self.final_lr + (self.base_lr - self.final_lr) * \
+            jnp.power(1.0 - frac, self.pwr)
+
+
+class CosineScheduler(LRScheduler):
+    """Cosine decay base_lr -> final_lr over max_update steps.
+    Reference: CosineScheduler."""
+
+    def __init__(self, max_update: int, base_lr: float = 0.01,
+                 final_lr: float = 0.0, **kw):
+        super().__init__(base_lr, **kw)
+        self.max_update = max_update
+        self.final_lr = final_lr
+
+    def _main_lr(self, step):
+        max_steps = max(self.max_update - self.warmup_steps, 1)
+        frac = jnp.clip((step - self.warmup_steps) / max_steps, 0.0, 1.0)
+        return self.final_lr + (self.base_lr - self.final_lr) * \
+            (1.0 + jnp.cos(jnp.pi * frac)) / 2.0
+
+
+def make(name: str, **kwargs) -> LRScheduler:
+    """Factory from config (``dt_tpu.config.LRSchedulerConfig.name``)."""
+    table = {
+        "constant": ConstantScheduler,
+        "factor": FactorScheduler,
+        "multifactor": MultiFactorScheduler,
+        "poly": PolyScheduler,
+        "cosine": CosineScheduler,
+    }
+    if name not in table:
+        raise ValueError(f"unknown scheduler {name!r}; known: {sorted(table)}")
+    return table[name](**kwargs)

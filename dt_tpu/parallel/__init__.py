@@ -1,0 +1,20 @@
+"""Distributed layer: mesh utilities, KVStore facade, elastic control plane.
+
+Reference: ``src/kvstore/`` + ``3rdparty/ps-lite`` (SURVEY.md §2.3).  The
+ps-lite data plane (push/aggregate/optimize/pull per key, every step) becomes
+a pjit-sharded train step with gradient ``psum`` over the mesh's ``data``
+axis; the KVStore class survives as the *control* facade the training loop
+talks to (rank/num_workers/barriers/membership changes), exactly the surface
+``BaseModule.fit`` consumes in the reference.
+"""
+
+from dt_tpu.parallel.mesh import (
+    make_mesh as make_mesh,
+    data_sharding as data_sharding,
+    replicate_sharding as replicate_sharding,
+    shard_batch as shard_batch,
+)
+from dt_tpu.parallel.kvstore import (
+    KVStore as KVStore,
+    create as create,
+)

@@ -1,0 +1,153 @@
+"""KVStore facade — the training loop's view of the distributed world.
+
+Reference: ``include/mxnet/kvstore.h`` + ``python/mxnet/kvstore.py``.  The
+reference KVStore carries both the DATA plane (push/pull of gradients and
+weights to parameter servers) and the CONTROL plane (rank/num_workers,
+barriers, membership changes).  On TPU the data plane is inside the compiled
+train step (psum over the mesh), so this facade keeps:
+
+- identity: ``rank``, ``num_workers`` (``kvstore.h:418``)
+- the epoch-boundary ``_membership_change_barrier``
+  (``python/mxnet/kvstore.py:617-624``) -> delegated to an attached elastic
+  controller (``dt_tpu.elastic``)
+- the parameter snapshot that replaces "the server's copy": new workers
+  bootstrap from it (``module/module.py:552-571``), BN aux params are
+  averaged into it at epoch end (the >= 10M key space,
+  ``kvstore_dist_server.h:356-360``)
+- ``push``/``pull`` retained for API parity with reference user code
+  (host-side averaged store keyed by str — NOT the training hot path).
+
+Types (``KVStore::Create``, ``src/kvstore/kvstore.cc:40-77``): ``local`` /
+``device`` -> single-process store; ``tpu_sync`` (aliases ``dist_sync``,
+``dist_device_sync``) -> mesh-backed store.  ``dist_async`` has no SPMD
+analog (SURVEY.md §5.8) and raises with that explanation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from dt_tpu.parallel import mesh as mesh_lib
+
+
+class KVStore:
+    """Base/local store: single process, whole local mesh."""
+
+    def __init__(self, mesh=None):
+        self._mesh = mesh
+        self._store: Dict[str, np.ndarray] = {}
+        self._controller = None  # dt_tpu.elastic worker-side client
+        self._num_dead = 0
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return "local"
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = mesh_lib.make_mesh()
+        return self._mesh
+
+    # -- data-plane parity API (host-side; NOT the training hot path) ------
+    def init(self, key: str, value, exclude_update: bool = False):
+        """Reference ``KVStore.init(..., exclude_update)``
+        (``kvstore.py:116-158``): exclude_update marks aux params (BN stats)
+        that are averaged, never optimizer-updated."""
+        self._store[key] = np.asarray(value)
+
+    def push(self, key: str, values):
+        """Aggregate (mean) into the store — the server-side merge
+        (``kvstore_dist_server.h:710-739``) without the wire."""
+        if not isinstance(values, (list, tuple)):
+            values = [values]
+        merged = np.mean([np.asarray(v) for v in values], axis=0)
+        self._store[key] = merged
+
+    def pull(self, key: str):
+        return self._store[key]
+
+    # -- barriers / elasticity --------------------------------------------
+    def barrier(self):
+        pass
+
+    def set_controller(self, controller):
+        """Attach an elastic controller (worker-side client owning the
+        scheduler connection)."""
+        self._controller = controller
+
+    def _membership_change_barrier(self, info: Optional[dict] = None) -> None:
+        """Reference ``kvstore.py:617-624``: block until the scheduler has
+        applied any pending membership change for this epoch.  May change
+        ``rank``/``num_workers``; fit re-reads them after the call."""
+        if self._controller is not None:
+            self._controller.membership_change_barrier(info or {})
+
+    def get_num_dead_node(self, timeout_s: float = 60.0) -> int:
+        """Reference ``kv.get_num_dead_node`` (``kvstore_dist.h:134-143``)."""
+        if self._controller is not None:
+            return self._controller.num_dead_nodes(timeout_s)
+        return 0
+
+    # -- optimizer hand-off (API parity) ----------------------------------
+    def set_optimizer(self, optimizer):
+        """Reference pickles the optimizer to the servers
+        (``kvstore.py:451-498``); on TPU the optimizer is already inside the
+        sharded train step, so this only records it for introspection."""
+        self._optimizer = optimizer
+
+
+class TPUSyncKVStore(KVStore):
+    """Mesh-backed synchronous store (``tpu_sync``).
+
+    num_workers/rank: in multi-process (multi-host pod) runs these are the
+    jax process indices; under an elastic controller they track the live
+    membership the scheduler maintains (ranks shift on removal exactly like
+    the reference's ordered-live-set ranks, ``van.cc:519-539``).
+    """
+
+    def __init__(self, mesh=None):
+        super().__init__(mesh)
+
+    @property
+    def type(self) -> str:
+        return "tpu_sync"
+
+    @property
+    def rank(self) -> int:
+        if self._controller is not None:
+            return self._controller.rank
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        if self._controller is not None:
+            return self._controller.num_workers
+        return jax.process_count()
+
+
+def create(name: str = "local", mesh=None) -> KVStore:
+    """Reference ``mx.kv.create`` type-string dispatch
+    (``src/kvstore/kvstore.cc:40-77``)."""
+    key = name.lower()
+    if key in ("local", "device"):
+        return KVStore(mesh)
+    if key in ("tpu_sync", "dist_sync", "dist_device_sync", "dist"):
+        return TPUSyncKVStore(mesh)
+    if key in ("dist_async",):
+        raise ValueError(
+            "dist_async has no synchronous-SPMD analog on TPU (SURVEY.md "
+            "§5.8); use tpu_sync")
+    raise ValueError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,56 @@
+"""Device-mesh utilities.
+
+The reference's process topology (N workers x G GPUs + R servers, ps-lite
+node groups, ``postoffice.h:102-111``) collapses on TPU into one
+``jax.sharding.Mesh``.  Axes: ``data`` (the worker dimension — gradients
+psum here, replacing push/pull), ``model`` (tensor parallelism; the
+reference only had manual ``group2ctx`` model parallelism).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(data: Optional[int] = None, model: int = 1,
+              devices: Optional[Sequence] = None,
+              axis_names: Tuple[str, str] = ("data", "model")) -> Mesh:
+    """Build a 2-D mesh (data-major).  ``data=None`` uses all devices / model.
+
+    The data axis should map to ICI neighbors so the gradient allreduce rides
+    ICI, not DCN — jax device order already enumerates the torus in
+    ICI-contiguous order, so a reshape is the right default.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devs) % model:
+            raise ValueError(f"{len(devs)} devices not divisible by model={model}")
+        data = len(devs) // model
+    if data * model > len(devs):
+        raise ValueError(
+            f"mesh {data}x{model} needs {data*model} devices, have {len(devs)}")
+    grid = np.array(devs[:data * model]).reshape(data, model)
+    return Mesh(grid, axis_names)
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard leading (batch) dim over 'data', replicate the rest."""
+    spec = P(*(("data",) + (None,) * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicate_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch (pytree of np arrays) onto the mesh, batch dim
+    sharded over 'data'.  Single-process path: ``jax.device_put`` with a
+    NamedSharding splits the array across local devices."""
+    def put(x):
+        return jax.device_put(x, data_sharding(mesh, np.ndim(x)))
+    return jax.tree_util.tree_map(put, batch)

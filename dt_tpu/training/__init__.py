@@ -1,0 +1,11 @@
+"""Training loop layer.
+
+Reference: ``python/mxnet/module/`` + ``metric.py`` + ``callback.py``
+(SURVEY.md §2.5).
+"""
+
+from dt_tpu.training import metrics as metrics
+from dt_tpu.training import callbacks as callbacks
+from dt_tpu.training import checkpoint as checkpoint
+from dt_tpu.training.train_state import TrainState as TrainState
+from dt_tpu.training.module import Module as Module, softmax_ce_loss as softmax_ce_loss

@@ -1,0 +1,93 @@
+"""Training callbacks.
+
+Reference: ``python/mxnet/callback.py`` (Speedometer, do_checkpoint,
+LogValidationMetricsCallback) + the elastic-aware Speedometer subclass in
+``example/dynamic-training/train_resnet.py:381-390`` that rescales
+throughput by the live worker count.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+from dt_tpu.training import checkpoint as ckpt_lib
+
+logger = logging.getLogger("dt_tpu")
+
+
+class BatchEndParam:
+    """Reference ``mx.model.BatchEndParam`` namedtuple equivalent."""
+
+    __slots__ = ("epoch", "nbatch", "eval_metric", "locals")
+
+    def __init__(self, epoch: int, nbatch: int, eval_metric=None, local=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = local
+
+
+class Speedometer:
+    """Log samples/sec every ``frequent`` batches.
+
+    ``num_workers_fn`` makes it elastic-aware: reported throughput is
+    per-worker rate x live worker count (reference ``train_resnet.py``
+    Speedometer subclass)."""
+
+    def __init__(self, batch_size: int, frequent: int = 50,
+                 auto_reset: bool = True,
+                 num_workers_fn: Optional[Callable[[], int]] = None):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.num_workers_fn = num_workers_fn
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param: BatchEndParam):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                if self.num_workers_fn is not None:
+                    speed *= self.num_workers_fn()
+                if param.eval_metric is not None:
+                    nv = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "\t".join(f"{n}={v:.6f}" for n, v in nv)
+                    logger.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                                "\t%s", param.epoch, count, speed, msg)
+                else:
+                    logger.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                                param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix: str, period: int = 1, meta: Optional[dict] = None):
+    """Epoch-end callback saving the FULL TrainState every ``period`` epochs
+    (reference ``mx.callback.do_checkpoint`` — but including optimizer state,
+    closing the reference's dist-checkpoint gap)."""
+    period = max(period, 1)
+
+    def _callback(epoch: int, state, metrics=None):
+        if (epoch + 1) % period == 0:
+            path = ckpt_lib.save_checkpoint(prefix, epoch, state, meta)
+            logger.info("Saved checkpoint to \"%s\"", path)
+    return _callback
+
+
+def log_validation_metrics(epoch: int, metric) -> None:
+    """Reference ``LogValidationMetricsCallback``."""
+    for name, value in metric.get_name_value():
+        logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)

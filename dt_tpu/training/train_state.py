@@ -1,0 +1,47 @@
+"""TrainState: the complete training state as one pytree.
+
+Replaces the reference's scattered state (executor arg_params on workers +
+optimizer state on parameter servers + aux params under server keys >= 10M).
+Having it in ONE pytree is what makes elastic resharding and full
+checkpointing (closing the reference's lost-server-state gap, SURVEY.md §5.4)
+trivial: snapshot/restore is a tree (de)serialization.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray            # global update counter
+    params: Any                  # model parameters
+    batch_stats: Any             # BN running stats — the reference's "aux
+    #                              params" (server keys >= 10M, averaged not
+    #                              optimized, kvstore_dist_server.h:356-360)
+    opt_state: Any               # optimizer state (lived on PS in reference;
+    #                              lost on checkpoint there — kept here)
+    apply_fn: Any = flax.struct.field(pytree_node=False, default=None)
+    tx: Any = flax.struct.field(pytree_node=False, default=None)
+
+    @classmethod
+    def create(cls, apply_fn, params, tx: optax.GradientTransformation,
+               batch_stats: Any = None):
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   batch_stats=batch_stats if batch_stats is not None else {},
+                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params,
+                            opt_state=new_opt)
+
+
+def param_count(state: TrainState) -> int:
+    return sum(int(jnp.size(p)) for p in jax.tree_util.tree_leaves(state.params))
