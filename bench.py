@@ -1,0 +1,77 @@
+"""Benchmark: ResNet-152 ImageNet training throughput on one TPU chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published single-GPU number for the same model and
+batch size — ResNet-152, batch 32, 20.08 img/s (BASELINE.md row 1,
+reference ``example/image-classification/README.md:300-320``).
+``vs_baseline`` = our imgs/sec / 20.08.
+
+Full training step (fwd + bwd + SGD-momentum update + BN stats), bf16
+compute, synthetic input (the reference's ``--benchmark 1`` mode) so input
+IO can't mask compute throughput.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from dt_tpu import models, optim
+    from dt_tpu.ops import losses
+    from dt_tpu.training.train_state import TrainState
+
+    batch = 32
+    model = models.create("resnet152", num_classes=1000, dtype=jnp.bfloat16)
+    x = jnp.asarray(np.random.RandomState(0)
+                    .uniform(-1, 1, (batch, 224, 224, 3)), jnp.bfloat16)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
+
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x,
+                           training=False)
+    tx = optim.create("sgd", learning_rate=0.1, momentum=0.9,
+                      weight_decay=1e-4)
+    state = TrainState.create(model.apply, variables["params"], tx,
+                              variables["batch_stats"])
+
+    def train_step(state, x, y):
+        def loss_of(params):
+            out, mutated = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                x, training=True, mutable=["batch_stats"])
+            return losses.softmax_cross_entropy(out, y), \
+                mutated["batch_stats"]
+        (loss, stats), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            state.params)
+        return state.apply_gradients(grads).replace(batch_stats=stats), loss
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+
+    # warmup / compile
+    state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    baseline = 20.08  # reference ResNet-152 1-GPU img/s, batch 32
+    print(json.dumps({
+        "metric": "resnet152_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -331,11 +331,15 @@ class ElasticDataIterator:
     def per_worker_batch(self, num_workers: int) -> int:
         if self.fixed_per_worker_batch:
             return self.global_batch_size
-        if self.global_batch_size % num_workers != 0:
+        # Floor division like the reference (train_resnet.py:315-317
+        # ``batch_size // kv.num_workers``): an indivisible global batch
+        # shrinks slightly rather than erroring.
+        per = self.global_batch_size // num_workers
+        if per == 0:
             raise ValueError(
-                f"global batch {self.global_batch_size} not divisible by "
-                f"{num_workers} workers")
-        return self.global_batch_size // num_workers
+                f"global batch {self.global_batch_size} < {num_workers} "
+                f"workers")
+        return per
 
     def get_data_iterator(self, kv) -> tuple:
         """``kv`` exposes ``num_workers`` and ``rank`` (KVStore facade)."""

@@ -1,0 +1,27 @@
+"""Elastic-training control plane.
+
+Reference: the DT fork's ps-lite extensions (SURVEY.md §3.3/§5.3) —
+``ETNodeManager`` on the scheduler (``ps-lite/src/elastic_training.cc``),
+``MEMBERSHIP_CHANGE_BARRIER``/``UPDATE_ENV_VAR`` control commands
+(``ps-lite/include/ps/internal/message.h:123``), heartbeat/dead-node
+tracking (``van.cc:686-698``, ``postoffice.cc:410-429``), and the
+``host_worker``/``host_worker_log`` file contract (README.md:28-70).
+
+TPU-native shape: ONE small scheduler service (``Scheduler``) replaces the
+ps-lite scheduler role; workers attach a ``WorkerClient`` to their KVStore.
+The parameter-server copy that joiners bootstrapped from becomes an explicit
+host-RAM snapshot held by the scheduler (published by rank 0 at each epoch
+end).  Semantics kept verbatim:
+
+- membership changes ONLY at the epoch-boundary barrier
+- removal takes priority over addition (one kind of change per epoch,
+  ``elastic_training.cc:91-126``)
+- base (launch-time) workers can never be removed (README.md:54-61)
+- rank = position in the ordered live worker list (ranks shift on removal,
+  ``van.cc:519-539``)
+- audit log lines ``SEQ ADDED|REMOVED IP TIME`` (``elastic_training.cc:
+  108-126``)
+"""
+
+from dt_tpu.elastic.scheduler import Scheduler as Scheduler
+from dt_tpu.elastic.client import WorkerClient as WorkerClient

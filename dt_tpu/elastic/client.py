@@ -1,0 +1,113 @@
+"""Worker-side elastic client — attaches to a KVStore via
+``kv.set_controller(...)``.
+
+Plays the role of the worker's Postoffice/Van connection to the scheduler
+(``ps-lite/src/postoffice.cc``): registration, background heartbeats,
+membership-change barrier, snapshot publish/fetch, and (for CPU-process
+clusters) the exact-average allreduce data plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dt_tpu.elastic import protocol
+
+logger = logging.getLogger("dt_tpu.elastic")
+
+
+class WorkerRemoved(Exception):
+    """Raised at the barrier when the scheduler removed this host.  The
+    reference terminated removed EC2 instances (``launch.py:196-199``); here
+    the fit loop catches this and exits cleanly."""
+
+
+class WorkerClient:
+    def __init__(self, scheduler_host: str, scheduler_port: int,
+                 host: Optional[str] = None, is_new: Optional[bool] = None,
+                 heartbeat_interval_s: float = 1.0):
+        self.addr = (scheduler_host, scheduler_port)
+        self.host = host or f"{socket.gethostname()}:{os.getpid()}"
+        if is_new is None:
+            is_new = os.environ.get("NEW_WORKER", "") in ("1", "true")
+        resp = self._req({"cmd": "register", "host": self.host,
+                          "is_new": is_new})
+        self.rank: int = resp["rank"]
+        self.workers: List[str] = resp["workers"]
+        self._stop = threading.Event()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(heartbeat_interval_s,),
+            daemon=True)
+        self._hb_thread.start()
+
+    @property
+    def num_workers(self) -> int:
+        return len(self.workers)
+
+    def _req(self, msg: dict, timeout: float = 600.0) -> dict:
+        resp = protocol.request(self.addr[0], self.addr[1], msg,
+                                timeout=timeout)
+        if "error" in resp:
+            raise RuntimeError(f"scheduler error: {resp['error']}")
+        return resp
+
+    def _heartbeat_loop(self, interval: float):
+        while not self._stop.is_set():
+            try:
+                self._req({"cmd": "heartbeat", "host": self.host}, timeout=10)
+            except (OSError, RuntimeError):
+                pass  # scheduler gone; dead-node detection is its problem
+            self._stop.wait(interval)
+
+    # ------------------------------------------------------------------
+    # the KVStore-controller surface (consumed by dt_tpu.parallel.kvstore)
+    # ------------------------------------------------------------------
+
+    def membership_change_barrier(self, info: Dict) -> None:
+        epoch = int(info.get("EPOCH_BEGIN", 0))
+        resp = self._req({"cmd": "mc_barrier", "host": self.host,
+                          "epoch": epoch, "info": info})
+        if resp.get("you_are_removed"):
+            raise WorkerRemoved(self.host)
+        self.workers = resp["workers"]
+        self.rank = resp["rank"]
+
+    def barrier(self) -> None:
+        self._req({"cmd": "barrier", "host": self.host})
+
+    def publish_snapshot(self, blob) -> None:
+        self._req({"cmd": "publish_snapshot", "blob": blob})
+
+    def fetch_snapshot(self):
+        return self._req({"cmd": "fetch_snapshot"})["blob"]
+
+    def num_dead_nodes(self, timeout_s: float = 60.0) -> int:
+        return self._req({"cmd": "num_dead", "timeout_s": timeout_s})["count"]
+
+    def allreduce(self, key: str, value: np.ndarray) -> np.ndarray:
+        """Exact average across live workers (CPU-cluster data plane; on a
+        TPU pod gradients ride ICI inside the jit step instead)."""
+        return self._req({"cmd": "allreduce", "host": self.host, "key": key,
+                          "value": np.asarray(value)})["value"]
+
+    def close(self):
+        self._stop.set()
+
+
+def auto_client(**kwargs) -> Optional[WorkerClient]:
+    """Build a WorkerClient from the launcher's env contract
+    (``DMLC_PS_ROOT_URI/PORT``, ``DT_WORKER_ID``, ``NEW_WORKER``); returns
+    None when not launched under the elastic launcher."""
+    uri = os.environ.get("DMLC_PS_ROOT_URI")
+    port = os.environ.get("DMLC_PS_ROOT_PORT")
+    if not uri or not port:
+        return None
+    return WorkerClient(uri, int(port),
+                        host=os.environ.get("DT_WORKER_ID"), **kwargs)

@@ -1,0 +1,64 @@
+"""Wire protocol for the elastic control plane.
+
+Length-prefixed pickled dicts over TCP — the role ps-lite's protobuf
+``Meta`` + zero-copy SArrays played (``3rdparty/ps-lite``, meta.proto).
+Control-plane traffic is tiny (snapshots are the exception and stream as one
+message); a trusted-cluster assumption identical to the reference's.
+
+Message is a dict with at least ``{"cmd": str}``.  Commands mirror the
+fork's ``Control::Command`` additions (``message.h:123``):
+
+- ``register``       (worker -> sched): {host, is_new} -> {rank, workers}
+- ``heartbeat``      (worker -> sched): {host} -> {}
+- ``mc_barrier``     (worker -> sched): {host, info} -> {workers, removed,
+                     added} — released when ALL live workers arrived and any
+                     membership change was applied (ADD_NODE/BARRIER dance in
+                     ``van.cc:269-315``)
+- ``publish_snapshot`` (worker -> sched): {blob}
+- ``fetch_snapshot``  (worker -> sched): {} -> {blob}
+- ``num_dead``        : {timeout_s} -> {count}
+- ``shutdown``        : {} -> {}
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any, Dict
+
+_LEN = struct.Struct("<Q")
+MAX_MSG = 1 << 33  # snapshots can be GBs in theory; sanity bound
+
+
+def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Dict[str, Any]:
+    hdr = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(hdr)
+    if length > MAX_MSG:
+        raise IOError(f"message too large: {length}")
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def request(host: str, port: int, msg: Dict[str, Any],
+            timeout: float = 120.0) -> Dict[str, Any]:
+    """One-shot request/response (every control message is independent,
+    like ps-lite's per-request Customer tracking)."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        send_msg(s, msg)
+        return recv_msg(s)

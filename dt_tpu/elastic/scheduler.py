@@ -1,0 +1,353 @@
+"""The elastic scheduler service.
+
+Replaces the ps-lite scheduler role + the fork's ``ETDefaultNodeManager``
+(``ps-lite/src/elastic_training.cc``, ``van.cc:256-315``).  One instance per
+job (the launcher runs it on the root host).  Thread-per-connection TCP; all
+state under one lock — control traffic is a handful of messages per epoch.
+
+Responsibilities (SURVEY.md §3.3):
+
+- worker registry: ordered live set; rank = position (``van.cc:519-539``)
+- heartbeats + dead-node count (``van.cc:686-698``,
+  ``postoffice.cc:410-429``)
+- the epoch-boundary MEMBERSHIP_CHANGE_BARRIER: release only when every live
+  worker arrived; first diff ``host_worker`` against the live set and apply
+  ONE change (removals win over adds, ``elastic_training.cc:91-126``)
+- ``host_worker_log`` audit lines ``SEQ ADDED|REMOVED IP TIME``
+  (``elastic_training.cc:108-126``)
+- new-worker launch via callback (``launchCommandOnNewWorker``,
+  ``elastic_training.cc:26-62``)
+- the parameter snapshot joiners bootstrap from (the "server copy",
+  ``module.py:552-571``)
+- exact-average ``allreduce``/``broadcast`` for CPU-process clusters — the
+  data plane the reference's servers provided (``kvstore_dist_server.h:
+  710-739``); on a real pod this path is idle (gradients ride ICI inside the
+  jit step) but it gives multi-process tests the reference's exact-value
+  dist-sync semantics (``tests/nightly/dist_sync_kvstore.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+import numpy as np
+
+from dt_tpu.elastic import protocol
+
+logger = logging.getLogger("dt_tpu.elastic")
+
+
+class Scheduler:
+    def __init__(self, host_worker_file: Optional[str] = None,
+                 initial_workers: Optional[List[str]] = None,
+                 port: int = 0,
+                 launch_callback: Optional[Callable[[str, int], None]] = None,
+                 host_worker_log: Optional[str] = None,
+                 expected_workers: Optional[int] = None,
+                 pre_change_hook: Optional[Callable[[int], None]] = None):
+        """``initial_workers`` seeds the base set; else the first line-set of
+        ``host_worker_file`` does (``postoffice.cc:247-259`` baseline read).
+        ``launch_callback(host, epoch_begin)`` starts a worker process on
+        ``host`` (the reference shells out to ``launch.py --launch-worker``).
+        ``expected_workers``: registrations to wait for before barriers make
+        sense (DMLC_NUM_WORKER analog)."""
+        self.host_worker_file = host_worker_file
+        if initial_workers is None and host_worker_file and \
+                os.path.exists(host_worker_file):
+            initial_workers = _read_hosts(host_worker_file)
+        self._workers: List[str] = list(initial_workers or [])
+        self._base: Set[str] = set(self._workers)
+        self._registered: Set[str] = set()
+        self._heartbeats: Dict[str, float] = {}
+        self._removed_hosts: Set[str] = set()
+        self._log_path = host_worker_log or (
+            host_worker_file + "_log" if host_worker_file else None)
+        self._log_seq = 0
+        self._launch_callback = launch_callback
+        # Called with the epoch right before the host_worker diff — the
+        # in-process analog of the EC2 manager thread that rewrites the file
+        # (launch.py:88-235); used by operator automation and tests.
+        self._pre_change_hook = pre_change_hook
+        self.expected_workers = expected_workers or len(self._workers)
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # barrier state
+        self._barrier_epoch: Optional[int] = None
+        self._barrier_arrived: Set[str] = set()
+        self._barrier_result: Dict[int, dict] = {}
+        self._last_completed_epoch = -1
+        # plain barrier
+        self._plain_arrived: Set[str] = set()
+        self._plain_gen = 0
+        # snapshot
+        self._snapshot = None
+        self._snapshot_lock = threading.Lock()
+        # allreduce state: key -> {host: array}; generation counting
+        self._reduce: Dict[str, dict] = {}
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        logger.info("scheduler listening on :%d, base workers %s",
+                    self.port, self._workers)
+
+    # ------------------------------------------------------------------
+    # server plumbing
+    # ------------------------------------------------------------------
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _handle_conn(self, conn: socket.socket):
+        with conn:
+            try:
+                msg = protocol.recv_msg(conn)
+                resp = self._dispatch(msg)
+                protocol.send_msg(conn, resp)
+            except (ConnectionError, OSError):
+                pass
+            except Exception as e:  # surface handler bugs to the worker
+                logger.exception("scheduler handler error")
+                try:
+                    protocol.send_msg(conn, {"error": repr(e)})
+                except OSError:
+                    pass
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, msg: dict) -> dict:
+        cmd = msg.get("cmd")
+        if cmd == "register":
+            return self._register(msg["host"], bool(msg.get("is_new")))
+        if cmd == "heartbeat":
+            with self._lock:
+                self._heartbeats[msg["host"]] = time.time()
+            return {}
+        if cmd == "mc_barrier":
+            return self._mc_barrier(msg["host"], int(msg["epoch"]),
+                                    msg.get("info") or {})
+        if cmd == "barrier":
+            return self._plain_barrier(msg["host"])
+        if cmd == "publish_snapshot":
+            with self._snapshot_lock:
+                self._snapshot = msg["blob"]
+            return {}
+        if cmd == "fetch_snapshot":
+            with self._snapshot_lock:
+                return {"blob": self._snapshot}
+        if cmd == "num_dead":
+            return {"count": self._num_dead(float(msg.get("timeout_s", 60)))}
+        if cmd == "allreduce":
+            return self._allreduce(msg["host"], msg["key"], msg["value"])
+        if cmd == "membership":
+            with self._lock:
+                return {"workers": list(self._workers)}
+        if cmd == "shutdown":
+            self.close()
+            return {}
+        return {"error": f"unknown cmd {cmd!r}"}
+
+    # ------------------------------------------------------------------
+    # registration / heartbeat
+    # ------------------------------------------------------------------
+
+    def _register(self, host: str, is_new: bool) -> dict:
+        with self._cv:
+            if host in self._removed_hosts:
+                # sender-validation drop of removed hosts (van.cc:571-574)
+                return {"error": "host was removed from the job"}
+            if host not in self._workers:
+                if not is_new:
+                    self._base.add(host)  # launch-time workers are base
+                self._workers.append(host)
+            self._registered.add(host)
+            self._heartbeats[host] = time.time()
+            self._cv.notify_all()
+            return {"rank": self._workers.index(host),
+                    "workers": list(self._workers)}
+
+    def wait_for_workers(self, n: Optional[int] = None, timeout: float = 120):
+        """Block until n workers registered (rendezvous;
+        ``van.cc:95-185`` waits for all ADD_NODEs)."""
+        n = n if n is not None else self.expected_workers
+        deadline = time.time() + timeout
+        with self._cv:
+            while len(self._registered) < n:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"only {len(self._registered)}/{n} workers registered")
+                self._cv.wait(remaining)
+
+    def _num_dead(self, timeout_s: float) -> int:
+        now = time.time()
+        with self._lock:
+            return sum(1 for h in self._workers
+                       if now - self._heartbeats.get(h, now) > timeout_s)
+
+    # ------------------------------------------------------------------
+    # membership-change barrier (the heart — SURVEY.md §3.3)
+    # ------------------------------------------------------------------
+
+    def _mc_barrier(self, host: str, epoch: int, info: dict) -> dict:
+        with self._cv:
+            if epoch <= self._last_completed_epoch:
+                # late arrival (a worker added during this epoch's barrier):
+                # the change was already applied — return the result
+                res = self._barrier_result.get(epoch)
+                if res is not None:
+                    return self._result_for(host, res)
+                return {"workers": list(self._workers),
+                        "removed": [], "added": []}
+
+            if self._barrier_epoch is None:
+                self._barrier_epoch = epoch
+            self._barrier_arrived.add(host)
+
+            if self._barrier_arrived >= set(self._workers):
+                # everyone is here: apply at most one membership change
+                result = self._apply_membership_change(epoch)
+                self._barrier_result[epoch] = result
+                self._last_completed_epoch = epoch
+                self._barrier_epoch = None
+                self._barrier_arrived = set()
+                self._cv.notify_all()
+                return self._result_for(host, result)
+
+            while epoch > self._last_completed_epoch:
+                if not self._cv.wait(timeout=300):
+                    raise TimeoutError(f"mc_barrier epoch {epoch} stuck")
+            return self._result_for(host, self._barrier_result[epoch])
+
+    def _result_for(self, host: str, result: dict) -> dict:
+        out = dict(result)
+        out["you_are_removed"] = host in result["removed"]
+        out["rank"] = result["workers"].index(host) \
+            if host in result["workers"] else -1
+        return out
+
+    def _apply_membership_change(self, epoch: int) -> dict:
+        """Diff host_worker vs live set; removals beat adds
+        (``elastic_training.cc:91-157``).  Caller holds the lock."""
+        if self._pre_change_hook is not None:
+            try:
+                self._pre_change_hook(epoch)
+            except Exception:
+                logger.exception("pre_change_hook failed")
+        desired = set(self._workers)
+        if self.host_worker_file and os.path.exists(self.host_worker_file):
+            desired = set(_read_hosts(self.host_worker_file))
+
+        current = set(self._workers)
+        removable = (current - desired) - self._base  # base protected
+        blocked = (current - desired) & self._base
+        if blocked:
+            logger.warning("refusing to remove base workers %s "
+                           "(README.md:54-61)", sorted(blocked))
+        removed: List[str] = []
+        added: List[str] = []
+        if removable:
+            removed = sorted(removable)
+            self._workers = [w for w in self._workers if w not in removable]
+            self._removed_hosts |= removable
+            self._registered -= removable
+            for h in removed:
+                self._append_log("REMOVED", h)
+        else:
+            to_add = sorted(desired - current)
+            for h in to_add:
+                if h in self._removed_hosts:
+                    self._removed_hosts.discard(h)  # re-adding is allowed
+                self._workers.append(h)
+                added.append(h)
+                self._append_log("ADDED", h)
+                if self._launch_callback is not None:
+                    # launch with EPOCH_BEGIN = this epoch (the barrier runs
+                    # BEFORE epoch's batches; elastic_training.cc:26-62)
+                    threading.Thread(target=self._launch_callback,
+                                     args=(h, epoch), daemon=True).start()
+        if removed or added:
+            logger.info("Epoch[%d] membership change: removed=%s added=%s "
+                        "-> %s", epoch, removed, added, self._workers)
+        return {"workers": list(self._workers), "removed": removed,
+                "added": added, "epoch": epoch}
+
+    def _append_log(self, action: str, host: str):
+        """``SEQ ADDED|REMOVED IP TIME`` (``elastic_training.cc:108-126``)."""
+        self._log_seq += 1
+        if self._log_path:
+            with open(self._log_path, "a") as f:
+                f.write(f"{self._log_seq} {action} {host} "
+                        f"{time.strftime('%Y-%m-%d_%H:%M:%S')}\n")
+
+    # ------------------------------------------------------------------
+    # plain barrier + exact-average allreduce (CPU-cluster data plane)
+    # ------------------------------------------------------------------
+
+    def _plain_barrier(self, host: str) -> dict:
+        with self._cv:
+            gen = self._plain_gen
+            self._plain_arrived.add(host)
+            if self._plain_arrived >= set(self._workers):
+                self._plain_arrived = set()
+                self._plain_gen += 1
+                self._cv.notify_all()
+                return {}
+            while self._plain_gen == gen:
+                if not self._cv.wait(timeout=300):
+                    raise TimeoutError("barrier stuck")
+            return {}
+
+    def _allreduce(self, host: str, key: str, value) -> dict:
+        """Average ``value`` across all live workers (one round per key-use,
+        mirroring server-side merged/NumWorkers(),
+        ``kvstore_dist_server.h:345-379``)."""
+        arr = np.asarray(value)
+        with self._cv:
+            slot = self._reduce.setdefault(key, {"vals": {}, "gen": 0,
+                                                 "result": None})
+            gen = slot["gen"]
+            slot["vals"][host] = arr
+            if set(slot["vals"]) >= set(self._workers):
+                stacked = [slot["vals"][h] for h in self._workers]
+                slot["result"] = np.mean(stacked, axis=0)
+                slot["vals"] = {}
+                slot["gen"] += 1
+                self._cv.notify_all()
+                return {"value": slot["result"]}
+            while slot["gen"] == gen:
+                if not self._cv.wait(timeout=300):
+                    raise TimeoutError(f"allreduce {key} stuck")
+            return {"value": slot["result"]}
+
+
+def _read_hosts(path: str) -> List[str]:
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip() and
+                not ln.strip().startswith("#")]

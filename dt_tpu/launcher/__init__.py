@@ -1,0 +1,3 @@
+"""Job launcher.  Reference: ``tools/launch.py`` (SURVEY.md §2.3)."""
+
+from dt_tpu.launcher.launch import main as main, launch_local as launch_local

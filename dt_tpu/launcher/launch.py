@@ -1,0 +1,123 @@
+"""Job launcher with the reference CLI surface.
+
+Reference: ``tools/launch.py`` — ``launch.py -n N -H hostfile
+--elastic-training-enabled True python train.py ...``; its dmlc-tracker
+"local" launcher forks all roles on one machine (that is how the reference
+runs every distributed test, ``ci/docker/runtime_functions.sh:907-915``).
+
+Here: ``local`` launcher runs the elastic Scheduler in-process and forks N
+worker processes with the env contract the fit loop reads
+(``ELASTIC_TRAINING_ENABLED``, ``DMLC_PS_ROOT_URI/PORT``, ``DT_WORKER_ID``,
+and for joiners ``NEW_WORKER``/``EPOCH_BEGIN`` — ``base_module.py:503-506``).
+The scheduler's launch callback re-invokes the SAME training command for
+workers added via the host_worker file (``TRAINING_CMD``,
+``elastic_training.cc:26-62``).  ``ssh`` launching of remote hosts is the
+same protocol with the Popen swapped for ssh; multi-host TPU pods use their
+own orchestration (GKE/xmanager) and only need the env contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+logger = logging.getLogger("dt_tpu.launcher")
+
+
+def _worker_env(base: dict, scheduler_port: int, worker_id: str,
+                hostfile: Optional[str], elastic: bool,
+                extra: Optional[dict] = None) -> dict:
+    env = dict(base)
+    env["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+    env["DMLC_PS_ROOT_PORT"] = str(scheduler_port)
+    env["DT_WORKER_ID"] = worker_id
+    env["DMLC_ROLE"] = "worker"
+    if hostfile:
+        env["WORKER_HOST_FILE"] = hostfile
+    if elastic:
+        env["ELASTIC_TRAINING_ENABLED"] = "1"
+    env.update(extra or {})
+    return env
+
+
+def launch_local(num_workers: int, command: List[str],
+                 hostfile: Optional[str] = None, elastic: bool = False,
+                 scheduler_port: int = 0):
+    """Fork scheduler + N local workers; returns worker exit codes."""
+    from dt_tpu.elastic import Scheduler
+
+    hosts = [f"worker-{i}" for i in range(num_workers)]
+    if hostfile and os.path.exists(hostfile):
+        from dt_tpu.elastic.scheduler import _read_hosts
+        listed = _read_hosts(hostfile)
+        if listed:
+            hosts = listed[:num_workers] + hosts[len(listed):]
+
+    procs = {}
+
+    def launch_new(host: str, epoch: int):
+        logger.info("launching elastic worker %s (EPOCH_BEGIN=%d)", host, epoch)
+        procs[host] = subprocess.Popen(
+            command, env=_worker_env(
+                os.environ, sched.port, host, hostfile, elastic,
+                {"NEW_WORKER": "1", "EPOCH_BEGIN": str(epoch),
+                 "TRAINING_CMD": " ".join(command)}))
+
+    sched = Scheduler(host_worker_file=hostfile, initial_workers=hosts,
+                      launch_callback=launch_new if elastic else None)
+    logger.info("scheduler on :%d; starting %d workers", sched.port,
+                num_workers)
+    try:
+        for h in hosts:
+            procs[h] = subprocess.Popen(
+                command, env=_worker_env(os.environ, sched.port, h, hostfile,
+                                         elastic,
+                                         {"TRAINING_CMD": " ".join(command)}))
+        rcs = {}
+        for h in hosts:
+            rcs[h] = procs[h].wait()
+        # elastic joiners may still be running; wait for them too
+        for h, p in procs.items():
+            if h not in rcs:
+                rcs[h] = p.wait()
+        return rcs
+    finally:
+        sched.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dt_tpu job launcher (reference tools/launch.py surface)")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="host_worker file (elastic membership source)")
+    ap.add_argument("--launcher", choices=["local"], default="local")
+    ap.add_argument("--elastic-training-enabled", default="False",
+                    help="True enables the epoch-boundary membership protocol")
+    ap.add_argument("--scheduler-port", type=int, default=0)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]  # REMAINDER keeps the separator
+    if not args.command:
+        ap.error("no training command given")
+    elastic = str(args.elastic_training_enabled).lower() in ("1", "true")
+    logging.basicConfig(level=logging.INFO)
+    rcs = launch_local(args.num_workers, args.command, args.hostfile,
+                       elastic, args.scheduler_port)
+    bad = {h: rc for h, rc in rcs.items() if rc != 0}
+    if bad:
+        logger.error("workers failed: %s", bad)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

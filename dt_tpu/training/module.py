@@ -33,6 +33,7 @@ import time
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -87,6 +88,17 @@ class Module:
         self.state: Optional[TrainState] = None
         self._train_step = None
         self._eval_step = None
+        # Gradient sync across worker PROCESSES.  "mesh" = gradients ride the
+        # XLA allreduce inside the jit step (TPU pod / single process — the
+        # normal path).  "host" = two-phase step with an exact-average
+        # allreduce through the elastic scheduler, which is this framework's
+        # equivalent of the reference's push/merge/pull PS round trip
+        # (kvstore_dist.h:326-449) — used by CPU-process clusters and the
+        # dist-sync tests.
+        self.sync_mode = "mesh"
+        self._grad_step = None
+        self._apply_step = None
+        self._unravel = None
 
     # ------------------------------------------------------------------
     # Binding / init
@@ -133,29 +145,27 @@ class Module:
         mesh = self.mesh
         replicated = mesh_lib.replicate_sharding(mesh)
 
+        def forward_loss(params, batch_stats, data, labels, dropout_rng):
+            """Shared by the mesh train step and the host-sync grad step."""
+            variables = {"params": params}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+                out, mutated = model.apply(
+                    variables, data, training=True,
+                    rngs={"dropout": dropout_rng}, mutable=["batch_stats"])
+                new_stats = mutated["batch_stats"]
+            else:
+                out = model.apply(variables, data, training=True,
+                                  rngs={"dropout": dropout_rng})
+                new_stats = batch_stats
+            logits = out[0] if isinstance(out, tuple) else out
+            return loss_fn(logits, labels), (logits, new_stats)
+
         def train_step(state: TrainState, data, labels, rng):
             dropout_rng = jax.random.fold_in(rng, state.step)
-
-            def loss_of(params):
-                variables = {"params": params}
-                has_bn = bool(state.batch_stats)
-                if has_bn:
-                    variables["batch_stats"] = state.batch_stats
-                    out, mutated = model.apply(
-                        variables, data, training=True,
-                        rngs={"dropout": dropout_rng},
-                        mutable=["batch_stats"])
-                    new_stats = mutated["batch_stats"]
-                else:
-                    out = model.apply(variables, data, training=True,
-                                      rngs={"dropout": dropout_rng})
-                    new_stats = state.batch_stats
-                logits = out[0] if isinstance(out, tuple) else out
-                loss = loss_fn(logits, labels)
-                return loss, (logits, new_stats)
-
             (loss, (logits, new_stats)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(state.params)
+                forward_loss, has_aux=True)(state.params, state.batch_stats,
+                                            data, labels, dropout_rng)
             new_state = state.apply_gradients(grads)
             new_state = new_state.replace(batch_stats=new_stats)
             return new_state, loss, logits
@@ -177,6 +187,27 @@ class Module:
                                    out_shardings=(replicated, replicated,
                                                   mesh_lib.data_sharding(mesh)))
         self._eval_step = jax.jit(eval_step)
+
+        # host-sync two-phase variant: grads AND new BN stats ride the same
+        # flattened allreduce, so running stats stay bit-identical across
+        # workers (the mesh path gets global-batch stats from XLA; averaging
+        # per-step local stats is the host-path equivalent and subsumes the
+        # reference's epoch-end >= 10M-key averaging).
+        def grad_step(state, data, labels, rng):
+            dropout_rng = jax.random.fold_in(rng, state.step)
+            (loss, (logits, new_stats)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(state.params, state.batch_stats,
+                                            data, labels, dropout_rng)
+            flat, _ = jax.flatten_util.ravel_pytree((grads, new_stats))
+            return flat, loss, logits
+
+        def apply_step(state, flat):
+            grads, new_stats = self._unravel(flat)
+            return state.apply_gradients(grads).replace(
+                batch_stats=new_stats)
+
+        self._grad_step = jax.jit(grad_step)
+        self._apply_step = jax.jit(apply_step)
 
     def _place(self, arr):
         if self.mesh.size > 1:
@@ -230,7 +261,15 @@ class Module:
         for epoch in range(begin_epoch, num_epoch):
             # --- membership-change barrier (base_module.py:540-543) ---
             if elastic_enabled or self.kv._controller is not None:
-                self.kv._membership_change_barrier({"EPOCH_BEGIN": epoch})
+                from dt_tpu.elastic.client import WorkerRemoved
+                try:
+                    self.kv._membership_change_barrier({"EPOCH_BEGIN": epoch})
+                except WorkerRemoved:
+                    # the reference terminates removed instances
+                    # (launch.py:196-199); exit the fit loop cleanly
+                    logger.info("Epoch[%d] this worker was removed from the "
+                                "job; stopping", epoch)
+                    return eval_metric
                 if self.kv.num_workers != num_workers:
                     logger.info(
                         "Epoch[%d] membership changed: %d -> %d workers",
@@ -253,8 +292,22 @@ class Module:
                     break
                 data = self._place(batch.data)
                 labels = self._place(batch.label)
-                self.state, loss, logits = self._train_step(
-                    self.state, data, labels, rng)
+                if self.sync_mode == "host" and self.kv.num_workers > 1:
+                    if self.kv._controller is None:
+                        raise RuntimeError(
+                            "sync_mode='host' needs an elastic controller "
+                            "(kv.set_controller) to carry the allreduce")
+                    if self._unravel is None:
+                        _, self._unravel = jax.flatten_util.ravel_pytree(
+                            (self.state.params, self.state.batch_stats))
+                    flat, loss, logits = self._grad_step(
+                        self.state, data, labels, rng)
+                    avg = self.kv._controller.allreduce(
+                        "grads", np.asarray(jax.device_get(flat)))
+                    self.state = self._apply_step(self.state, jnp.asarray(avg))
+                else:
+                    self.state, loss, logits = self._train_step(
+                        self.state, data, labels, rng)
                 # metric update excludes pad examples (reference
                 # DataBatch.pad semantics)
                 n_real = batch.data.shape[0] - batch.pad
@@ -295,11 +348,14 @@ class Module:
         BN aux stats ride along (the >= 10M key space)."""
         ctrl = self.kv._controller
         if ctrl is not None and hasattr(ctrl, "publish_snapshot"):
+            import flax.serialization
             host = jax.device_get(
                 {"step": self.state.step, "params": self.state.params,
                  "batch_stats": self.state.batch_stats,
                  "opt_state": self.state.opt_state})
-            ctrl.publish_snapshot(host)
+            # ship as a plain state dict so joiners restore it regardless of
+            # optimizer-state class identity across processes
+            ctrl.publish_snapshot(flax.serialization.to_state_dict(host))
 
     # ------------------------------------------------------------------
     # score / predict

@@ -1,0 +1,114 @@
+"""Worker process for the elastic integration test.
+
+Each instance is one "worker host" (the reference's per-host worker process,
+driven by ``tools/launch.py``).  Trains an MLP on a deterministic shared
+dataset with exact host-allreduce gradient sync, the elastic fit contract,
+and snapshot bootstrap for joiners.  Writes a JSON result file the test
+asserts on.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.flatten_util  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dt_tpu import data, models  # noqa: E402
+from dt_tpu.elastic import WorkerClient  # noqa: E402
+from dt_tpu.parallel import kvstore as kvstore_lib  # noqa: E402
+from dt_tpu.training import Module  # noqa: E402
+
+
+def make_dataset(n=128, seed=1234):
+    rng = np.random.RandomState(seed)  # same on every worker
+    x = rng.normal(0, 1, (n, 8, 8, 3)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    return x, y
+
+
+class TinyBNNet:
+    """Conv+BN+dense — exercises batch-stats sync across workers."""
+
+    @staticmethod
+    def create():
+        import flax.linen as linen
+        import jax.numpy as jnp
+        from dt_tpu.models.common import bn
+
+        class Net(linen.Module):
+            @linen.compact
+            def __call__(self, x, training=True):
+                x = linen.Conv(8, (3, 3), padding="SAME", use_bias=False)(x)
+                x = bn(training)(x)
+                x = jax.nn.relu(x)
+                x = jnp.mean(x, axis=(1, 2))
+                return linen.Dense(2)(x)
+        return Net()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scheduler-port", type=int, required=True)
+    ap.add_argument("--host", required=True)
+    ap.add_argument("--num-epoch", type=int, default=6)
+    ap.add_argument("--global-batch", type=int, default=32)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    x, y = make_dataset()
+    ctrl = WorkerClient("127.0.0.1", args.scheduler_port, host=args.host)
+    kv = kvstore_lib.create("tpu_sync")
+    kv.set_controller(ctrl)
+
+    def factory(num_parts, part_index, batch_size):
+        it = data.NDArrayIter(x, y, batch_size=batch_size, shuffle=True,
+                              num_parts=num_parts, part_index=part_index,
+                              seed=99)
+        # equal batches per worker (fit.py:38-43 ResizeIter semantics)
+        return data.ResizeIter(it, size=len(x) // args.global_batch), None
+
+    eit = data.ElasticDataIterator(factory, args.global_batch)
+    train, _ = eit.get_data_iterator(kv)
+
+    mod = Module(TinyBNNet.create(),
+                 optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                 kvstore=kv, seed=7)
+    mod.sync_mode = "host"
+
+    bootstrap_step = None
+    if os.environ.get("NEW_WORKER") == "1":
+        first = x[:args.global_batch // kv.num_workers]
+        mod.init_params(first, initialize_from_kvstore=True)
+        bootstrap_step = int(mod.state.step)
+
+    mod.fit(train, num_epoch=args.num_epoch,
+            elastic_data_iterator=eit)
+
+    flat, _ = jax.flatten_util.ravel_pytree(
+        (mod.state.params, mod.state.batch_stats))  # BN stats must sync too
+    result = {
+        "host": args.host,
+        "final_step": int(mod.state.step),
+        "param_sum": float(np.asarray(flat).sum()),
+        "param_hash": float(np.abs(np.asarray(flat)).sum()),
+        "num_workers_at_end": kv.num_workers,
+        "bootstrap_step": bootstrap_step,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f)
+    ctrl.close()
+
+
+if __name__ == "__main__":
+    main()

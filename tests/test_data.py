@@ -136,13 +136,18 @@ def test_elastic_iterator_contract():
     assert calls[-1] == (4, 1, 32)
 
 
-def test_elastic_iterator_indivisible_raises():
-    eit = data.ElasticDataIterator(lambda *a: (None, None), 10)
+def test_elastic_iterator_indivisible_floors():
+    """Reference floor-divides (train_resnet.py:315-317); zero batch raises."""
+    eit = data.ElasticDataIterator(lambda *a: a, 10)
 
     class KV:
         num_workers, rank = 3, 0
-    with pytest.raises(ValueError, match="not divisible"):
-        eit.get_data_iterator(KV)
+    assert eit.get_data_iterator(KV)[2] == 3
+
+    class KVBig:
+        num_workers, rank = 11, 0
+    with pytest.raises(ValueError, match="<"):
+        eit.get_data_iterator(KVBig)
 
 
 # ---------------------------------------------------------------------------

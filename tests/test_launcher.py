@@ -1,0 +1,32 @@
+"""Launcher test: `launch_local` forks N workers wired to a scheduler via the
+env contract (reference local-tracker behavior,
+``ci/docker/runtime_functions.sh:907-915``)."""
+
+import os
+import sys
+import textwrap
+
+from dt_tpu.launcher import launch_local
+
+
+def test_launch_local_runs_workers(tmp_path):
+    script = tmp_path / "trainee.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        os.environ.pop("XLA_FLAGS", None)
+        from dt_tpu.elastic.client import auto_client
+        c = auto_client()
+        assert c is not None, "env contract missing"
+        assert os.environ["ELASTIC_TRAINING_ENABLED"] == "1"
+        c.barrier()
+        out = os.path.join(%r, os.environ["DT_WORKER_ID"] + ".ok")
+        open(out, "w").write(f"{c.rank}/{c.num_workers}")
+        c.close()
+    """ % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           str(tmp_path))))
+    rcs = launch_local(2, [sys.executable, str(script)], elastic=True)
+    assert all(rc == 0 for rc in rcs.values()), rcs
+    got = sorted(open(str(tmp_path / f"worker-{i}.ok")).read()
+                 for i in range(2))
+    assert got == ["0/2", "1/2"]
