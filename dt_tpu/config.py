@@ -54,6 +54,18 @@ def env_str(name: str, default: str = "") -> str:
     return os.environ.get(name, default)
 
 
+def maybe_force_cpu() -> bool:
+    """Honor ``DT_FORCE_CPU=1``: flip jax to the CPU backend before any
+    backend init.  Used by tests/CI where the TPU is absent — env var alone
+    is not enough when a sitecustomize pre-registers an accelerator
+    backend."""
+    if os.environ.get("DT_FORCE_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False
+
+
 # ---------------------------------------------------------------------------
 # Typed configs
 # ---------------------------------------------------------------------------

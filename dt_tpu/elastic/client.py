@@ -91,11 +91,15 @@ class WorkerClient:
     def num_dead_nodes(self, timeout_s: float = 60.0) -> int:
         return self._req({"cmd": "num_dead", "timeout_s": timeout_s})["count"]
 
-    def allreduce(self, key: str, value: np.ndarray) -> np.ndarray:
+    def allreduce(self, key: str, value) -> np.ndarray:
         """Exact average across live workers (CPU-cluster data plane; on a
-        TPU pod gradients ride ICI inside the jit step instead)."""
+        TPU pod gradients ride ICI inside the jit step instead).  ``value``
+        is an array, or a ``{"packed", "n", "threshold"}`` dict for
+        2-bit-compressed gradients (scheduler dequantizes before merging)."""
+        if not isinstance(value, dict):
+            value = np.asarray(value)
         return self._req({"cmd": "allreduce", "host": self.host, "key": key,
-                          "value": np.asarray(value)})["value"]
+                          "value": value})["value"]
 
     def close(self):
         self._stop.set()

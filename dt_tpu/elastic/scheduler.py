@@ -63,7 +63,10 @@ class Scheduler:
         self._workers: List[str] = list(initial_workers or [])
         self._base: Set[str] = set(self._workers)
         self._registered: Set[str] = set()
-        self._heartbeats: Dict[str, float] = {}
+        # Seed heartbeats at startup so a worker that never comes up ages
+        # out and is counted dead, instead of defaulting to "alive forever".
+        now = time.time()
+        self._heartbeats: Dict[str, float] = {h: now for h in self._workers}
         self._removed_hosts: Set[str] = set()
         self._log_path = host_worker_log or (
             host_worker_file + "_log" if host_worker_file else None)
@@ -209,7 +212,7 @@ class Scheduler:
         now = time.time()
         with self._lock:
             return sum(1 for h in self._workers
-                       if now - self._heartbeats.get(h, now) > timeout_s)
+                       if now - self._heartbeats.get(h, 0.0) > timeout_s)
 
     # ------------------------------------------------------------------
     # membership-change barrier (the heart — SURVEY.md §3.3)
@@ -221,10 +224,10 @@ class Scheduler:
                 # late arrival (a worker added during this epoch's barrier):
                 # the change was already applied — return the result
                 res = self._barrier_result.get(epoch)
-                if res is not None:
-                    return self._result_for(host, res)
-                return {"workers": list(self._workers),
-                        "removed": [], "added": []}
+                if res is None:
+                    res = {"workers": list(self._workers), "removed": [],
+                           "added": [], "epoch": epoch}
+                return self._result_for(host, res)
 
             if self._barrier_epoch is None:
                 self._barrier_epoch = epoch
@@ -285,6 +288,7 @@ class Scheduler:
                 if h in self._removed_hosts:
                     self._removed_hosts.discard(h)  # re-adding is allowed
                 self._workers.append(h)
+                self._heartbeats[h] = time.time()  # grace until it registers
                 added.append(h)
                 self._append_log("ADDED", h)
                 if self._launch_callback is not None:
@@ -327,8 +331,17 @@ class Scheduler:
     def _allreduce(self, host: str, key: str, value) -> dict:
         """Average ``value`` across all live workers (one round per key-use,
         mirroring server-side merged/NumWorkers(),
-        ``kvstore_dist_server.h:345-379``)."""
-        arr = np.asarray(value)
+        ``kvstore_dist_server.h:345-379``).  A dict value
+        ``{"packed", "n", "threshold"}`` is a 2-bit-compressed gradient:
+        dequantize before merging, exactly like the server's
+        DataHandleCompressed (``kvstore_dist_server.h:606-673``)."""
+        if isinstance(value, dict) and "packed" in value:
+            from dt_tpu.parallel.compression import np_dequantize_2bit
+            arr = np_dequantize_2bit(np.asarray(value["packed"]),
+                                     int(value["n"]),
+                                     float(value["threshold"]))
+        else:
+            arr = np.asarray(value)
         with self._cv:
             slot = self._reduce.setdefault(key, {"vals": {}, "gen": 0,
                                                  "result": None})

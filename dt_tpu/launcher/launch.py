@@ -80,9 +80,13 @@ def launch_local(num_workers: int, command: List[str],
         rcs = {}
         for h in hosts:
             rcs[h] = procs[h].wait()
-        # elastic joiners may still be running; wait for them too
-        for h, p in procs.items():
-            if h not in rcs:
+        # elastic joiners may still be running — and the scheduler's launch
+        # thread may still be inserting; iterate over snapshots until stable
+        while True:
+            pending = [(h, p) for h, p in list(procs.items()) if h not in rcs]
+            if not pending:
+                break
+            for h, p in pending:
                 rcs[h] = p.wait()
         return rcs
     finally:

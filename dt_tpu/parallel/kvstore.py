@@ -40,6 +40,7 @@ class KVStore:
         self._mesh = mesh
         self._store: Dict[str, np.ndarray] = {}
         self._controller = None  # dt_tpu.elastic worker-side client
+        self._gradient_compression = None
         self._num_dead = 0
 
     # -- identity ----------------------------------------------------------
@@ -100,6 +101,26 @@ class KVStore:
         if self._controller is not None:
             return self._controller.num_dead_nodes(timeout_s)
         return 0
+
+    # -- gradient compression ---------------------------------------------
+    def set_gradient_compression(self, compression_params: Dict):
+        """Reference ``kv.set_gradient_compression({'type': '2bit',
+        'threshold': t})`` (``python/mxnet/kvstore.py``).  Applies to the
+        host-sync data plane (DCN-crossing gradients); the in-graph mesh
+        path doesn't need it (gradients ride ICI)."""
+        if "type" not in compression_params:
+            raise ValueError("compression_params must include 'type' "
+                             "(none|2bit)")
+        ctype = compression_params["type"]
+        if ctype == "none":
+            self._gradient_compression = None
+            return
+        if ctype != "2bit":
+            raise ValueError(f"unsupported compression type {ctype!r} "
+                             "(reference supports none|2bit)")
+        from dt_tpu.parallel.compression import GradientCompression
+        self._gradient_compression = GradientCompression(
+            float(compression_params.get("threshold", 0.5)))
 
     # -- optimizer hand-off (API parity) ----------------------------------
     def set_optimizer(self, optimizer):

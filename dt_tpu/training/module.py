@@ -99,6 +99,7 @@ class Module:
         self._grad_step = None
         self._apply_step = None
         self._unravel = None
+        self._unravel_stats = None
 
     # ------------------------------------------------------------------
     # Binding / init
@@ -198,11 +199,16 @@ class Module:
             (loss, (logits, new_stats)), grads = jax.value_and_grad(
                 forward_loss, has_aux=True)(state.params, state.batch_stats,
                                             data, labels, dropout_rng)
-            flat, _ = jax.flatten_util.ravel_pytree((grads, new_stats))
-            return flat, loss, logits
+            # grads and BN stats travel separately: grads may be 2-bit
+            # compressed on the wire, stats never are
+            flat_g, _ = jax.flatten_util.ravel_pytree(grads)
+            flat_s, _ = jax.flatten_util.ravel_pytree(new_stats)
+            return flat_g, flat_s, loss, logits
 
-        def apply_step(state, flat):
-            grads, new_stats = self._unravel(flat)
+        def apply_step(state, flat_g, flat_s):
+            grads = self._unravel(flat_g)
+            new_stats = self._unravel_stats(flat_s) if self._unravel_stats \
+                else state.batch_stats
             return state.apply_gradients(grads).replace(
                 batch_stats=new_stats)
 
@@ -299,12 +305,28 @@ class Module:
                             "(kv.set_controller) to carry the allreduce")
                     if self._unravel is None:
                         _, self._unravel = jax.flatten_util.ravel_pytree(
-                            (self.state.params, self.state.batch_stats))
-                    flat, loss, logits = self._grad_step(
+                            self.state.params)
+                        if self.state.batch_stats:
+                            _, self._unravel_stats = \
+                                jax.flatten_util.ravel_pytree(
+                                    self.state.batch_stats)
+                    flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
-                    avg = self.kv._controller.allreduce(
-                        "grads", np.asarray(jax.device_get(flat)))
-                    self.state = self._apply_step(self.state, jnp.asarray(avg))
+                    g_np = np.asarray(jax.device_get(flat_g))
+                    gc = self.kv._gradient_compression
+                    if gc is not None:
+                        payload = {"packed": gc.compress(g_np),
+                                   "n": g_np.size, "threshold": gc.threshold}
+                    else:
+                        payload = g_np
+                    avg_g = self.kv._controller.allreduce("grads", payload)
+                    if self._unravel_stats is not None:
+                        avg_s = self.kv._controller.allreduce(
+                            "stats", np.asarray(jax.device_get(flat_s)))
+                    else:
+                        avg_s = np.zeros((0,), np.float32)
+                    self.state = self._apply_step(
+                        self.state, jnp.asarray(avg_g), jnp.asarray(avg_s))
                 else:
                     self.state, loss, logits = self._train_step(
                         self.state, data, labels, rng)
@@ -347,7 +369,10 @@ class Module:
         parameter-server copy played for joiners (``module.py:552-571``);
         BN aux stats ride along (the >= 10M key space)."""
         ctrl = self.kv._controller
-        if ctrl is not None and hasattr(ctrl, "publish_snapshot"):
+        # rank 0 publishes (all workers hold identical state under sync;
+        # N identical uploads would only load the scheduler)
+        if ctrl is not None and hasattr(ctrl, "publish_snapshot") and \
+                self.kv.rank == 0:
             import flax.serialization
             host = jax.device_get(
                 {"step": self.state.step, "params": self.state.params,

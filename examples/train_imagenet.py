@@ -1,0 +1,35 @@
+"""ImageNet training / benchmark sweep.
+
+Reference: ``example/image-classification/train_imagenet.py`` +
+``benchmark_score.py`` (synthetic-input throughput).  Any zoo network:
+resnet50/152, vgg16_bn, inception-v3, alexnet, mobilenet, ...
+
+    python examples/train_imagenet.py --network resnet50 --benchmark 1 \
+        --batch-size 128 --dtype bfloat16 --num-epochs 1
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import common  # noqa: E402
+
+
+def main():
+    ap = common.base_parser("ImageNet")
+    args = ap.parse_args()
+    image_shape = common.setup(args)
+    if args.network.startswith("inception"):
+        image_shape = (299, 299, 3)
+
+    from dt_tpu import parallel
+    kv = parallel.create(args.kv_store)
+    train, val = common.make_data(args, image_shape, kv)
+    steps = train.steps_per_epoch or 1
+    mod = common.make_module(args, steps, kv)
+    common.fit(args, mod, train, val)
+
+
+if __name__ == "__main__":
+    main()

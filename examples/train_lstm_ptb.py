@@ -1,0 +1,113 @@
+"""Word-level LSTM language model (BASELINE config #5).
+
+Reference: ``example/rnn/word_lm/train.py`` (PTB).  Reads a tokenized text
+file via --data-train (whitespace tokens, one sentence per line) or
+generates synthetic token streams.  Perplexity metric, grad clipping,
+truncated BPTT with carried state.
+
+    python examples/train_lstm_ptb.py --data-train ptb.train.txt \
+        --num-epochs 40 --lr 20 --batch-size 32
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def batchify(tokens, batch_size):
+    import numpy as np
+    nb = len(tokens) // batch_size
+    return np.asarray(tokens[:nb * batch_size]) \
+        .reshape(batch_size, nb).T  # (T, B)
+
+
+def main():
+    ap = argparse.ArgumentParser("LSTM LM")
+    ap.add_argument("--data-train", default=None)
+    ap.add_argument("--vocab-size", type=int, default=10000)
+    ap.add_argument("--emsize", type=int, default=200)
+    ap.add_argument("--nhid", type=int, default=200)
+    ap.add_argument("--nlayers", type=int, default=2)
+    ap.add_argument("--bptt", type=int, default=35)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=1.0)
+    ap.add_argument("--clip", type=float, default=0.25)
+    ap.add_argument("--dropout", type=float, default=0.2)
+    ap.add_argument("--tied", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dt_tpu import models, optim
+    from dt_tpu.ops import losses, tensor
+    from dt_tpu.training import metrics
+    from dt_tpu.training.train_state import TrainState
+
+    if args.data_train and os.path.exists(args.data_train):
+        words = open(args.data_train).read().split()
+        vocab = {w: i for i, w in
+                 enumerate(sorted(set(words))[:args.vocab_size - 1])}
+        unk = len(vocab)
+        toks = [vocab.get(w, unk) for w in words]
+        vocab_size = unk + 1
+    else:
+        rng = np.random.RandomState(0)
+        vocab_size = args.vocab_size
+        toks = rng.randint(0, vocab_size, 200000).tolist()
+
+    stream = batchify(toks, args.batch_size)  # (T_total, B)
+    model = models.create("lstm_lm", vocab_size=vocab_size,
+                          embed_dim=args.emsize, hidden=args.nhid,
+                          num_layers=args.nlayers, dropout=args.dropout,
+                          tie_weights=args.tied)
+    tokens0 = jnp.zeros((args.bptt, args.batch_size), jnp.int32)
+    variables = model.init({"params": jax.random.PRNGKey(0),
+                            "dropout": jax.random.PRNGKey(1)}, tokens0,
+                           training=False)
+    tx = optim.create("sgd", learning_rate=args.lr)
+    state = TrainState.create(model.apply, variables["params"], tx)
+
+    def train_step(state, inp, tgt, h, c, rng):
+        def loss_of(params):
+            (logits, (hT, cT)) = model.apply(
+                {"params": params}, inp, state=(h, c), training=True,
+                rngs={"dropout": jax.random.fold_in(rng, state.step)})
+            loss = losses.softmax_cross_entropy(
+                logits.reshape(-1, vocab_size), tgt.reshape(-1))
+            return loss, (hT, cT)
+        (loss, (hT, cT)), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            state.params)
+        grads, _ = tensor.clip_global_norm(grads, args.clip)
+        return state.apply_gradients(grads), loss, hT, cT
+
+    step = jax.jit(train_step)
+    rng = jax.random.PRNGKey(2)
+    t_total = stream.shape[0]
+    for epoch in range(args.num_epochs):
+        h = jnp.zeros((args.nlayers, args.batch_size, args.nhid))
+        c = jnp.zeros((args.nlayers, args.batch_size, args.nhid))
+        ppl = metrics.Perplexity()
+        total_loss, nb = 0.0, 0
+        for i in range(0, t_total - 1 - args.bptt, args.bptt):
+            inp = jnp.asarray(stream[i:i + args.bptt])
+            tgt = jnp.asarray(stream[i + 1:i + 1 + args.bptt])
+            state, loss, h, c = step(state, inp, tgt, h, c, rng)
+            total_loss += float(loss)
+            nb += 1
+        logging.info("Epoch[%d] train ppl %.2f",
+                     epoch, float(np.exp(total_loss / max(nb, 1))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Example-script smoke tests (reference ``tests/python/train/`` analog):
+each example must run a tiny configuration end to end as a subprocess."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(REPO, "examples")
+
+
+def _run(script, *args, timeout=300):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"  # ignored (sitecustomize) but harmless
+    cmd = [sys.executable, os.path.join(EX, script), *args]
+    # force CPU inside the example via a wrapper -c? examples run jax on
+    # default backend; use the conftest trick through env:
+    env["DT_FORCE_CPU"] = "1"
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
+                       env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    return r
+
+
+def test_train_cifar10_smoke():
+    _run("train_cifar10.py", "--network", "resnet20", "--batch-size", "16",
+         "--num-epochs", "1", "--num-examples", "64", "--benchmark", "1",
+         "--disp-batches", "2")
+
+
+def test_train_imagenet_smoke():
+    _run("train_imagenet.py", "--network", "mobilenet", "--image-shape",
+         "32,32,3", "--num-classes", "5", "--batch-size", "8",
+         "--num-epochs", "1", "--num-examples", "16", "--benchmark", "1")
+
+
+def test_train_lstm_smoke():
+    _run("train_lstm_ptb.py", "--vocab-size", "50", "--emsize", "8",
+         "--nhid", "8", "--nlayers", "1", "--bptt", "5", "--batch-size", "4",
+         "--num-epochs", "1")
+
+
+def test_train_elastic_under_launcher(tmp_path):
+    hw = str(tmp_path / "host_worker")
+    with open(hw, "w") as f:
+        f.write("worker-0\nworker-1\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["DT_FORCE_CPU"] = "1"
+    r = subprocess.run(
+        [sys.executable, "-m", "dt_tpu.launcher.launch", "-n", "2",
+         "-H", hw, "--elastic-training-enabled", "True", "--",
+         sys.executable, os.path.join(EX, "train_elastic.py"),
+         "--network", "mlp", "--num-classes", "2", "--image-shape", "4,4,1",
+         "--batch-size", "16", "--num-epochs", "2", "--num-examples", "64"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
