@@ -60,9 +60,16 @@ class RecordIOWriter:
 
 
 class RecordIOReader:
-    """Sequential + indexed record reader."""
+    """Sequential + indexed record reader.
+
+    ``read_all`` uses the native C++ scanner when available
+    (``dt_tpu/native/recordio.cc`` — single-pass index + batched payload
+    read, the dmlc-core recordio_split.cc analog) and falls back to the
+    Python loop otherwise.
+    """
 
     def __init__(self, path: str, index_path: Optional[str] = None):
+        self._path = path
         self._f = open(path, "rb")
         self._size = os.path.getsize(path)
         self.index: Optional[dict] = None
@@ -92,6 +99,19 @@ class RecordIOReader:
         return data
 
     def read_all(self) -> List[bytes]:
+        try:
+            from dt_tpu import native
+            idx = native.native_index(self._path)
+            if idx is not None:
+                recs = native.native_read_batch(self._path, *idx)
+                if recs is not None:
+                    # keep cursor state identical to the Python path (EOF)
+                    self._f.seek(0, os.SEEK_END)
+                    return recs
+        except native.BadRecordFile:
+            raise  # genuinely corrupt file — same as Python path failing
+        except Exception:  # native layer optional; never block reads
+            pass
         self._f.seek(0)
         out = []
         while True:

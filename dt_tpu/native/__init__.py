@@ -1,0 +1,20 @@
+"""Native (C++) runtime components.
+
+The reference's runtime around the compute path is C++ (engine, storage,
+recordio data layer — SURVEY.md §2.1/§2.4).  On TPU, XLA replaces the
+engine/storage layers; the pieces that remain host-side hot paths are
+implemented here in C++ with ctypes bindings (no pybind11 in the image):
+
+- ``recordio.cc`` — RecordIO index scan + batched payload reads.
+
+``lib()`` compiles on first use (g++ -O2 -shared) and caches the .so next to
+the sources; every native entry point has a pure-Python fallback, so the
+framework works without a toolchain.
+"""
+
+from dt_tpu.native.binding import (
+    available as available,
+    BadRecordFile as BadRecordFile,
+    native_index as native_index,
+    native_read_batch as native_read_batch,
+)

@@ -1,0 +1,130 @@
+"""ctypes binding + on-demand build for the native components."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("dt_tpu.native")
+
+
+class BadRecordFile(IOError):
+    """A .rec file failed native parsing (bad framing / unreadable) — the
+    file's fault, not the native layer's; callers should NOT fall back."""
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libdtnative.so")
+_SRC = [os.path.join(_HERE, "recordio.cc")]
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library if sources are newer than the cached .so."""
+    try:
+        if os.path.exists(_SO) and all(
+                os.path.getmtime(_SO) >= os.path.getmtime(s) for s in _SRC):
+            return _SO
+        # unique temp output: concurrent processes may race to build; each
+        # writes its own file and os.replace is atomic
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               "-o", tmp] + _SRC
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+        return _SO
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        logger.warning("native build unavailable (%s); using Python paths", e)
+        return None
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        try:
+            L = ctypes.CDLL(so)
+        except OSError as e:  # stale/corrupt .so: disable, don't break reads
+            logger.warning("cannot load %s (%s); using Python paths", so, e)
+            _build_failed = True
+            return None
+        L.dtrec_index.restype = ctypes.c_longlong
+        L.dtrec_index.argtypes = [ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+                                  ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64))]
+        L.dtrec_free.argtypes = [ctypes.c_void_p]
+        L.dtrec_read_batch.restype = ctypes.c_int
+        L.dtrec_read_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_ubyte)]
+        _lib = L
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def native_index(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(offsets, lengths) arrays for every record in a .rec file, or None if
+    the native path is unavailable.  Raises IOError on bad files."""
+    L = lib()
+    if L is None:
+        return None
+    off_p = ctypes.POINTER(ctypes.c_uint64)()
+    len_p = ctypes.POINTER(ctypes.c_uint64)()
+    n = L.dtrec_index(path.encode(), ctypes.byref(off_p),
+                      ctypes.byref(len_p))
+    if n == -1:
+        raise BadRecordFile(f"cannot open {path}")
+    if n == -2:
+        raise BadRecordFile(f"bad RecordIO framing in {path}")
+    try:
+        offsets = np.ctypeslib.as_array(off_p, (n,)).copy() if n else \
+            np.zeros(0, np.uint64)
+        lengths = np.ctypeslib.as_array(len_p, (n,)).copy() if n else \
+            np.zeros(0, np.uint64)
+    finally:
+        # dtrec_free is free(): safe for the malloc(0) pointer too
+        L.dtrec_free(off_p)
+        L.dtrec_free(len_p)
+    return offsets, lengths
+
+
+def native_read_batch(path: str, offsets: np.ndarray,
+                      lengths: np.ndarray) -> Optional[List[bytes]]:
+    """Read the given records' payloads; None if native unavailable."""
+    L = lib()
+    if L is None:
+        return None
+    offsets = np.ascontiguousarray(offsets, np.uint64)
+    lengths = np.ascontiguousarray(lengths, np.uint64)
+    total = int(lengths.sum())
+    buf = np.empty(total, np.uint8)
+    rc = L.dtrec_read_batch(
+        path.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(offsets),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)))
+    if rc != 0:
+        raise BadRecordFile(f"native read failed rc={rc} for {path}")
+    out = []
+    cursor = 0
+    for ln in lengths:
+        out.append(buf[cursor:cursor + int(ln)].tobytes())
+        cursor += int(ln)
+    return out

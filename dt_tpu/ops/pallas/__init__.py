@@ -9,3 +9,11 @@ Planned contents (SURVEY.md §7 translation table):
 Kernels land incrementally; each has an interpreter-mode test against the
 jnp oracle in ``dt_tpu.ops``.
 """
+
+from dt_tpu.ops.pallas.kernels import (
+    fused_bn_inference as fused_bn_inference,
+    quantize_2bit as quantize_2bit,
+    dequantize_2bit as dequantize_2bit,
+    lstm_pointwise as lstm_pointwise,
+    lstm_cell_fused as lstm_cell_fused,
+)

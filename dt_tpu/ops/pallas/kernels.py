@@ -1,0 +1,232 @@
+"""Pallas TPU kernels for the paths the reference hand-wrote CUDA for.
+
+Reference targets (SURVEY.md §7 translation table):
+- fused BN + activation epilogue (``src/operator/nn/batch_norm.cu``; cuDNN
+  fused BN-ReLU)
+- 2-bit gradient quantize/dequantize (``src/kvstore/gradient_compression.cu``)
+- fused LSTM cell pointwise stage (``cudnn_rnn-inl.h`` fused elementwise)
+
+Each kernel has the same semantics as its jnp oracle in ``dt_tpu.ops`` /
+``dt_tpu.parallel.compression`` and is tested against it in interpreter mode
+(CPU) and compiled mode (TPU).  ``interpret`` defaults to True off-TPU.
+
+Design notes: all kernels are VPU elementwise/pack work tiled as
+(rows x 128-lane) blocks; the matmuls that FEED them (conv, gate projections)
+stay in XLA where the MXU scheduling is already optimal — fusing the epilogue
+is the part XLA sometimes leaves on the table.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Fused BN (+ optional ReLU) inference epilogue
+# ---------------------------------------------------------------------------
+
+
+def _bn_act_kernel(x_ref, scale_ref, bias_ref, out_ref, *, relu: bool):
+    # scale/bias are precomputed (gamma*rsqrt(var+eps), beta - mean*scale):
+    # one multiply-add per element, then the activation — a single VPU pass.
+    y = x_ref[:] * scale_ref[:] + bias_ref[:]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    out_ref[:] = y
+
+
+def fused_bn_inference(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+                       mean: jax.Array, var: jax.Array, *,
+                       eps: float = 1e-5, relu: bool = False,
+                       block_rows: int = 256,
+                       interpret: Optional[bool] = None) -> jax.Array:
+    """Inference-mode BN (+ReLU) over the trailing channel axis.
+
+    ``x``: (..., C) any leading shape.  Equivalent to
+    ``dt_tpu.ops.nn.batch_norm(training=False)`` (+ relu).
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    orig_shape = x.shape
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+    n = x2.shape[0]
+    if n == 0:
+        return x
+
+    scale = (gamma * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    bias = (beta - mean * gamma * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+    rows = min(block_rows, n)
+    padded = _round_up(n, rows)
+    if padded != n:
+        x2 = jnp.pad(x2, ((0, padded - n), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_bn_act_kernel, relu=relu),
+        out_shape=jax.ShapeDtypeStruct((padded, c), x.dtype),
+        grid=(padded // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, c), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, c), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2, scale, bias)
+    return out[:n].reshape(orig_shape)
+
+
+# ---------------------------------------------------------------------------
+# 2-bit gradient compression
+# ---------------------------------------------------------------------------
+
+_CODES = 16  # per uint32 word
+
+
+def _quant2_kernel(x_ref, packed_ref, resid_ref, *, threshold: float):
+    x = x_ref[:]  # (W, 16) block of grad+residual
+    codes = jnp.where(x >= threshold, jnp.uint32(1),
+                      jnp.where(x <= -threshold, jnp.uint32(2),
+                                jnp.uint32(0)))
+    decoded = jnp.where(codes == 1, threshold,
+                        jnp.where(codes == 2, -threshold, 0.0))
+    resid_ref[:] = x - decoded.astype(x.dtype)
+    shifts = jax.lax.broadcasted_iota(jnp.uint32, codes.shape, 1) * 2
+    packed_ref[:] = jnp.sum(codes << shifts, axis=1, dtype=jnp.uint32,
+                            keepdims=True)
+
+
+def quantize_2bit(grad: jax.Array, residual: jax.Array,
+                  threshold: float = 0.5, block_words: int = 512,
+                  interpret: Optional[bool] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Pallas 2-bit quantize: same contract as
+    ``dt_tpu.parallel.compression.quantize_2bit`` (flat grad+residual ->
+    packed uint32 words + new residual)."""
+    if interpret is None:
+        interpret = _default_interpret()
+    flat = (grad + residual).ravel()
+    n = flat.shape[0]
+    words = _round_up(n, _CODES) // _CODES
+    wpad = _round_up(words, block_words)
+    x = jnp.pad(flat, (0, wpad * _CODES - n)).reshape(wpad, _CODES)
+
+    packed, resid = pl.pallas_call(
+        functools.partial(_quant2_kernel, threshold=threshold),
+        out_shape=(jax.ShapeDtypeStruct((wpad, 1), jnp.uint32),
+                   jax.ShapeDtypeStruct((wpad, _CODES), flat.dtype)),
+        grid=(wpad // block_words,),
+        in_specs=[pl.BlockSpec((block_words, _CODES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((block_words, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((block_words, _CODES), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(x)
+    new_residual = resid.ravel()[:n].reshape(grad.shape) \
+        .astype(residual.dtype)
+    return packed.ravel()[:words], new_residual
+
+
+def _dequant2_kernel(packed_ref, out_ref, *, threshold: float):
+    p = packed_ref[:]  # (W, 1) uint32
+    shifts = jax.lax.broadcasted_iota(jnp.uint32, (p.shape[0], _CODES), 1) * 2
+    codes = (p >> shifts) & jnp.uint32(3)
+    out_ref[:] = jnp.where(codes == 1, threshold,
+                           jnp.where(codes == 2, -threshold, 0.0)
+                           ).astype(out_ref.dtype)
+
+
+def dequantize_2bit(packed: jax.Array, n: int, threshold: float = 0.5,
+                    dtype=jnp.float32, block_words: int = 512,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    if interpret is None:
+        interpret = _default_interpret()
+    words = packed.shape[0]
+    wpad = _round_up(words, block_words)
+    p = jnp.pad(packed, (0, wpad - words)).reshape(wpad, 1)
+    out = pl.pallas_call(
+        functools.partial(_dequant2_kernel, threshold=threshold),
+        out_shape=jax.ShapeDtypeStruct((wpad, _CODES), dtype),
+        grid=(wpad // block_words,),
+        in_specs=[pl.BlockSpec((block_words, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block_words, _CODES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(p)
+    return out.ravel()[:n]
+
+
+# ---------------------------------------------------------------------------
+# Fused LSTM cell pointwise stage
+# ---------------------------------------------------------------------------
+
+
+def _lstm_point_kernel(gates_ref, c_ref, h_out_ref, c_out_ref, *, hidden: int):
+    g = gates_ref[:].astype(jnp.float32)  # (B, 4H) pre-activation
+    i = jax.nn.sigmoid(g[:, 0 * hidden:1 * hidden])
+    f = jax.nn.sigmoid(g[:, 1 * hidden:2 * hidden])
+    gg = jnp.tanh(g[:, 2 * hidden:3 * hidden])
+    o = jax.nn.sigmoid(g[:, 3 * hidden:4 * hidden])
+    c_new = f * c_ref[:].astype(jnp.float32) + i * gg
+    h_out_ref[:] = (o * jnp.tanh(c_new)).astype(h_out_ref.dtype)
+    c_out_ref[:] = c_new.astype(c_out_ref.dtype)
+
+
+def lstm_pointwise(gates: jax.Array, c: jax.Array,
+                   interpret: Optional[bool] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Fused i/f/g/o activations + state update after the gate matmul.
+
+    ``gates``: (B, 4H) = x@Wx + h@Wh + b; ``c``: (B, H).  Returns (h', c').
+    Matches ``dt_tpu.ops.rnn.lstm_cell`` post-matmul math (gate order
+    i,f,g,o).  One VMEM pass instead of ~10 separate HLO elementwise ops —
+    the fusion cuDNN's fused LSTM did for the reference.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    gates = gates.astype(jnp.float32)  # nonlinearities read f32 pre-acts
+    b, four_h = gates.shape
+    hidden = four_h // 4
+    return pl.pallas_call(
+        functools.partial(_lstm_point_kernel, hidden=hidden),
+        out_shape=(jax.ShapeDtypeStruct((b, hidden), gates.dtype),
+                   jax.ShapeDtypeStruct((b, hidden), c.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(gates, c)
+
+
+def lstm_cell_fused(x: jax.Array, h: jax.Array, c: jax.Array, w,
+                    interpret: Optional[bool] = None
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Drop-in for ``dt_tpu.ops.rnn.lstm_cell``: XLA matmul (MXU) + Pallas
+    fused pointwise stage.  Gate pre-activations stay f32 into the kernel
+    (matching the oracle's precision); outputs take x/c dtypes."""
+    gates = (jnp.matmul(x, w.wx) + jnp.matmul(h, w.wh)).astype(jnp.float32) \
+        + w.b
+    h_new, c_new = lstm_pointwise(gates, c.astype(jnp.float32),
+                                  interpret=interpret)
+    return h_new.astype(x.dtype), c_new.astype(c.dtype)

@@ -1,0 +1,99 @@
+"""Pallas kernels vs jnp oracles, interpreter mode (CPU).
+
+The reference's analog is CPU-vs-GPU check_consistency
+(``tests/python/gpu/test_operator_gpu.py``); here it is
+interpreter-vs-oracle, with compiled-TPU runs covered by the bench drives.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dt_tpu.ops import nn, rnn
+from dt_tpu.ops.pallas import kernels as K
+from dt_tpu.parallel import compression as C
+
+
+def test_fused_bn_inference_matches_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 2, (4, 6, 6, 16)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, 16).astype(np.float32)
+    beta = rng.normal(0, 1, 16).astype(np.float32)
+    mean = rng.normal(0, 1, 16).astype(np.float32)
+    var = rng.uniform(0.5, 2.0, 16).astype(np.float32)
+    got = K.fused_bn_inference(jnp.asarray(x), gamma, beta, mean, var,
+                               interpret=True)
+    want, _, _ = nn.batch_norm(jnp.asarray(x), gamma, beta, mean, var,
+                               training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_bn_relu():
+    x = jnp.asarray(np.random.RandomState(1).normal(0, 1, (8, 16))
+                    .astype(np.float32))
+    got = K.fused_bn_inference(x, jnp.ones(16), jnp.zeros(16),
+                               jnp.zeros(16), jnp.ones(16), relu=True,
+                               interpret=True)
+    assert float(jnp.min(got)) >= 0.0
+    want = jnp.maximum(nn.batch_norm(x, jnp.ones(16), jnp.zeros(16),
+                                     jnp.zeros(16), jnp.ones(16),
+                                     training=False)[0], 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_fused_bn_ragged_rows():
+    """Row count not divisible by the block: padding must not leak."""
+    x = jnp.ones((3, 5, 5, 8))  # 75 rows
+    got = K.fused_bn_inference(x, jnp.ones(8), jnp.zeros(8), jnp.zeros(8),
+                               jnp.ones(8), block_rows=64, interpret=True)
+    assert got.shape == x.shape
+
+
+def test_quantize_2bit_matches_numpy_path():
+    rng = np.random.RandomState(2)
+    g = rng.normal(0, 1, 1000).astype(np.float32)
+    r = rng.normal(0, 0.2, 1000).astype(np.float32)
+    pk_p, res_p = K.quantize_2bit(jnp.asarray(g), jnp.asarray(r), 0.5,
+                                  interpret=True)
+    pk_n, res_n = C.np_quantize_2bit(g, r, 0.5)
+    np.testing.assert_array_equal(np.asarray(pk_p), pk_n)
+    np.testing.assert_allclose(np.asarray(res_p), res_n, rtol=1e-6)
+    out_p = K.dequantize_2bit(pk_p, 1000, 0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_p),
+                               C.np_dequantize_2bit(pk_n, 1000, 0.5))
+
+
+def test_quantize_roundtrip_error_feedback():
+    gc_resid = jnp.zeros(64)
+    g = jnp.full(64, 0.3)
+    total = jnp.zeros(64)
+    for _ in range(5):
+        pk, gc_resid = K.quantize_2bit(g, gc_resid, 0.5, interpret=True)
+        total = total + K.dequantize_2bit(pk, 64, 0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(total), 1.5, rtol=1e-6)  # 5*0.3
+
+
+def test_lstm_pointwise_matches_cell():
+    rng = jax.random.PRNGKey(3)
+    B, I, H = 4, 8, 16
+    ws = rnn.init_lstm_weights(rng, 1, I, H)[0]
+    x = jax.random.normal(jax.random.PRNGKey(4), (B, I))
+    h = jax.random.normal(jax.random.PRNGKey(5), (B, H))
+    c = jax.random.normal(jax.random.PRNGKey(6), (B, H))
+    h_ref, c_ref = rnn.lstm_cell(x, h, c, ws)
+    h_got, c_got = K.lstm_cell_fused(x, h, c, ws, interpret=True)
+    np.testing.assert_allclose(np.asarray(h_got), np.asarray(h_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_got), np.asarray(c_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_kernels_jit_compatible():
+    """Kernels must compose under jit (traced shapes, no Python leaks)."""
+    @jax.jit
+    def f(x):
+        return K.fused_bn_inference(x, jnp.ones(8), jnp.zeros(8),
+                                    jnp.zeros(8), jnp.ones(8),
+                                    interpret=True)
+    assert f(jnp.ones((4, 8))).shape == (4, 8)
