@@ -20,13 +20,14 @@ class DataBatch:
     """One batch.  Reference: ``mx.io.DataBatch`` — ``pad`` counts the fake
     trailing examples appended to fill the batch (last_batch_handle='pad')."""
 
-    __slots__ = ("data", "label", "pad")
+    __slots__ = ("data", "label", "pad", "bucket_key")
 
     def __init__(self, data: np.ndarray, label: Optional[np.ndarray] = None,
-                 pad: int = 0):
+                 pad: int = 0, bucket_key=None):
         self.data = data
         self.label = label
         self.pad = pad
+        self.bucket_key = bucket_key  # set by bucketing iterators
 
 
 class DataIter:

@@ -101,6 +101,11 @@ class RecordIOReader:
     def read_all(self) -> List[bytes]:
         try:
             from dt_tpu import native
+        except Exception:
+            native = None
+        try:
+            if native is None:
+                raise RuntimeError("native layer unavailable")
             idx = native.native_index(self._path)
             if idx is not None:
                 recs = native.native_read_batch(self._path, *idx)

@@ -1,0 +1,136 @@
+"""Initializer zoo with the reference's registry surface.
+
+Reference: ``python/mxnet/initializer.py`` — Zero, One, Constant, Uniform,
+Normal, Orthogonal, Xavier (rnd_type gaussian|uniform, factor_type
+in|out|avg, magnitude), MSRAPrelu, Bilinear (for deconv upsampling), Mixed
+(pattern-dispatch).  Each returns a flax-style ``init(key, shape, dtype)``
+so they drop into ``linen.Module.param`` / ``linen.Dense(kernel_init=...)``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+InitFn = Callable[..., jax.Array]
+
+
+def zeros() -> InitFn:
+    return lambda key, shape, dtype=jnp.float32: jnp.zeros(shape, dtype)
+
+
+def ones() -> InitFn:
+    return lambda key, shape, dtype=jnp.float32: jnp.ones(shape, dtype)
+
+
+def constant(value: float) -> InitFn:
+    return lambda key, shape, dtype=jnp.float32: jnp.full(shape, value, dtype)
+
+
+def uniform(scale: float = 0.07) -> InitFn:
+    return lambda key, shape, dtype=jnp.float32: jax.random.uniform(
+        key, shape, dtype, -scale, scale)
+
+
+def normal(sigma: float = 0.01) -> InitFn:
+    return lambda key, shape, dtype=jnp.float32: \
+        jax.random.normal(key, shape, dtype) * sigma
+
+
+def orthogonal(scale: float = 1.414, rand_type: str = "uniform") -> InitFn:
+    def init(key, shape, dtype=jnp.float32):
+        return jax.nn.initializers.orthogonal(scale)(key, shape, dtype)
+    return init
+
+
+def _fans(shape: Sequence[int]) -> Tuple[float, float]:
+    """fan_in/fan_out with conv receptive-field scaling (reference
+    ``Xavier._init_weight`` semantics, adapted to HWIO kernels)."""
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    if len(shape) == 4:  # HWIO
+        rf = shape[0] * shape[1]
+        return float(shape[2] * rf), float(shape[3] * rf)
+    n = float(np.prod(shape))
+    return n, n
+
+
+def xavier(rnd_type: str = "uniform", factor_type: str = "avg",
+           magnitude: float = 3.0) -> InitFn:
+    """Reference ``mx.init.Xavier``."""
+    if rnd_type not in ("uniform", "gaussian"):
+        raise ValueError(rnd_type)
+    if factor_type not in ("in", "out", "avg"):
+        raise ValueError(factor_type)
+
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        factor = {"in": fan_in, "out": fan_out,
+                  "avg": (fan_in + fan_out) / 2.0}[factor_type]
+        scale = float(np.sqrt(magnitude / max(factor, 1.0)))
+        if rnd_type == "uniform":
+            return jax.random.uniform(key, shape, dtype, -scale, scale)
+        return jax.random.normal(key, shape, dtype) * scale
+    return init
+
+
+def msra_prelu(factor_type: str = "avg", slope: float = 0.25) -> InitFn:
+    """Reference ``mx.init.MSRAPrelu``: Xavier-gaussian with magnitude
+    2/(1+slope²)."""
+    magnitude = 2.0 / (1.0 + slope ** 2)
+    return xavier("gaussian", factor_type, magnitude)
+
+
+def bilinear() -> InitFn:
+    """Bilinear upsampling kernel for deconv (reference ``mx.init.Bilinear``);
+    shape (kh, kw, in_c, out_c) HWIO."""
+    def init(key, shape, dtype=jnp.float32):
+        kh, kw = shape[0], shape[1]
+        f = np.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        w = np.zeros(shape, np.float32)
+        for y in range(kh):
+            for x in range(kw):
+                val = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+                for ch in range(min(shape[2], shape[3])):
+                    w[y, x, ch, ch] = val
+        return jnp.asarray(w, dtype)
+    return init
+
+
+def mixed(patterns: Sequence[str], initializers: Sequence[InitFn]) -> Callable:
+    """Pattern-dispatch by param name (reference ``mx.init.Mixed``): returns
+    ``init(name, key, shape, dtype)``."""
+    compiled = [re.compile(p) for p in patterns]
+
+    def init(name: str, key, shape, dtype=jnp.float32):
+        for pat, fn in zip(compiled, initializers):
+            if pat.search(name):
+                return fn(key, shape, dtype)
+        raise ValueError(f"no initializer pattern matched {name!r}")
+    return init
+
+
+_REGISTRY: Dict[str, Callable[..., InitFn]] = {
+    "zeros": zeros,
+    "ones": ones,
+    "constant": constant,
+    "uniform": uniform,
+    "normal": normal,
+    "orthogonal": orthogonal,
+    "xavier": xavier,
+    "msra_prelu": msra_prelu,
+    "bilinear": bilinear,
+}
+
+
+def create(name: str, **kwargs) -> InitFn:
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown initializer {name!r}; known: "
+                         f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key](**kwargs)

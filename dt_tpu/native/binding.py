@@ -34,7 +34,7 @@ def _build() -> Optional[str]:
             return _SO
         # unique temp output: concurrent processes may race to build; each
         # writes its own file and os.replace is atomic
-        tmp = f"{_SO}.{os.getpid()}.tmp"
+        tmp = os.path.join(_HERE, f"libdtnative.{os.getpid()}.so.tmp")
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                "-o", tmp] + _SRC
         subprocess.run(cmd, check=True, capture_output=True, text=True)

@@ -30,33 +30,43 @@ long long dtrec_index(const char* path, uint64_t** offsets_out,
                       uint64_t** lengths_out) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
+  // file size up front: a truncated tail record (killed writer) is treated
+  // as end-of-records, matching the Python reader's lenient behavior
+  if (std::fseek(f, 0, SEEK_END) != 0) { std::fclose(f); return -1; }
+  uint64_t fsize = static_cast<uint64_t>(std::ftell(f));
+  std::rewind(f);
   std::vector<uint64_t> offsets;
   std::vector<uint64_t> lengths;
   uint64_t pos = 0;
   uint32_t hdr[2];
   for (;;) {
     size_t got = std::fread(hdr, 1, sizeof(hdr), f);
-    if (got == 0) break;  // clean EOF
-    if (got != sizeof(hdr)) { std::fclose(f); return -2; }
+    if (got == 0) break;             // clean EOF
+    if (got != sizeof(hdr)) break;   // truncated header: stop
     if (hdr[0] != kMagic) { std::fclose(f); return -2; }
     uint64_t len = hdr[1] & kLenMask;
+    uint64_t padded = (len + 3) & ~3ull;
+    if (pos + sizeof(hdr) + len > fsize) break;  // truncated payload: stop
     offsets.push_back(pos + sizeof(hdr));
     lengths.push_back(len);
-    uint64_t padded = (len + 3) & ~3ull;
-    if (std::fseek(f, static_cast<long>(padded), SEEK_CUR) != 0) {
-      std::fclose(f);
-      return -2;
-    }
+    if (std::fseek(f, static_cast<long>(padded), SEEK_CUR) != 0) break;
     pos += sizeof(hdr) + padded;
   }
   std::fclose(f);
   uint64_t n = offsets.size();
-  *offsets_out = static_cast<uint64_t*>(std::malloc(n * sizeof(uint64_t)));
-  *lengths_out = static_cast<uint64_t*>(std::malloc(n * sizeof(uint64_t)));
-  if (n) {
-    std::memcpy(*offsets_out, offsets.data(), n * sizeof(uint64_t));
-    std::memcpy(*lengths_out, lengths.data(), n * sizeof(uint64_t));
+  uint64_t* offs = static_cast<uint64_t*>(std::malloc(n * sizeof(uint64_t)));
+  uint64_t* lens = static_cast<uint64_t*>(std::malloc(n * sizeof(uint64_t)));
+  if (!offs || !lens) {
+    std::free(offs);
+    std::free(lens);
+    return -1;
   }
+  if (n) {
+    std::memcpy(offs, offsets.data(), n * sizeof(uint64_t));
+    std::memcpy(lens, lengths.data(), n * sizeof(uint64_t));
+  }
+  *offsets_out = offs;
+  *lengths_out = lens;
   return static_cast<long long>(n);
 }
 

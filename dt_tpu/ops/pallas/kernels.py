@@ -97,7 +97,8 @@ def fused_bn_inference(x: jax.Array, gamma: jax.Array, beta: jax.Array,
 # 2-bit gradient compression
 # ---------------------------------------------------------------------------
 
-_CODES = 16  # per uint32 word
+from dt_tpu.parallel.compression import CODES_PER_WORD as _CODES  # noqa: E402
+# (same wire format as the numpy/jnp oracles in parallel.compression)
 
 
 def _quant2_kernel(x_ref, packed_ref, resid_ref, *, threshold: float):
@@ -193,6 +194,7 @@ def _lstm_point_kernel(gates_ref, c_ref, h_out_ref, c_out_ref, *, hidden: int):
 
 
 def lstm_pointwise(gates: jax.Array, c: jax.Array,
+                   block_rows: int = 256,
                    interpret: Optional[bool] = None
                    ) -> Tuple[jax.Array, jax.Array]:
     """Fused i/f/g/o activations + state update after the gate matmul.
@@ -207,16 +209,28 @@ def lstm_pointwise(gates: jax.Array, c: jax.Array,
     gates = gates.astype(jnp.float32)  # nonlinearities read f32 pre-acts
     b, four_h = gates.shape
     hidden = four_h // 4
-    return pl.pallas_call(
+    # tile over batch so gates blocks fit VMEM at large B*H
+    rows = min(block_rows, b)
+    padded = _round_up(b, rows)
+    if padded != b:
+        gates = jnp.pad(gates, ((0, padded - b), (0, 0)))
+        c = jnp.pad(c, ((0, padded - b), (0, 0)))
+    h_out, c_out = pl.pallas_call(
         functools.partial(_lstm_point_kernel, hidden=hidden),
-        out_shape=(jax.ShapeDtypeStruct((b, hidden), gates.dtype),
-                   jax.ShapeDtypeStruct((b, hidden), c.dtype)),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((padded, hidden), jnp.float32),
+                   jax.ShapeDtypeStruct((padded, hidden), c.dtype)),
+        grid=(padded // rows,),
+        in_specs=[pl.BlockSpec((rows, four_h), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((rows, hidden), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((rows, hidden), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((rows, hidden), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)),
         interpret=interpret,
     )(gates, c)
+    return h_out[:b], c_out[:b]
 
 
 def lstm_cell_fused(x: jax.Array, h: jax.Array, c: jax.Array, w,
@@ -229,4 +243,5 @@ def lstm_cell_fused(x: jax.Array, h: jax.Array, c: jax.Array, w,
         + w.b
     h_new, c_new = lstm_pointwise(gates, c.astype(jnp.float32),
                                   interpret=interpret)
-    return h_new.astype(x.dtype), c_new.astype(c.dtype)
+    # same output dtypes as the oracle rnn.lstm_cell (both follow x.dtype)
+    return h_new.astype(x.dtype), c_new.astype(x.dtype)

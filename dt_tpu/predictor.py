@@ -1,0 +1,62 @@
+"""Inference/serving surface.
+
+Reference: the C predict API (``src/c_api/c_predict_api.cc``,
+``include/mxnet/c_predict_api.h``) — load a symbol+params checkpoint, bind
+at fixed shapes, feed forward.  Here: load a dt_tpu checkpoint (full
+TrainState), jit the eval forward once per input shape, serve numpy in/out.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dt_tpu import models as models_lib
+from dt_tpu.training import checkpoint as ckpt_lib
+from dt_tpu.training.train_state import TrainState
+
+
+class Predictor:
+    """``Predictor(model_or_name, prefix, epoch)`` -> ``predict(x)``.
+
+    The jit cache shape-specializes per input shape (the C predict API's
+    ``MXPredReshape`` re-bind is automatic here).
+    """
+
+    def __init__(self, model: Union[str, object], prefix: str, epoch: int,
+                 sample_input: np.ndarray, dtype=jnp.float32, **model_kwargs):
+        if isinstance(model, str):
+            model = models_lib.create(model, dtype=dtype, **model_kwargs)
+        self.model = model
+        x = jnp.asarray(sample_input, dtype)
+        variables = model.init({"params": jax.random.PRNGKey(0)}, x,
+                               training=False)
+        from dt_tpu import optim
+        state = TrainState.create(model.apply, variables["params"],
+                                  optim.create("sgd"),
+                                  variables.get("batch_stats", {}))
+        self.state = ckpt_lib.load_checkpoint(prefix, epoch, state)
+        self.dtype = dtype
+
+        def fwd(params, batch_stats, x):
+            v = {"params": params}
+            if batch_stats:
+                v["batch_stats"] = batch_stats
+            out = model.apply(v, x, training=False)
+            return out[0] if isinstance(out, tuple) else out
+
+        self._fwd = jax.jit(fwd)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        out = self._fwd(self.state.params, self.state.batch_stats,
+                        jnp.asarray(x, self.dtype))
+        return np.asarray(jax.device_get(out))
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        logits = self.predict(x)
+        z = logits - logits.max(axis=-1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=-1, keepdims=True)

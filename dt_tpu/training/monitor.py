@@ -1,0 +1,73 @@
+"""Monitor: tap intermediate layer outputs during forward passes.
+
+Reference: ``python/mxnet/monitor.py`` — installs an executor callback that
+applies ``stat_func`` to every op output matching a pattern, printed via
+``toc_print``.  Flax-native: ``linen.Module.apply(...,
+capture_intermediates=...)`` collects the intermediates in one pass; the
+Monitor filters by regex and reduces with stat_func.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("dt_tpu")
+
+
+def _default_stat(x: jax.Array) -> jax.Array:
+    """|x|.mean() — the reference's default 'norm' stat."""
+    return jnp.mean(jnp.abs(x.astype(jnp.float32)))
+
+
+class Monitor:
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        self.interval = max(interval, 1)
+        self.stat_func = stat_func or _default_stat
+        self.pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.queue: List[Tuple[int, str, float]] = []
+
+    def forward(self, model, variables, *args, **kwargs):
+        """Run a forward pass capturing intermediates; returns the model
+        output (use in place of ``model.apply`` while monitoring)."""
+        out, mods = model.apply(
+            variables, *args, capture_intermediates=True, mutable="all",
+            **kwargs)
+        self.step += 1
+        if self.step % self.interval == 0:
+            self._collect(mods.get("intermediates", {}))
+        return out
+
+    def _collect(self, tree, prefix=""):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                self._collect(v, f"{prefix}/{k}" if prefix else k)
+            return
+        if isinstance(tree, (tuple, list)):
+            for i, v in enumerate(tree):
+                self._collect(v, prefix)
+            return
+        name = prefix
+        if self.pattern.search(name):
+            try:
+                stat = float(np.asarray(self.stat_func(tree)))
+            except Exception:
+                return
+            self.queue.append((self.step, name, stat))
+
+    def toc_print(self):
+        """Log + clear collected stats (reference ``Monitor.toc_print``)."""
+        entries = sorted(self.queue) if self.sort else self.queue
+        for step, name, stat in entries:
+            logger.info("Batch: %7d %30s %.6g", step, name, stat)
+        out, self.queue = entries, []
+        return out

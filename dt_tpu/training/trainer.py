@@ -1,0 +1,93 @@
+"""Gluon-style Trainer — the imperative training surface.
+
+Reference: ``python/mxnet/gluon/trainer.py:27-408`` (Trainer holds params +
+optimizer + kvstore; per-iteration ``step(batch_size)`` rescales grads by
+1/batch_size, allreduces, applies the update; ``save_states/load_states``
+serialize optimizer state).  Functional here: the user computes grads with
+``jax.grad`` (the autograd.record() analog) and hands them to ``step``.
+
+    trainer = Trainer(params, "sgd", {"learning_rate": 0.1}, kvstore=kv)
+    loss, grads = jax.value_and_grad(loss_fn)(trainer.params, batch)
+    trainer.step(grads, batch_size)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import flax.serialization
+import jax
+import jax.numpy as jnp
+import optax
+
+from dt_tpu.parallel import kvstore as kvstore_lib
+
+
+class Trainer:
+    def __init__(self, params: Any,
+                 optimizer: Union[str, optax.GradientTransformation] = "sgd",
+                 optimizer_params: Optional[Dict] = None,
+                 kvstore: Union[str, kvstore_lib.KVStore] = "local"):
+        if isinstance(optimizer, str):
+            from dt_tpu import optim
+            optimizer = optim.create(optimizer, **(optimizer_params or {}))
+        self.tx = optimizer
+        self.params = params
+        self.opt_state = optimizer.init(params)
+        self.kv = kvstore_lib.create(kvstore) if isinstance(kvstore, str) \
+            else kvstore
+        self._step_fn = None
+
+    def _build(self):
+        tx = self.tx
+
+        def apply(params, opt_state, grads, rescale):
+            grads = jax.tree_util.tree_map(lambda g: g * rescale, grads)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        self._step_fn = jax.jit(apply)
+
+    def allreduce_grads(self, grads):
+        """Average grads across workers (reference
+        ``Trainer.allreduce_grads``); on a mesh this is a no-op — gradients
+        were already psum'd inside jit — so this only acts under a
+        host-sync controller."""
+        ctrl = self.kv._controller
+        if ctrl is None or self.kv.num_workers <= 1:
+            return grads
+        import numpy as np
+        flat, unravel = jax.flatten_util.ravel_pytree(grads)
+        avg = ctrl.allreduce("trainer_grads",
+                             np.asarray(jax.device_get(flat)))
+        return unravel(jnp.asarray(avg))
+
+    def step(self, grads, batch_size: int = 1,
+             ignore_stale_grad: bool = False):
+        """Rescale by 1/batch_size, sync, update (reference
+        ``Trainer.step``)."""
+        if self._step_fn is None:
+            self._build()
+        grads = self.allreduce_grads(grads)
+        self.params, self.opt_state = self._step_fn(
+            self.params, self.opt_state, grads, 1.0 / batch_size)
+        return self.params
+
+    @property
+    def learning_rate(self):
+        return getattr(self.tx, "learning_rate", None)
+
+    def save_states(self, fname: str):
+        """Serialize optimizer state (reference ``Trainer.save_states`` —
+        which the reference could NOT do in dist mode; here it always
+        works)."""
+        blob = flax.serialization.msgpack_serialize(
+            flax.serialization.to_state_dict(jax.device_get(self.opt_state)))
+        with open(fname, "wb") as f:
+            f.write(blob)
+
+    def load_states(self, fname: str):
+        with open(fname, "rb") as f:
+            restored = flax.serialization.msgpack_restore(f.read())
+        self.opt_state = flax.serialization.from_state_dict(
+            self.opt_state, restored)

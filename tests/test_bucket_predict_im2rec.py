@@ -1,0 +1,102 @@
+"""Bucketing, Predictor, im2rec tests."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dt_tpu import models
+from dt_tpu.data.bucket_io import BucketSentenceIter
+from dt_tpu.predictor import Predictor
+from dt_tpu.training import checkpoint
+from dt_tpu.training.train_state import TrainState
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bucket_iter_pads_and_buckets():
+    sents = [[1, 2], [3, 4, 5], [6], [7, 8, 9, 10], [1, 1, 1], [2, 2]]
+    it = BucketSentenceIter(sents, batch_size=2, buckets=[2, 4],
+                            invalid_label=0, shuffle=False)
+    batches = list(iter(it))
+    assert batches, "no batches"
+    for b in batches:
+        assert b.bucket_key in (2, 4)
+        assert b.data.shape == (b.bucket_key, 2)  # TN layout
+    # 3 sents per bucket, batch 2 -> one full batch each (partial leftovers
+    # dropped, reference BucketSentenceIter behavior)
+    total = sum(b.data.shape[1] for b in batches)
+    assert total == 4
+    assert sorted(b.bucket_key for b in batches) == [2, 4]
+
+
+def test_bucket_iter_jit_cache_per_bucket():
+    sents = [[1] * 3] * 4 + [[2] * 7] * 4
+    it = BucketSentenceIter(sents, batch_size=4, buckets=[3, 7],
+                            shuffle=False)
+    compiles = []
+
+    @jax.jit
+    def step(x):
+        compiles.append(x.shape)
+        return x.sum()
+
+    for b in iter(it):
+        step(jnp.asarray(b.data))
+    assert sorted(set(compiles)) == [(3, 4), (7, 4)]  # one trace per bucket
+
+
+def test_predictor_roundtrip(tmp_path):
+    model = models.create("mlp", num_classes=3, hidden=(8,))
+    x = np.random.RandomState(0).rand(4, 6, 6, 1).astype(np.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           jnp.asarray(x), training=False)
+    from dt_tpu import optim
+    state = TrainState.create(model.apply, variables["params"],
+                              optim.create("sgd"), {})
+    prefix = str(tmp_path / "m")
+    checkpoint.save_checkpoint(prefix, 0, state)
+
+    pred = Predictor("mlp", prefix, 0, sample_input=x, num_classes=3,
+                     hidden=(8,))
+    out = pred.predict(x)
+    assert out.shape == (4, 3)
+    # matches direct apply
+    want = model.apply(variables, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-5)
+    proba = pred.predict_proba(x)
+    np.testing.assert_allclose(proba.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_im2rec_packs_directory(tmp_path):
+    from PIL import Image
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            Image.fromarray(
+                np.full((10, 10, 3), i * 40, np.uint8)).save(
+                    d / f"{i}.jpg")
+    out = str(tmp_path / "packed")
+    env = dict(os.environ)
+    env["DT_FORCE_CPU"] = "1"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         "--root", str(root), "--out", out, "--resize", "8"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    from dt_tpu import data
+    it = data.ImageRecordIter(out + ".rec", (8, 8, 3), batch_size=2,
+                              path_imgidx=out + ".idx")
+    batches = list(it)
+    assert sum(b.data.shape[0] - b.pad for b in batches) == 6
+    labels = np.concatenate([b.label[:b.data.shape[0] - b.pad]
+                             for b in batches])
+    assert set(labels.tolist()) == {0.0, 1.0}
+    # classes manifest + lst written
+    assert open(out + "_classes.txt").read().split() == ["cat", "dog"]
+    assert len(open(out + ".lst").read().strip().splitlines()) == 6

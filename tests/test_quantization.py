@@ -1,0 +1,74 @@
+"""INT8 quantization tests (reference ``tests/python/quantization/``)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dt_tpu.ops import quantization as Q
+
+
+def test_quantize_dequantize_roundtrip():
+    x = jnp.asarray(np.linspace(-2, 2, 101).astype(np.float32))
+    q, scale = Q.quantize(x, -2.0, 2.0)
+    assert q.dtype == jnp.int8
+    back = Q.dequantize(q, scale)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=2 / 127)
+
+
+def test_quantize_clips():
+    x = jnp.asarray([10.0, -10.0])
+    q, _ = Q.quantize(x, -1.0, 1.0)
+    np.testing.assert_array_equal(np.asarray(q), [127, -127])
+
+
+def test_quantized_dense_close_to_float():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (8, 32)).astype(np.float32)
+    w = rng.uniform(-0.5, 0.5, (32, 16)).astype(np.float32)
+    xq, xs = Q.quantize(jnp.asarray(x), x.min(), x.max())
+    wq, ws = Q.quantize(jnp.asarray(w), w.min(), w.max())
+    got = Q.quantized_dense(xq, wq, xs, ws)
+    want = x @ w
+    err = np.abs(np.asarray(got) - want).max() / np.abs(want).max()
+    assert err < 0.05, err
+
+
+def test_quantized_conv_close_to_float():
+    rng = np.random.RandomState(1)
+    x = rng.uniform(-1, 1, (1, 8, 8, 4)).astype(np.float32)
+    w = rng.uniform(-0.5, 0.5, (3, 3, 4, 8)).astype(np.float32)
+    from dt_tpu.ops import nn
+    xq, xs = Q.quantize(jnp.asarray(x), x.min(), x.max())
+    wq, ws = Q.quantize(jnp.asarray(w), w.min(), w.max())
+    got = Q.quantized_conv2d(xq, wq, xs, ws, padding=1)
+    want = np.asarray(nn.conv2d(jnp.asarray(x), jnp.asarray(w), padding=1))
+    err = np.abs(np.asarray(got) - want).max() / np.abs(want).max()
+    assert err < 0.05, err
+
+
+def test_requantize():
+    acc = jnp.asarray([[1000, -500]], jnp.int32)
+    out = Q.requantize(acc, scale_in=100.0, scale_out=12.7)
+    np.testing.assert_array_equal(np.asarray(out), [[127, -64]])
+
+
+def test_minmax_collector():
+    c = Q.MinMaxCollector()
+    c.collect("a", np.array([1.0, -2.0]))
+    c.collect("a", np.array([3.0, 0.0]))
+    assert c.ranges["a"] == (-2.0, 3.0)
+
+
+def test_entropy_calibrate_clips_outliers():
+    rng = np.random.RandomState(2)
+    bulk = rng.normal(0, 1, 100000)
+    outliers = np.array([50.0, -60.0])
+    t = Q.entropy_calibrate(np.concatenate([bulk, outliers]))
+    assert t < 20.0  # threshold ignores the two extreme outliers
+    assert t > 1.0   # but keeps the bulk
+
+
+def test_quantize_params_tree():
+    params = {"dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones(4)}}
+    qp = Q.quantize_params(params)
+    assert qp["dense"]["kernel"]["q"].dtype == jnp.int8
+    assert qp["dense"]["bias"].dtype == jnp.float32  # bias untouched

@@ -1,0 +1,72 @@
+"""Ring attention vs full-attention oracle on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dt_tpu.parallel import mesh as mesh_lib
+from dt_tpu.parallel.ring_attention import full_attention, ring_attention
+
+
+def _qkv(b=2, s=64, h=2, d=8, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, h, d))
+    v = jax.random.normal(ks[2], (b, s, h, d))
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full(causal):
+    mesh = mesh_lib.make_mesh()  # 8-way on the data axis
+    q, k, v = _qkv()
+    got = ring_attention(q, k, v, mesh, causal=causal)
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_under_jit_with_sharded_inputs():
+    mesh = mesh_lib.make_mesh()
+    q, k, v = _qkv(s=32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P(None, "data", None, None))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, mesh, causal=True)
+
+    out = f(q, k, v)
+    assert out.shape == (2, 32, 2, 8)
+    want = full_attention(jax.device_get(q), jax.device_get(k),
+                          jax.device_get(v), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_grad_flows():
+    mesh = mesh_lib.make_mesh()
+    q, k, v = _qkv(s=16)
+
+    def loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+    g = jax.grad(loss)(q, k, v)
+    # oracle grads
+    def loss_o(q, k, v):
+        return jnp.sum(full_attention(q, k, v) ** 2)
+    go = jax.grad(loss_o)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(go), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_ring_long_sequence_smoke():
+    """4096-long sequence across 8 devices — per-device score block is
+    512x4096... no: 512x512 per ring step; must run comfortably."""
+    mesh = mesh_lib.make_mesh()
+    q, k, v = _qkv(b=1, s=4096, h=1, d=16, seed=1)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    assert out.shape == (1, 4096, 1, 16)
+    assert bool(jnp.isfinite(out).all())

@@ -1,0 +1,110 @@
+"""Trainer / initializer / Monitor tests (reference test_gluon_trainer.py,
+test_init.py, monitor usage)."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dt_tpu import initializer as init_lib
+from dt_tpu import models
+from dt_tpu.training.monitor import Monitor
+from dt_tpu.training.trainer import Trainer
+
+
+def test_trainer_step_descends():
+    params = {"w": jnp.ones(4)}
+    trainer = Trainer(params, "sgd", {"learning_rate": 0.5})
+
+    def loss(p, x):
+        return jnp.sum((p["w"] * x) ** 2)
+
+    for _ in range(20):
+        l, g = jax.value_and_grad(loss)(trainer.params, jnp.ones(4))
+        trainer.step(g, batch_size=1)
+    assert float(loss(trainer.params, jnp.ones(4))) < 1e-3
+
+
+def test_trainer_batch_rescale():
+    params = {"w": jnp.zeros(2)}
+    trainer = Trainer(params, "sgd", {"learning_rate": 1.0})
+    g = {"w": jnp.asarray([8.0, 8.0])}
+    trainer.step(g, batch_size=8)  # rescale 1/8 -> effective grad 1
+    np.testing.assert_allclose(np.asarray(trainer.params["w"]), -1.0)
+
+
+def test_trainer_save_load_states(tmp_path):
+    params = {"w": jnp.ones(3)}
+    t1 = Trainer(params, "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    t1.step({"w": jnp.ones(3)}, 1)
+    f = str(tmp_path / "opt.states")
+    t1.save_states(f)
+    t2 = Trainer(params, "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    t2.load_states(f)
+    m1 = jax.tree_util.tree_leaves(t1.opt_state)
+    m2 = jax.tree_util.tree_leaves(t2.opt_state)
+    for a, b in zip(m1, m2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("zeros", {}), ("ones", {}), ("constant", {"value": 2.5}),
+    ("uniform", {"scale": 0.1}), ("normal", {"sigma": 0.02}),
+    ("xavier", {}), ("xavier", {"rnd_type": "gaussian", "factor_type": "in"}),
+    ("msra_prelu", {}), ("orthogonal", {}),
+])
+def test_initializers_produce_shapes(name, kwargs):
+    fn = init_lib.create(name, **kwargs)
+    out = fn(jax.random.PRNGKey(0), (8, 16), jnp.float32)
+    assert out.shape == (8, 16)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_xavier_scale():
+    fn = init_lib.create("xavier", rnd_type="uniform", factor_type="avg",
+                         magnitude=3.0)
+    w = fn(jax.random.PRNGKey(0), (100, 100))
+    bound = np.sqrt(3.0 / 100)
+    assert float(jnp.abs(w).max()) <= bound + 1e-6
+    assert float(jnp.abs(w).max()) > bound * 0.9
+
+
+def test_bilinear_upsampling_kernel():
+    fn = init_lib.create("bilinear")
+    w = fn(jax.random.PRNGKey(0), (4, 4, 2, 2))
+    # center-symmetric, diagonal channels only
+    assert float(w[1, 1, 0, 0]) > 0
+    assert float(w[1, 1, 0, 1]) == 0.0
+
+
+def test_mixed_dispatch():
+    fn = init_lib.mixed([r"bias", r".*"],
+                        [init_lib.zeros(), init_lib.ones()])
+    b = fn("dense0_bias", jax.random.PRNGKey(0), (4,))
+    w = fn("dense0_weight", jax.random.PRNGKey(0), (4,))
+    np.testing.assert_array_equal(np.asarray(b), 0.0)
+    np.testing.assert_array_equal(np.asarray(w), 1.0)
+
+
+def test_initializer_in_flax_module():
+    import flax.linen as linen
+    layer = linen.Dense(4, kernel_init=init_lib.create("xavier"))
+    v = layer.init(jax.random.PRNGKey(0), jnp.ones((1, 8)))
+    assert v["params"]["kernel"].shape == (8, 4)
+
+
+def test_monitor_captures_intermediates(caplog):
+    model = models.create("mlp", num_classes=3, hidden=(8,))
+    x = jnp.ones((2, 4, 4, 1))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x,
+                           training=False)
+    mon = Monitor(interval=1, pattern="Dense")
+    out = mon.forward(model, variables, x, training=False)
+    assert out[0].shape if isinstance(out, tuple) else out.shape
+    with caplog.at_level(logging.INFO, logger="dt_tpu"):
+        entries = mon.toc_print()
+    assert entries, "monitor captured nothing"
+    assert all("Dense" in name for _, name, _ in entries)
+    assert mon.queue == []

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Pack an image directory (or .lst file) into RecordIO.
+
+Reference: ``tools/im2rec.py`` — the dataset-packing tool producing the
+``.rec``/``.idx``/``.lst`` files the image iterators consume.  Formats are
+byte-compatible with ``dt_tpu.data`` (and the reference's wire format).
+
+    python tools/im2rec.py --root imgs/ --out train        # class-per-subdir
+    python tools/im2rec.py --lst train.lst --root imgs/ --out train
+
+``.lst`` format (reference): ``index\\tlabel\\trelative/path.jpg``.
+"""
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dt_tpu.data import RecordIOWriter, pack_label  # noqa: E402
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def build_list(root):
+    """Walk class-per-subdirectory layout -> [(label, relpath)]."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    items = []
+    for label, cls in enumerate(classes):
+        for dirpath, _, files in os.walk(os.path.join(root, cls)):
+            for f in sorted(files):
+                if f.lower().endswith(IMG_EXTS):
+                    items.append((float(label),
+                                  os.path.relpath(os.path.join(dirpath, f),
+                                                  root)))
+    return items, classes
+
+
+def read_list(path):
+    items = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) >= 3:
+                items.append((float(parts[1]), parts[2]))
+    return items
+
+
+def encode(path, resize=None, quality=95):
+    from PIL import Image
+    img = Image.open(path).convert("RGB")
+    if resize:
+        w, h = img.size
+        s = resize / min(w, h)
+        img = img.resize((int(w * s), int(h * s)), Image.BILINEAR)
+    import io
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", required=True, help="image root directory")
+    ap.add_argument("--out", required=True, help="output prefix")
+    ap.add_argument("--lst", default=None, help="existing .lst file")
+    ap.add_argument("--resize", type=int, default=None,
+                    help="resize shorter side to this many pixels")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if not os.path.isdir(args.root):
+        ap.error(f"--root {args.root!r} is not a directory")
+    if args.lst:
+        items = read_list(args.lst)
+    else:
+        items, classes = build_list(args.root)
+        with open(args.out + "_classes.txt", "w") as f:
+            f.write("\n".join(classes) + "\n")
+    if args.shuffle:
+        random.Random(args.seed).shuffle(items)
+
+    with open(args.out + ".lst", "w") as lst, \
+            RecordIOWriter(args.out + ".rec", args.out + ".idx") as w:
+        for i, (label, rel) in enumerate(items):
+            payload = encode(os.path.join(args.root, rel), args.resize,
+                             args.quality)
+            w.write(pack_label(payload, label, rec_id=i), key=i)
+            lst.write(f"{i}\t{label:g}\t{rel}\n")
+    print(f"packed {len(items)} images -> {args.out}.rec")
+
+
+if __name__ == "__main__":
+    main()
