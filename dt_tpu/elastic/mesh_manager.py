@@ -1,0 +1,114 @@
+"""Mesh lifecycle across membership changes — the multi-host data plane.
+
+SURVEY.md §5.8/§7 "hard parts": XLA/GSPMD assumes a fixed device set, so a
+membership change means tearing down and re-initializing the
+``jax.distributed`` runtime with the new host set, rebuilding the mesh, and
+resharding the training state from a host-RAM snapshot.  This module owns
+that dance; the elastic Scheduler/WorkerClient own the *decision* (who is in
+the job).
+
+On one host (or the CPU test mesh) ``rebuild`` degenerates to re-creating
+the local mesh and re-placing state — exercised by tests; the
+``jax.distributed`` branch runs on real pods where each worker process owns
+one host's chips.
+
+Mitigations from SURVEY.md §7 applied here:
+- epoch-boundary only (caller's contract),
+- snapshot in host RAM before teardown (``snapshot_state``),
+- the persistent compilation cache keyed by world size amortizes the
+  recompile (enable via ``jax.config.jax_compilation_cache_dir``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+from dt_tpu.parallel import mesh as mesh_lib
+
+logger = logging.getLogger("dt_tpu.elastic")
+
+
+def snapshot_state(state: Any) -> Any:
+    """Pull a (possibly sharded) pytree fully to host RAM (numpy)."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
+                                  state)
+
+
+def restore_state(host_state: Any, mesh, shardings: Any = None) -> Any:
+    """Re-place a host snapshot onto a (new) mesh.
+
+    ``shardings``: optional pytree of per-leaf ``NamedSharding`` matching
+    ``host_state`` for model-parallel layouts; default replicates every leaf
+    (the DP case)."""
+    if shardings is None:
+        rep = mesh_lib.replicate_sharding(mesh)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep), host_state)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), host_state, shardings)
+
+
+class MeshManager:
+    """Owns the distributed runtime + mesh for one worker process."""
+
+    def __init__(self, coordinator_address: Optional[str] = None,
+                 local_device_count: Optional[int] = None):
+        self.coordinator_address = coordinator_address
+        self.local_device_count = local_device_count
+        self._initialized = False
+        self.mesh = None
+
+    def initialize(self, num_processes: int = 1, process_id: int = 0,
+                   coordinator_address: Optional[str] = None):
+        """Join the distributed world (no-op single-process).
+
+        Real pods: every worker calls this with its rank and the coordinator
+        (rank-0 host) address — the ``jax.distributed`` analog of ps-lite's
+        scheduler rendezvous (``van.cc:95-185``).  ``coordinator_address``
+        overrides the constructor's (the coordinator can move when
+        membership changes remove the old rank-0 host)."""
+        if coordinator_address is not None:
+            self.coordinator_address = coordinator_address
+        if num_processes > 1:
+            if not self.coordinator_address:
+                raise ValueError(
+                    "multi-process world needs a coordinator_address; "
+                    "refusing to build a local-only mesh that would silently "
+                    "skip cross-host gradient averaging")
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+            self._initialized = True
+        self.mesh = mesh_lib.make_mesh()
+        return self.mesh
+
+    def teardown(self):
+        if self._initialized:
+            jax.distributed.shutdown()
+            self._initialized = False
+        self.mesh = None
+
+    def rebuild(self, state: Any, num_processes: int, process_id: int,
+                coordinator_address: Optional[str] = None):
+        """Membership changed: snapshot -> teardown -> re-init with the new
+        world -> reshard.  Returns (new_mesh, restored_state).
+
+        ``coordinator_address``: the NEW world's coordinator (rank-0 host
+        after the change — the old one may have been removed).
+
+        The reference's equivalent is ``updateNumWorker`` rewriting node
+        groups in place (``postoffice.cc:71-187``); GSPMD cannot mutate a
+        live mesh, so the world is rebuilt — acceptable at epoch granularity
+        (the same boundary the reference restricts changes to)."""
+        host_state = snapshot_state(state)
+        self.teardown()
+        mesh = self.initialize(num_processes, process_id,
+                               coordinator_address)
+        restored = restore_state(host_state, mesh)
+        logger.info("mesh rebuilt: %d device(s), world=%d rank=%d",
+                    mesh.devices.size, num_processes, process_id)
+        return mesh, restored

@@ -22,6 +22,8 @@ from dt_tpu.models.inception import InceptionV3 as InceptionV3
 from dt_tpu.models.mobilenet import MobileNetV1 as MobileNetV1, MobileNetV2 as MobileNetV2
 from dt_tpu.models.densenet import DenseNet as DenseNet
 from dt_tpu.models.squeezenet import SqueezeNet as SqueezeNet
+from dt_tpu.models.googlenet import GoogLeNet as GoogLeNet
+from dt_tpu.models.resnext import ResNeXt as ResNeXt
 from dt_tpu.models.lstm_lm import LSTMLanguageModel as LSTMLanguageModel
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {}
@@ -35,8 +37,8 @@ def register(name: str, factory: Callable[..., Any]):
 def create(name: str, **kwargs):
     """Create a model by the reference's network names: lenet, mlp, alexnet,
     vgg11/13/16/19[_bn], resnet18/34/50/101/152[_v2], resnet20/56/110 (CIFAR),
-    inception-v3, mobilenet[_v2], densenet121/161/169/201, squeezenet,
-    lstm_lm."""
+    inception-v3, googlenet, resnext50/101/152, mobilenet[_v2],
+    densenet121/161/169/201, squeezenet, lstm_lm."""
     key = name.lower().replace("-", "_")
     if key in _REGISTRY:
         return _REGISTRY[key](**kwargs)
@@ -57,6 +59,9 @@ def _setup_registry():
         register(f"resnet{d}_cifar", lambda d=d, **kw: CifarResNet(depth=d, **kw))
         register(f"resnet{d}", lambda d=d, **kw: CifarResNet(depth=d, **kw))
     register("inception_v3", lambda **kw: InceptionV3(**kw))
+    register("googlenet", lambda **kw: GoogLeNet(**kw))
+    for d in (50, 101, 152):
+        register(f"resnext{d}", lambda d=d, **kw: ResNeXt(depth=d, **kw))
     register("mobilenet", lambda **kw: MobileNetV1(**kw))
     register("mobilenet_v2", lambda **kw: MobileNetV2(**kw))
     for d in (121, 161, 169, 201):

@@ -1,0 +1,77 @@
+"""GoogLeNet (Inception v1).
+
+Reference: ``example/image-classification/symbols/googlenet.py`` (Szegedy et
+al. 2014, without the auxiliary heads — matching the reference symbol)."""
+
+from typing import Any
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.ops import nn as ops
+
+
+class ConvRelu(linen.Module):
+    features: int
+    kernel: tuple = (1, 1)
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x):
+        x = linen.Conv(self.features, self.kernel, self.strides,
+                       padding=self.padding, dtype=self.dtype)(x)
+        return jax.nn.relu(x)
+
+
+class InceptionBlock(linen.Module):
+    c1: int
+    c3r: int
+    c3: int
+    c5r: int
+    c5: int
+    cp: int
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x):
+        d = self.dtype
+        b1 = ConvRelu(self.c1, dtype=d)(x)
+        b3 = ConvRelu(self.c3r, dtype=d)(x)
+        b3 = ConvRelu(self.c3, (3, 3), dtype=d)(b3)
+        b5 = ConvRelu(self.c5r, dtype=d)(x)
+        b5 = ConvRelu(self.c5, (5, 5), dtype=d)(b5)
+        bp = ops.max_pool2d(x, 3, 1, padding=1)
+        bp = ConvRelu(self.cp, dtype=d)(bp)
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+class GoogLeNet(linen.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        d = self.dtype
+        x = ConvRelu(64, (7, 7), (2, 2), dtype=d)(x)
+        x = ops.max_pool2d(x, 3, 2, padding=1)
+        x = ConvRelu(64, dtype=d)(x)
+        x = ConvRelu(192, (3, 3), dtype=d)(x)
+        x = ops.max_pool2d(x, 3, 2, padding=1)
+        x = InceptionBlock(64, 96, 128, 16, 32, 32, d)(x)
+        x = InceptionBlock(128, 128, 192, 32, 96, 64, d)(x)
+        x = ops.max_pool2d(x, 3, 2, padding=1)
+        x = InceptionBlock(192, 96, 208, 16, 48, 64, d)(x)
+        x = InceptionBlock(160, 112, 224, 24, 64, 64, d)(x)
+        x = InceptionBlock(128, 128, 256, 24, 64, 64, d)(x)
+        x = InceptionBlock(112, 144, 288, 32, 64, 64, d)(x)
+        x = InceptionBlock(256, 160, 320, 32, 128, 128, d)(x)
+        x = ops.max_pool2d(x, 3, 2, padding=1)
+        x = InceptionBlock(256, 160, 320, 32, 128, 128, d)(x)
+        x = InceptionBlock(384, 192, 384, 48, 128, 128, d)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = ops.dropout(x, 0.4, training=training,
+                        rng=self.make_rng("dropout") if training else None)
+        return linen.Dense(self.num_classes, dtype=d)(x)

@@ -1,0 +1,75 @@
+"""ResNeXt (aggregated residual transformations).
+
+Reference: ``example/image-classification/symbols/resnext.py`` (Xie et al.
+2017).  Grouped 3x3 convs lower to XLA grouped convolution on the MXU."""
+
+from typing import Any, Tuple
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.models.common import bn as _bn
+from dt_tpu.ops import nn as ops
+
+_SPECS = {
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+_FILTERS = [128, 256, 512, 1024]  # group-conv width (cardinality 32, 4d)
+
+
+class ResNeXtBlock(linen.Module):
+    features: int  # grouped-conv width; output is features * 2
+    cardinality: int = 32
+    strides: Tuple[int, int] = (1, 1)
+    downsample: bool = False
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        residual = x
+        y = linen.Conv(self.features, (1, 1), use_bias=False,
+                       dtype=self.dtype)(x)
+        y = _bn(training, self.dtype)(y)
+        y = jax.nn.relu(y)
+        y = linen.Conv(self.features, (3, 3), self.strides, padding="SAME",
+                       feature_group_count=self.cardinality, use_bias=False,
+                       dtype=self.dtype)(y)
+        y = _bn(training, self.dtype)(y)
+        y = jax.nn.relu(y)
+        y = linen.Conv(self.features * 2, (1, 1), use_bias=False,
+                       dtype=self.dtype)(y)
+        y = _bn(training, self.dtype)(y)
+        if self.downsample:
+            residual = linen.Conv(self.features * 2, (1, 1), self.strides,
+                                  use_bias=False, dtype=self.dtype)(x)
+            residual = _bn(training, self.dtype)(residual)
+        return jax.nn.relu(y + residual)
+
+
+class ResNeXt(linen.Module):
+    depth: int = 50
+    num_classes: int = 1000
+    cardinality: int = 32
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        stages = _SPECS[self.depth]
+        x = linen.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                       use_bias=False, dtype=self.dtype)(x)
+        x = _bn(training, self.dtype)(x)
+        x = jax.nn.relu(x)
+        x = ops.max_pool2d(x, 3, 2, padding=1)
+        in_f = 64
+        for stage, (nblk, f) in enumerate(zip(stages, _FILTERS)):
+            for i in range(nblk):
+                strides = (2, 2) if (i == 0 and stage > 0) else (1, 1)
+                down = (i == 0) and (strides != (1, 1) or in_f != f * 2)
+                x = ResNeXtBlock(f, self.cardinality, strides, down,
+                                 self.dtype)(x, training)
+                in_f = f * 2
+        x = jnp.mean(x, axis=(1, 2))
+        return linen.Dense(self.num_classes, dtype=self.dtype)(x)

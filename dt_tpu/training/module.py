@@ -74,7 +74,7 @@ class Module:
                  optimizer: Union[str, optax.GradientTransformation] = "sgd",
                  optimizer_params: Optional[dict] = None,
                  kvstore: Union[str, kvstore_lib.KVStore] = "local",
-                 mesh=None, seed: int = 0):
+                 mesh=None, mesh_manager=None, seed: int = 0):
         self.model = model
         self.loss_fn = loss_fn
         if isinstance(optimizer, str):
@@ -84,6 +84,10 @@ class Module:
         self.kv = kvstore_lib.create(kvstore) if isinstance(kvstore, str) \
             else kvstore
         self._mesh = mesh
+        # Multi-host pods pass a dt_tpu.elastic.MeshManager: on membership
+        # change the fit loop rebuilds the jax.distributed world + mesh and
+        # reshards state through it (SURVEY.md §7 "mesh resize" hard part).
+        self.mesh_manager = mesh_manager
         self.seed = seed
         self.state: Optional[TrainState] = None
         self._train_step = None
@@ -281,6 +285,14 @@ class Module:
                         "Epoch[%d] membership changed: %d -> %d workers",
                         epoch, num_workers, self.kv.num_workers)
                     num_workers = self.kv.num_workers
+                    if self.mesh_manager is not None:
+                        # rebuild the distributed world + mesh, reshard the
+                        # live state, recompile the steps for the new mesh
+                        self._mesh, self.state = self.mesh_manager.rebuild(
+                            self.state, num_workers, self.kv.rank)
+                        self._build_steps()
+                        self._unravel = None
+                        self._unravel_stats = None
                     if elastic_data_iterator is not None:
                         train_data, new_eval = \
                             elastic_data_iterator.get_data_iterator(self.kv)

@@ -44,6 +44,8 @@ def test_small_models_forward(name, shape, classes):
     ("mobilenet_v2", 64),
     ("squeezenet", 64),
     ("densenet121", 64),
+    ("googlenet", 64),
+    ("resnext50", 64),
 ])
 def test_imagenet_models_forward(name, size):
     model = models.create(name, num_classes=7)
