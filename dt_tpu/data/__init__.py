@@ -14,6 +14,7 @@ from dt_tpu.data.io import (
     DataIter as DataIter,
     NDArrayIter as NDArrayIter,
     CSVIter as CSVIter,
+    LibSVMIter as LibSVMIter,
     ResizeIter as ResizeIter,
     PrefetchingIter as PrefetchingIter,
     SyntheticImageIter as SyntheticImageIter,

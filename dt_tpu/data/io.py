@@ -155,6 +155,58 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size, **kw)
 
 
+class LibSVMIter(NDArrayIter):
+    """LibSVM-format sparse data, densified.
+
+    Reference: ``src/io/iter_libsvm.cc`` — the reference keeps CSR end to
+    end for the sparse-PS path; on TPU sparse inputs densify at the host
+    boundary (XLA wants static shapes; embedding-style models use
+    ``ops.tensor.embedding`` instead of CSR matmul).
+    Line format: ``label idx:val idx:val ...``.  ``indexing``: 'zero',
+    'one' (the LibSVM standard), or 'auto' (one-based unless any index 0 is
+    seen).  Out-of-range indices raise.
+    """
+
+    def __init__(self, data_libsvm: str, data_shape: Sequence[int],
+                 batch_size: int = 32, indexing: str = "auto", **kw):
+        if indexing not in ("auto", "zero", "one"):
+            raise ValueError(f"indexing {indexing!r}")
+        num_features = int(np.prod(data_shape))
+        entries, labels = [], []
+        min_idx = None
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                pairs = []
+                for tok in parts[1:]:
+                    idx, val = tok.split(":")
+                    idx = int(idx)
+                    min_idx = idx if min_idx is None else min(min_idx, idx)
+                    pairs.append((idx, float(val)))
+                entries.append(pairs)
+        if indexing == "auto":
+            indexing = "zero" if min_idx == 0 else "one"
+        offset = 1 if indexing == "one" else 0
+        rows = []
+        for pairs in entries:
+            row = np.zeros(num_features, np.float32)
+            for idx, val in pairs:
+                j = idx - offset
+                if not 0 <= j < num_features:
+                    raise ValueError(
+                        f"LibSVM index {idx} out of range for "
+                        f"{num_features} features ({indexing}-based)")
+                row[j] = val
+            rows.append(row)
+        data = np.asarray(rows, np.float32).reshape(
+            (-1,) + tuple(data_shape))
+        super().__init__(data, np.asarray(labels, np.float32), batch_size,
+                         **kw)
+
+
 class ResizeIter(DataIter):
     """Clamp an underlying iterator to exactly ``size`` batches per epoch,
     refilling from a fresh pass when the inner iterator is exhausted.

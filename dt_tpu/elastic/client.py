@@ -41,6 +41,7 @@ class WorkerClient:
                           "is_new": is_new})
         self.rank: int = resp["rank"]
         self.workers: List[str] = resp["workers"]
+        self._ar_seq: Dict[str, int] = {}
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval_s,),
@@ -51,9 +52,23 @@ class WorkerClient:
     def num_workers(self) -> int:
         return len(self.workers)
 
-    def _req(self, msg: dict, timeout: float = 600.0) -> dict:
-        resp = protocol.request(self.addr[0], self.addr[1], msg,
-                                timeout=timeout)
+    def _req(self, msg: dict, timeout: float = 600.0,
+             retries: int = 5) -> dict:
+        """Request with at-least-once retry — the Resender role
+        (``ps-lite/src/resender.h``).  Safe because the scheduler's
+        fault-injection drop happens before dispatch, and barrier/registry
+        handlers are idempotent for re-sent requests."""
+        delay = 0.2
+        for attempt in range(retries):
+            try:
+                resp = protocol.request(self.addr[0], self.addr[1], msg,
+                                        timeout=timeout)
+                break
+            except (ConnectionError, socket.timeout, OSError):
+                if attempt == retries - 1:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 5.0)
         if "error" in resp:
             raise RuntimeError(f"scheduler error: {resp['error']}")
         return resp
@@ -95,11 +110,17 @@ class WorkerClient:
         """Exact average across live workers (CPU-cluster data plane; on a
         TPU pod gradients ride ICI inside the jit step instead).  ``value``
         is an array, or a ``{"packed", "n", "threshold"}`` dict for
-        2-bit-compressed gradients (scheduler dequantizes before merging)."""
+        2-bit-compressed gradients (scheduler dequantizes before merging).
+
+        Each call carries a per-host sequence number so an at-least-once
+        retry of a lost RESPONSE is served the cached result instead of
+        being mistaken for the next round's contribution."""
         if not isinstance(value, dict):
             value = np.asarray(value)
+        seq = self._ar_seq.get(key, 0)
+        self._ar_seq[key] = seq + 1
         return self._req({"cmd": "allreduce", "host": self.host, "key": key,
-                          "value": value})["value"]
+                          "seq": seq, "value": value})["value"]
 
     def close(self):
         self._stop.set()

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import socket
 import threading
 import time
@@ -40,6 +41,7 @@ import numpy as np
 from dt_tpu.elastic import protocol
 
 logger = logging.getLogger("dt_tpu.elastic")
+_drop_rng = random.Random(0xD207)  # deterministic fault injection
 
 
 class Scheduler:
@@ -122,6 +124,13 @@ class Scheduler:
         with conn:
             try:
                 msg = protocol.recv_msg(conn)
+                # Fault injection: DT_DROP_MSG=<percent> drops received
+                # requests BEFORE dispatch (the ps-lite PS_DROP_MSG
+                # transport fuzz, van.cc:430-431,563-570); clients retry.
+                drop = os.environ.get("DT_DROP_MSG")
+                if drop and _drop_rng.random() * 100 < float(drop):
+                    logger.debug("DT_DROP_MSG: dropping %s", msg.get("cmd"))
+                    return
                 resp = self._dispatch(msg)
                 protocol.send_msg(conn, resp)
             except (ConnectionError, OSError):
@@ -167,7 +176,8 @@ class Scheduler:
         if cmd == "num_dead":
             return {"count": self._num_dead(float(msg.get("timeout_s", 60)))}
         if cmd == "allreduce":
-            return self._allreduce(msg["host"], msg["key"], msg["value"])
+            return self._allreduce(msg["host"], msg["key"], msg["value"],
+                                   int(msg.get("seq", -1)))
         if cmd == "membership":
             with self._lock:
                 return {"workers": list(self._workers)}
@@ -328,13 +338,18 @@ class Scheduler:
                     raise TimeoutError("barrier stuck")
             return {}
 
-    def _allreduce(self, host: str, key: str, value) -> dict:
+    def _allreduce(self, host: str, key: str, value, seq: int = -1) -> dict:
         """Average ``value`` across all live workers (one round per key-use,
         mirroring server-side merged/NumWorkers(),
         ``kvstore_dist_server.h:345-379``).  A dict value
         ``{"packed", "n", "threshold"}`` is a 2-bit-compressed gradient:
         dequantize before merging, exactly like the server's
-        DataHandleCompressed (``kvstore_dist_server.h:606-673``)."""
+        DataHandleCompressed (``kvstore_dist_server.h:606-673``).
+
+        ``seq`` makes retries idempotent: a re-sent (host, seq) whose round
+        already completed is served the cached result rather than being
+        folded into the next generation (at-least-once delivery safety,
+        the Resender's ACK-dedup role, ``ps-lite/src/resender.h``)."""
         if isinstance(value, dict) and "packed" in value:
             from dt_tpu.parallel.compression import np_dequantize_2bit
             arr = np_dequantize_2bit(np.asarray(value["packed"]),
@@ -343,13 +358,18 @@ class Scheduler:
         else:
             arr = np.asarray(value)
         with self._cv:
-            slot = self._reduce.setdefault(key, {"vals": {}, "gen": 0,
-                                                 "result": None})
+            slot = self._reduce.setdefault(
+                key, {"vals": {}, "gen": 0, "result": None, "served": {}})
+            served = slot["served"].get(host)
+            if seq >= 0 and served is not None and served[0] == seq:
+                return {"value": served[1]}  # retry of a completed round
             gen = slot["gen"]
-            slot["vals"][host] = arr
+            slot["vals"][host] = (seq, arr)
             if set(slot["vals"]) >= set(self._workers):
-                stacked = [slot["vals"][h] for h in self._workers]
+                stacked = [slot["vals"][h][1] for h in self._workers]
                 slot["result"] = np.mean(stacked, axis=0)
+                for h, (h_seq, _) in slot["vals"].items():
+                    slot["served"][h] = (h_seq, slot["result"])
                 slot["vals"] = {}
                 slot["gen"] += 1
                 self._cv.notify_all()

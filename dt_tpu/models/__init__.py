@@ -23,6 +23,8 @@ from dt_tpu.models.mobilenet import MobileNetV1 as MobileNetV1, MobileNetV2 as M
 from dt_tpu.models.densenet import DenseNet as DenseNet
 from dt_tpu.models.squeezenet import SqueezeNet as SqueezeNet
 from dt_tpu.models.googlenet import GoogLeNet as GoogLeNet
+from dt_tpu.models.inception_v4 import (InceptionBN as InceptionBN,
+                                        InceptionV4 as InceptionV4)
 from dt_tpu.models.resnext import ResNeXt as ResNeXt
 from dt_tpu.models.lstm_lm import LSTMLanguageModel as LSTMLanguageModel
 
@@ -60,6 +62,8 @@ def _setup_registry():
         register(f"resnet{d}", lambda d=d, **kw: CifarResNet(depth=d, **kw))
     register("inception_v3", lambda **kw: InceptionV3(**kw))
     register("googlenet", lambda **kw: GoogLeNet(**kw))
+    register("inception_bn", lambda **kw: InceptionBN(**kw))
+    register("inception_v4", lambda **kw: InceptionV4(**kw))
     for d in (50, 101, 152):
         register(f"resnext{d}", lambda d=d, **kw: ResNeXt(depth=d, **kw))
     register("mobilenet", lambda **kw: MobileNetV1(**kw))

@@ -1,0 +1,208 @@
+"""Inception-BN (v2) and Inception-v4.
+
+Reference: ``example/image-classification/symbols/inception-bn.py`` and
+``symbols/inception-v4.py`` (Ioffe & Szegedy 2015; Szegedy et al. 2016).
+"""
+
+from typing import Any
+
+import flax.linen as linen
+import jax.numpy as jnp
+
+from dt_tpu.models.common import ConvBN
+from dt_tpu.ops import nn as ops
+
+
+class InceptionBNBlock(linen.Module):
+    """3a-style mixed block with BN on every conv (inception-bn.py)."""
+    c1: int
+    c3r: int
+    c3: int
+    cd3r: int
+    cd3: int
+    cp: int
+    pool: str = "avg"
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        branches = []
+        if self.c1 > 0:
+            branches.append(ConvBN(self.c1, (1, 1), dtype=d)(x, training))
+        b3 = ConvBN(self.c3r, (1, 1), dtype=d)(x, training)
+        branches.append(ConvBN(self.c3, (3, 3), dtype=d)(b3, training))
+        bd3 = ConvBN(self.cd3r, (1, 1), dtype=d)(x, training)
+        bd3 = ConvBN(self.cd3, (3, 3), dtype=d)(bd3, training)
+        branches.append(ConvBN(self.cd3, (3, 3), dtype=d)(bd3, training))
+        bp = ops.avg_pool2d(x, 3, 1, padding=1) if self.pool == "avg" \
+            else ops.max_pool2d(x, 3, 1, padding=1)
+        if self.cp > 0:
+            bp = ConvBN(self.cp, (1, 1), dtype=d)(bp, training)
+        branches.append(bp)
+        return jnp.concatenate(branches, axis=-1)
+
+
+class InceptionBNDownsample(linen.Module):
+    c3r: int
+    c3: int
+    cd3r: int
+    cd3: int
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        b3 = ConvBN(self.c3r, (1, 1), dtype=d)(x, training)
+        b3 = ConvBN(self.c3, (3, 3), (2, 2), dtype=d)(b3, training)
+        bd3 = ConvBN(self.cd3r, (1, 1), dtype=d)(x, training)
+        bd3 = ConvBN(self.cd3, (3, 3), dtype=d)(bd3, training)
+        bd3 = ConvBN(self.cd3, (3, 3), (2, 2), dtype=d)(bd3, training)
+        bp = ops.max_pool2d(x, 3, 2, padding=1)
+        return jnp.concatenate([b3, bd3, bp], axis=-1)
+
+
+class InceptionBN(linen.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        d = self.dtype
+        x = ConvBN(64, (7, 7), (2, 2), dtype=d)(x, training)
+        x = ops.max_pool2d(x, 3, 2, padding=1)
+        x = ConvBN(64, (1, 1), dtype=d)(x, training)
+        x = ConvBN(192, (3, 3), dtype=d)(x, training)
+        x = ops.max_pool2d(x, 3, 2, padding=1)
+        x = InceptionBNBlock(64, 64, 64, 64, 96, 32, "avg", d)(x, training)
+        x = InceptionBNBlock(64, 64, 96, 64, 96, 64, "avg", d)(x, training)
+        x = InceptionBNDownsample(128, 160, 64, 96, d)(x, training)
+        x = InceptionBNBlock(224, 64, 96, 96, 128, 128, "avg", d)(x, training)
+        x = InceptionBNBlock(192, 96, 128, 96, 128, 128, "avg", d)(x, training)
+        x = InceptionBNBlock(160, 128, 160, 128, 160, 128, "avg", d)(x, training)
+        x = InceptionBNBlock(96, 128, 192, 160, 192, 128, "avg", d)(x, training)
+        x = InceptionBNDownsample(128, 192, 192, 256, d)(x, training)
+        x = InceptionBNBlock(352, 192, 320, 160, 224, 128, "avg", d)(x, training)
+        x = InceptionBNBlock(352, 192, 320, 192, 224, 128, "max", d)(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        return linen.Dense(self.num_classes, dtype=d)(x)
+
+
+# ----- Inception-v4 ---------------------------------------------------------
+
+
+class _StemV4(linen.Module):
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        x = ConvBN(32, (3, 3), (2, 2), "VALID", dtype=d)(x, training)
+        x = ConvBN(32, (3, 3), padding="VALID", dtype=d)(x, training)
+        x = ConvBN(64, (3, 3), dtype=d)(x, training)
+        a = ops.max_pool2d(x, 3, 2)
+        b = ConvBN(96, (3, 3), (2, 2), "VALID", dtype=d)(x, training)
+        x = jnp.concatenate([a, b], axis=-1)
+        a = ConvBN(64, (1, 1), dtype=d)(x, training)
+        a = ConvBN(96, (3, 3), padding="VALID", dtype=d)(a, training)
+        b = ConvBN(64, (1, 1), dtype=d)(x, training)
+        b = ConvBN(64, (7, 1), dtype=d)(b, training)
+        b = ConvBN(64, (1, 7), dtype=d)(b, training)
+        b = ConvBN(96, (3, 3), padding="VALID", dtype=d)(b, training)
+        x = jnp.concatenate([a, b], axis=-1)
+        a = ConvBN(192, (3, 3), (2, 2), "VALID", dtype=d)(x, training)
+        b = ops.max_pool2d(x, 3, 2)
+        return jnp.concatenate([a, b], axis=-1)
+
+
+class _BlockA4(linen.Module):
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        b1 = ops.avg_pool2d(x, 3, 1, padding=1)
+        b1 = ConvBN(96, (1, 1), dtype=d)(b1, training)
+        b2 = ConvBN(96, (1, 1), dtype=d)(x, training)
+        b3 = ConvBN(64, (1, 1), dtype=d)(x, training)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, training)
+        b4 = ConvBN(64, (1, 1), dtype=d)(x, training)
+        b4 = ConvBN(96, (3, 3), dtype=d)(b4, training)
+        b4 = ConvBN(96, (3, 3), dtype=d)(b4, training)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class _BlockB4(linen.Module):
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        b1 = ops.avg_pool2d(x, 3, 1, padding=1)
+        b1 = ConvBN(128, (1, 1), dtype=d)(b1, training)
+        b2 = ConvBN(384, (1, 1), dtype=d)(x, training)
+        b3 = ConvBN(192, (1, 1), dtype=d)(x, training)
+        b3 = ConvBN(224, (1, 7), dtype=d)(b3, training)
+        b3 = ConvBN(256, (7, 1), dtype=d)(b3, training)
+        b4 = ConvBN(192, (1, 1), dtype=d)(x, training)
+        b4 = ConvBN(192, (1, 7), dtype=d)(b4, training)
+        b4 = ConvBN(224, (7, 1), dtype=d)(b4, training)
+        b4 = ConvBN(224, (1, 7), dtype=d)(b4, training)
+        b4 = ConvBN(256, (7, 1), dtype=d)(b4, training)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class _BlockC4(linen.Module):
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        b1 = ops.avg_pool2d(x, 3, 1, padding=1)
+        b1 = ConvBN(256, (1, 1), dtype=d)(b1, training)
+        b2 = ConvBN(256, (1, 1), dtype=d)(x, training)
+        b3 = ConvBN(384, (1, 1), dtype=d)(x, training)
+        b3a = ConvBN(256, (1, 3), dtype=d)(b3, training)
+        b3b = ConvBN(256, (3, 1), dtype=d)(b3, training)
+        b4 = ConvBN(384, (1, 1), dtype=d)(x, training)
+        b4 = ConvBN(448, (1, 3), dtype=d)(b4, training)
+        b4 = ConvBN(512, (3, 1), dtype=d)(b4, training)
+        b4a = ConvBN(256, (3, 1), dtype=d)(b4, training)
+        b4b = ConvBN(256, (1, 3), dtype=d)(b4, training)
+        return jnp.concatenate([b1, b2, b3a, b3b, b4a, b4b], axis=-1)
+
+
+class InceptionV4(linen.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        d = self.dtype
+        x = _StemV4(d)(x, training)
+        for _ in range(4):
+            x = _BlockA4(d)(x, training)
+        # reduction A
+        a = ConvBN(384, (3, 3), (2, 2), "VALID", dtype=d)(x, training)
+        b = ConvBN(192, (1, 1), dtype=d)(x, training)
+        b = ConvBN(224, (3, 3), dtype=d)(b, training)
+        b = ConvBN(256, (3, 3), (2, 2), "VALID", dtype=d)(b, training)
+        c = ops.max_pool2d(x, 3, 2)
+        x = jnp.concatenate([a, b, c], axis=-1)
+        for _ in range(7):
+            x = _BlockB4(d)(x, training)
+        # reduction B
+        a = ConvBN(192, (1, 1), dtype=d)(x, training)
+        a = ConvBN(192, (3, 3), (2, 2), "VALID", dtype=d)(a, training)
+        b = ConvBN(256, (1, 1), dtype=d)(x, training)
+        b = ConvBN(256, (1, 7), dtype=d)(b, training)
+        b = ConvBN(320, (7, 1), dtype=d)(b, training)
+        b = ConvBN(320, (3, 3), (2, 2), "VALID", dtype=d)(b, training)
+        c = ops.max_pool2d(x, 3, 2)
+        x = jnp.concatenate([a, b, c], axis=-1)
+        for _ in range(3):
+            x = _BlockC4(d)(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        x = ops.dropout(x, 0.2, training=training,
+                        rng=self.make_rng("dropout") if training else None)
+        return linen.Dense(self.num_classes, dtype=d)(x)

@@ -303,6 +303,11 @@ class Module:
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
+            # Metric updates run ONE STEP BEHIND: step N+1 is dispatched
+            # before step N's logits are fetched to host, so the device
+            # pipeline never drains for metrics (the async-dispatch analog
+            # of the reference engine's compute/update overlap, SURVEY §3.4).
+            pending = None  # (label_np, n_real, logits_device)
             while True:
                 try:
                     batch = train_data.next()
@@ -342,17 +347,17 @@ class Module:
                 else:
                     self.state, loss, logits = self._train_step(
                         self.state, data, labels, rng)
-                # metric update excludes pad examples (reference
-                # DataBatch.pad semantics)
-                n_real = batch.data.shape[0] - batch.pad
-                probs = _softmax_np(np.asarray(jax.device_get(logits)))
-                eval_metric.update(np.asarray(batch.label)[:n_real],
-                                   probs[:n_real])
-                nbatch += 1
-                if batch_end_callback is not None:
-                    p = callbacks_lib.BatchEndParam(epoch, nbatch, eval_metric)
-                    for cb in batch_end_callback:
-                        cb(p)
+                # flush the PREVIOUS step's metric + its callback (its
+                # logits are ready by now; this step already runs on device)
+                if pending is not None:
+                    nbatch = self._flush_metric(pending, eval_metric, epoch,
+                                                nbatch, batch_end_callback)
+                # pad examples excluded (reference DataBatch.pad semantics)
+                pending = (np.asarray(batch.label),
+                           batch.data.shape[0] - batch.pad, logits)
+            if pending is not None:  # final step's metric + callback
+                nbatch = self._flush_metric(pending, eval_metric, epoch,
+                                            nbatch, batch_end_callback)
 
             if eval_metric.num_inst > 0:  # empty when Speedometer auto_reset
                 for name, val in eval_metric.get_name_value():
@@ -375,6 +380,21 @@ class Module:
                     eval_end_callback(epoch, validation_metric)
 
         return eval_metric
+
+    def _flush_metric(self, pending, eval_metric, epoch, nbatch,
+                      batch_end_callback):
+        """Account one completed batch: metric update, then its batch-end
+        callback — same ordering as the reference's synchronous loop, just
+        deferred one step so device dispatch never drains for metrics."""
+        lab, n_real, lg = pending
+        probs = _softmax_np(np.asarray(jax.device_get(lg)))
+        eval_metric.update(lab[:n_real], probs[:n_real])
+        nbatch += 1
+        if batch_end_callback is not None:
+            p = callbacks_lib.BatchEndParam(epoch, nbatch, eval_metric)
+            for cb in batch_end_callback:
+                cb(p)
+        return nbatch
 
     def _publish_snapshot(self):
         """Push the live TrainState to the elastic controller — the role the

@@ -1,0 +1,45 @@
+"""Model summaries.
+
+Reference: ``python/mxnet/visualization.py`` (``print_summary`` layer table;
+``plot_network`` graphviz).  ``print_summary`` maps to flax's tabulate;
+``plot_network``'s graph role is served by jax's own HLO/StableHLO dumps
+(``jax.jit(f).lower(...).as_text()``), exposed here as ``dump_hlo``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def print_summary(model, sample_input, training: bool = False,
+                  console_kwargs: Optional[dict] = None) -> str:
+    """Layer table with shapes/params (reference ``mx.viz.print_summary``)."""
+    tab = model.tabulate(
+        {"params": jax.random.PRNGKey(0)}, jnp.asarray(sample_input),
+        training=training,
+        console_kwargs=console_kwargs or {"width": 120})
+    print(tab)
+    return tab
+
+
+def param_summary(variables) -> dict:
+    """{'total': n, 'by_collection': {...}} parameter counts."""
+    out = {"total": 0, "by_collection": {}}
+    for coll, tree in variables.items():
+        n = sum(int(jnp.size(x)) for x in jax.tree_util.tree_leaves(tree))
+        out["by_collection"][coll] = n
+        out["total"] += n
+    return out
+
+
+def dump_hlo(fn, *example_args, stage: str = "stablehlo") -> str:
+    """Compiled-graph dump (the plot_network analog for XLA).
+
+    ``stage``: 'stablehlo' (lowered) or 'optimized' (post-XLA-passes)."""
+    lowered = jax.jit(fn).lower(*example_args)
+    if stage == "optimized":
+        return lowered.compile().as_text()
+    return lowered.as_text()

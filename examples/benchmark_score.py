@@ -1,0 +1,68 @@
+"""Forward-inference throughput sweep across the model zoo.
+
+Reference: ``example/image-classification/benchmark_score.py`` (symbolic fwd
+speed per model at several batch sizes — the harness behind the published
+img/s tables in BASELINE.md).
+
+    python examples/benchmark_score.py --networks resnet50,resnet152 \
+        --batch-sizes 1,32 --dtype bfloat16
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser("benchmark_score")
+    ap.add_argument("--networks",
+                    default="alexnet,vgg16,resnet50,resnet152,inception-v3,"
+                            "mobilenet,densenet121")
+    ap.add_argument("--batch-sizes", default="1,16,32")
+    ap.add_argument("--image-shape", default="224,224,3")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu import models
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+
+    for name in args.networks.split(","):
+        ishape = (299, 299, 3) if name.startswith("inception") and \
+            "bn" not in name else shape
+        model = models.create(name, num_classes=1000, dtype=dtype)
+        # params are batch-size independent: init once per network
+        variables = model.init({"params": jax.random.PRNGKey(0)},
+                               jnp.ones((1,) + ishape, dtype),
+                               training=False)
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            x = jnp.asarray(np.random.RandomState(0)
+                            .uniform(-1, 1, (bs,) + ishape), dtype)
+
+            @jax.jit
+            def fwd(v, x):
+                return model.apply(v, x, training=False)
+
+            jax.block_until_ready(fwd(variables, x))  # compile
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = fwd(variables, x)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            print(f"network: {name:16s} batch: {bs:4d}  "
+                  f"{bs * args.iters / dt:10.2f} images/sec")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Tests: fault injection + retries, LibSVM iter, visualization,
+inception-bn/v4, fit pipelining correctness."""
+
+import logging
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dt_tpu import data, models
+from dt_tpu.elastic import Scheduler, WorkerClient
+
+
+def test_drop_msg_fault_injection_with_retries(monkeypatch):
+    """PS_DROP_MSG analog: 30% of control messages dropped; retries keep
+    the protocol exact (the transport-fuzz test, SURVEY §5.2)."""
+    monkeypatch.setenv("DT_DROP_MSG", "30")
+    s = Scheduler(initial_workers=["a", "b"])
+    try:
+        ca = WorkerClient("127.0.0.1", s.port, host="a", is_new=False)
+        cb = WorkerClient("127.0.0.1", s.port, host="b", is_new=False)
+        outs = {}
+
+        def push(c, v):
+            outs[c.host] = c.allreduce("g", np.full(4, v, np.float32))
+
+        for rnd in range(3):  # several rounds under drops
+            outs.clear()
+            ts = [threading.Thread(target=push, args=(c, i + 1.0))
+                  for i, c in enumerate((ca, cb))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            np.testing.assert_allclose(outs["a"], 1.5)
+            np.testing.assert_allclose(outs["b"], 1.5)
+    finally:
+        s.close()
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "data.svm"
+    p.write_text("1 0:0.5 3:1.5\n0 1:2.0\n1 2:3.0 0:1.0\n")
+    it = data.LibSVMIter(str(p), data_shape=(4,), batch_size=2,
+                         last_batch_handle="pad")
+    b = it.next()
+    np.testing.assert_allclose(b.data[0], [0.5, 0, 0, 1.5])
+    np.testing.assert_allclose(b.label[:2], [1, 0])
+    # one-based (the LibSVM standard) auto-detected when no 0 index appears
+    p1 = tmp_path / "one.svm"
+    p1.write_text("1 1:0.5 4:1.5\n")
+    it1 = data.LibSVMIter(str(p1), data_shape=(4,), batch_size=1)
+    np.testing.assert_allclose(it1.next().data[0], [0.5, 0, 0, 1.5])
+    # out-of-range raises instead of silently wrapping
+    pbad = tmp_path / "bad.svm"
+    pbad.write_text("1 7:2.0\n")
+    with pytest.raises(ValueError, match="out of range"):
+        data.LibSVMIter(str(pbad), data_shape=(4,), batch_size=1)
+
+
+def test_inception_bn_and_v4_forward():
+    for name, size in (("inception_bn", 64), ("inception_v4", 299)):
+        model = models.create(name, num_classes=4)
+        x = jnp.ones((1, size, size, 3))
+        rngs = {"params": jax.random.PRNGKey(0),
+                "dropout": jax.random.PRNGKey(1)}
+        variables = model.init(rngs, x, training=False)
+        out = model.apply(variables, x, training=False)
+        assert out.shape == (1, 4), name
+
+
+def test_visualization_summary():
+    from dt_tpu import visualization as viz
+    model = models.create("mlp", num_classes=3, hidden=(8,))
+    x = np.ones((1, 4, 4, 1), np.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           jnp.asarray(x), training=False)
+    counts = viz.param_summary(variables)
+    assert counts["total"] > 0
+    hlo = viz.dump_hlo(
+        lambda v, x: model.apply(v, x, training=False), variables,
+        jnp.asarray(x))
+    assert "dot" in hlo or "stablehlo" in hlo or "func" in hlo
+
+
+def test_fit_metric_pipelining_counts_all_batches():
+    """The one-step-behind metric update must still account every batch
+    (incl. the final one)."""
+    from dt_tpu.training import Module, metrics
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (48, 4, 4, 1)).astype(np.float32)
+    y = (x.mean((1, 2, 3)) > 0).astype(np.int32)
+    train = data.NDArrayIter(x, y, batch_size=16)
+    mod = Module(models.create("mlp", num_classes=2, hidden=(4,)))
+    m = mod.fit(train, num_epoch=1, eval_metric="acc")
+    assert m.num_inst == 48  # 3 batches x 16, none skipped
