@@ -162,13 +162,15 @@ class LibSVMIter(NDArrayIter):
     end for the sparse-PS path; on TPU sparse inputs densify at the host
     boundary (XLA wants static shapes; embedding-style models use
     ``ops.tensor.embedding`` instead of CSR matmul).
-    Line format: ``label idx:val idx:val ...``.  ``indexing``: 'zero',
-    'one' (the LibSVM standard), or 'auto' (one-based unless any index 0 is
-    seen).  Out-of-range indices raise.
+    Line format: ``label idx:val idx:val ...``.  ``indexing``: 'one' (the
+    LibSVM standard, DEFAULT — zero-based files fail loudly on index 0),
+    'zero', or 'auto' (zero-based iff an index 0 appears; note auto cannot
+    distinguish a zero-based file that never uses feature 0).  Out-of-range
+    indices raise.
     """
 
     def __init__(self, data_libsvm: str, data_shape: Sequence[int],
-                 batch_size: int = 32, indexing: str = "auto", **kw):
+                 batch_size: int = 32, indexing: str = "one", **kw):
         if indexing not in ("auto", "zero", "one"):
             raise ValueError(f"indexing {indexing!r}")
         num_features = int(np.prod(data_shape))

@@ -53,7 +53,7 @@ class WorkerClient:
         return len(self.workers)
 
     def _req(self, msg: dict, timeout: float = 600.0,
-             retries: int = 5) -> dict:
+             retries: int = 8) -> dict:
         """Request with at-least-once retry — the Resender role
         (``ps-lite/src/resender.h``).  Safe because the scheduler's
         fault-injection drop happens before dispatch, and barrier/registry
@@ -95,7 +95,9 @@ class WorkerClient:
         self.rank = resp["rank"]
 
     def barrier(self) -> None:
-        self._req({"cmd": "barrier", "host": self.host})
+        seq = self._ar_seq.get("__barrier__", 0)
+        self._ar_seq["__barrier__"] = seq + 1
+        self._req({"cmd": "barrier", "host": self.host, "seq": seq})
 
     def publish_snapshot(self, blob) -> None:
         self._req({"cmd": "publish_snapshot", "blob": blob})

@@ -90,6 +90,7 @@ class Scheduler:
         # plain barrier
         self._plain_arrived: Set[str] = set()
         self._plain_gen = 0
+        self._plain_served: Dict[str, int] = {}
         # snapshot
         self._snapshot = None
         self._snapshot_lock = threading.Lock()
@@ -165,7 +166,8 @@ class Scheduler:
             return self._mc_barrier(msg["host"], int(msg["epoch"]),
                                     msg.get("info") or {})
         if cmd == "barrier":
-            return self._plain_barrier(msg["host"])
+            return self._plain_barrier(msg["host"],
+                                       int(msg.get("seq", -1)))
         if cmd == "publish_snapshot":
             with self._snapshot_lock:
                 self._snapshot = msg["blob"]
@@ -324,10 +326,16 @@ class Scheduler:
     # plain barrier + exact-average allreduce (CPU-cluster data plane)
     # ------------------------------------------------------------------
 
-    def _plain_barrier(self, host: str) -> dict:
+    def _plain_barrier(self, host: str, seq: int = -1) -> dict:
+        """Plain barrier; ``seq`` dedups at-least-once retries (a re-sent
+        request whose generation already released returns immediately
+        instead of polluting the next generation)."""
         with self._cv:
+            if seq >= 0 and self._plain_served.get(host) == seq:
+                return {}  # retry of a released barrier
             gen = self._plain_gen
             self._plain_arrived.add(host)
+            self._plain_served[host] = seq
             if self._plain_arrived >= set(self._workers):
                 self._plain_arrived = set()
                 self._plain_gen += 1

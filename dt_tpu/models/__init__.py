@@ -27,6 +27,7 @@ from dt_tpu.models.inception_v4 import (InceptionBN as InceptionBN,
                                         InceptionV4 as InceptionV4)
 from dt_tpu.models.resnext import ResNeXt as ResNeXt
 from dt_tpu.models.lstm_lm import LSTMLanguageModel as LSTMLanguageModel
+from dt_tpu.models.transformer import TransformerLM as TransformerLM
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {}
 
@@ -72,6 +73,7 @@ def _setup_registry():
         register(f"densenet{d}", lambda d=d, **kw: DenseNet(depth=d, **kw))
     register("squeezenet", lambda **kw: SqueezeNet(**kw))
     register("lstm_lm", lambda **kw: LSTMLanguageModel(**kw))
+    register("transformer_lm", lambda **kw: TransformerLM(**kw))
 
 
 _setup_registry()

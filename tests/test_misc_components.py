@@ -45,15 +45,18 @@ def test_libsvm_iter(tmp_path):
     p = tmp_path / "data.svm"
     p.write_text("1 0:0.5 3:1.5\n0 1:2.0\n1 2:3.0 0:1.0\n")
     it = data.LibSVMIter(str(p), data_shape=(4,), batch_size=2,
-                         last_batch_handle="pad")
+                         indexing="zero", last_batch_handle="pad")
     b = it.next()
     np.testing.assert_allclose(b.data[0], [0.5, 0, 0, 1.5])
     np.testing.assert_allclose(b.label[:2], [1, 0])
-    # one-based (the LibSVM standard) auto-detected when no 0 index appears
+    # one-based is the DEFAULT (LibSVM standard)
     p1 = tmp_path / "one.svm"
     p1.write_text("1 1:0.5 4:1.5\n")
     it1 = data.LibSVMIter(str(p1), data_shape=(4,), batch_size=1)
     np.testing.assert_allclose(it1.next().data[0], [0.5, 0, 0, 1.5])
+    # zero-based file under the one-based default fails loudly on index 0
+    with pytest.raises(ValueError, match="out of range"):
+        data.LibSVMIter(str(p), data_shape=(4,), batch_size=1)
     # out-of-range raises instead of silently wrapping
     pbad = tmp_path / "bad.svm"
     pbad.write_text("1 7:2.0\n")
