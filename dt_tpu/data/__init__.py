@@ -21,6 +21,15 @@ from dt_tpu.data.io import (
     ElasticDataIterator as ElasticDataIterator,
 )
 from dt_tpu.data import augment as augment
+from dt_tpu.data.mnist import MNISTIter as MNISTIter
+from dt_tpu.data.dataset import (
+    Dataset as Dataset,
+    ArrayDataset as ArrayDataset,
+    DataLoader as DataLoader,
+    RandomSampler as RandomSampler,
+    SequentialSampler as SequentialSampler,
+)
+from dt_tpu.data.bucket_io import BucketSentenceIter as BucketSentenceIter
 from dt_tpu.data.recordio import (
     RecordIOReader as RecordIOReader,
     RecordIOWriter as RecordIOWriter,

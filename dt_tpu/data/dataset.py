@@ -1,0 +1,173 @@
+"""Gluon-style Dataset / DataLoader.
+
+Reference: ``python/mxnet/gluon/data/`` — ``Dataset`` (random access),
+``ArrayDataset``, transforms, ``Sampler`` zoo, ``DataLoader`` (batchify +
+shuffle + multi-worker prefetch).  Worker processes become a prefetch
+thread here (host-side batching is numpy; the heavy decode work already
+releases the GIL in PIL/numpy, and device feeding is the jit step's job).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from dt_tpu.data.io import DataBatch, DataIter, PrefetchingIter
+
+
+class Dataset:
+    """Random-access dataset (reference ``gluon.data.Dataset``)."""
+
+    def __getitem__(self, idx: int):
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def transform(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        return _TransformedDataset(self, fn)
+
+    def transform_first(self, fn: Callable) -> "Dataset":
+        return self.transform(lambda *items: (fn(items[0]),) + items[1:])
+
+
+class _TransformedDataset(Dataset):
+    def __init__(self, base: Dataset, fn: Callable):
+        self._base = base
+        self._fn = fn
+
+    def __getitem__(self, idx):
+        item = self._base[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+    def __len__(self):
+        return len(self._base)
+
+
+class ArrayDataset(Dataset):
+    """Zip of equal-length arrays (reference ``gluon.data.ArrayDataset``)."""
+
+    def __init__(self, *arrays):
+        assert arrays and all(len(a) == len(arrays[0]) for a in arrays)
+        self._arrays = arrays
+
+    def __getitem__(self, idx):
+        out = tuple(a[idx] for a in self._arrays)
+        return out if len(out) > 1 else out[0]
+
+    def __len__(self):
+        return len(self._arrays[0])
+
+
+class Sampler:
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+
+class SequentialSampler(Sampler):
+    def __init__(self, length: int):
+        self._n = length
+
+    def __iter__(self):
+        return iter(range(self._n))
+
+    def __len__(self):
+        return self._n
+
+
+class RandomSampler(Sampler):
+    def __init__(self, length: int, seed: int = 0):
+        self._n = length
+        self._seed = seed
+        self._epoch = 0
+
+    def __iter__(self):
+        rng = np.random.RandomState(self._seed + self._epoch)
+        self._epoch += 1
+        return iter(rng.permutation(self._n).tolist())
+
+    def __len__(self):
+        return self._n
+
+
+def default_batchify(items: List) -> DataBatch:
+    """Stack tuple items column-wise (reference ``default_batchify_fn``).
+
+    1 column -> ``DataBatch(data)``; 2 -> ``(data, label)``; 3+ ->
+    ``label`` is the tuple of all remaining stacked columns (nothing is
+    dropped; supply a custom ``batchify_fn`` for other layouts)."""
+    if isinstance(items[0], tuple):
+        cols = list(zip(*items))
+        arrs = [np.stack([np.asarray(x) for x in col]) for col in cols]
+        if len(arrs) == 1:
+            return DataBatch(arrs[0], None, 0)
+        if len(arrs) == 2:
+            return DataBatch(arrs[0], arrs[1], 0)
+        return DataBatch(arrs[0], tuple(arrs[1:]), 0)
+    return DataBatch(np.stack([np.asarray(x) for x in items]), None, 0)
+
+
+class DataLoader(DataIter):
+    """Reference ``gluon.data.DataLoader``: dataset + sampler -> batches;
+    ``num_workers > 0`` enables background prefetch; ``last_batch`` in
+    {'keep','discard'}."""
+
+    def __init__(self, dataset: Dataset, batch_size: int,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: str = "keep",
+                 batchify_fn: Callable = default_batchify,
+                 num_workers: int = 0, seed: int = 0):
+        super().__init__(batch_size)
+        self.dataset = dataset
+        if sampler is None:
+            sampler = RandomSampler(len(dataset), seed) if shuffle \
+                else SequentialSampler(len(dataset))
+        self.sampler = sampler
+        if last_batch not in ("keep", "discard"):
+            raise ValueError(last_batch)
+        self.last_batch = last_batch
+        self.batchify_fn = batchify_fn
+        self._inner = _LoaderIter(self)
+        self._it: DataIter = PrefetchingIter(self._inner) if num_workers \
+            else self._inner
+
+    def reset(self):
+        self._it.reset()
+
+    @property
+    def steps_per_epoch(self):
+        n = len(self.dataset)
+        return n // self.batch_size if self.last_batch == "discard" \
+            else -(-n // self.batch_size)
+
+    def next(self) -> DataBatch:
+        return self._it.next()
+
+
+class _LoaderIter(DataIter):
+    def __init__(self, loader: DataLoader):
+        super().__init__(loader.batch_size)
+        self._loader = loader
+        self._order: List[int] = []
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        self._order = list(iter(self._loader.sampler))
+        self._cursor = 0
+
+    def next(self) -> DataBatch:
+        n = len(self._order)
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        if end > n and self._loader.last_batch == "discard":
+            self._cursor = n
+            raise StopIteration
+        idx = self._order[self._cursor:end]
+        self._cursor = end
+        return self._loader.batchify_fn([self._loader.dataset[i]
+                                         for i in idx])

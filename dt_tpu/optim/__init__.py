@@ -29,6 +29,12 @@ from dt_tpu.optim.optimizers import (
     lamb as lamb,
     with_multi_precision as with_multi_precision,
 )
+from dt_tpu.optim.svrg import (
+    svrg as svrg,
+    SVRGState as SVRGState,
+    refresh_snapshot as refresh_snapshot,
+    full_gradient as full_gradient,
+)
 from dt_tpu.optim.lr_scheduler import (
     LRScheduler as LRScheduler,
     FactorScheduler as FactorScheduler,

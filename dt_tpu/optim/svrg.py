@@ -1,0 +1,69 @@
+"""SVRG — stochastic variance-reduced gradient.
+
+Reference: ``python/mxnet/contrib/svrg_optimization/`` (SVRGModule +
+SVRGOptimizer, Johnson & Zhang 2013): every ``update_freq`` epochs snapshot
+the weights and compute the FULL-dataset gradient at the snapshot; each step
+then updates with ``g(w) - g(w_snap) + full_grad`` for variance reduction.
+
+Functional shape: :class:`SVRG` holds (w_snap, full_grad) in its optax
+state; the trainer refreshes them via :meth:`snapshot` at epoch boundaries.
+The per-step corrected gradient needs ``grad_at_snapshot`` for the SAME
+batch, so the training loop computes grads twice per step (w and w_snap) —
+exactly the reference's dual-executor design (``svrg_module.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class SVRGState(NamedTuple):
+    inner: Any
+    w_snap: Any
+    full_grad: Any
+
+
+def svrg(inner: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Wrap ``inner`` (e.g. plain SGD) with SVRG variance reduction.
+
+    ``update`` expects ``grads`` to be the tuple
+    ``(batch_grad_at_w, batch_grad_at_snapshot)`` — the loop computes the
+    batch gradient twice (at the live weights and at ``state.w_snap``) and
+    refreshes the snapshot each epoch with :func:`refresh_snapshot` +
+    :func:`full_gradient`.
+    """
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return SVRGState(inner.init(params), params, zeros)
+
+    def update(grads, state, params):
+        g_w, g_snap = grads
+        corrected = jax.tree_util.tree_map(
+            lambda a, b, f: a - b + f, g_w, g_snap, state.full_grad)
+        updates, new_inner = inner.update(corrected, state.inner, params)
+        return updates, SVRGState(new_inner, state.w_snap, state.full_grad)
+
+    return optax.GradientTransformation(init, update)
+
+
+def refresh_snapshot(state: SVRGState, params, full_grad) -> SVRGState:
+    """Epoch-boundary snapshot refresh (reference ``update_full_grads``)."""
+    return SVRGState(state.inner, params, full_grad)
+
+
+def full_gradient(grad_fn: Callable, params, batches) -> Any:
+    """Average ``grad_fn(params, batch)`` over all batches (the full-dataset
+    gradient at the snapshot)."""
+    total = None
+    n = 0
+    for batch in batches:
+        g = grad_fn(params, batch)
+        total = g if total is None else jax.tree_util.tree_map(
+            jnp.add, total, g)
+        n += 1
+    return jax.tree_util.tree_map(lambda t: t / n, total)

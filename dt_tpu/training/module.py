@@ -74,7 +74,8 @@ class Module:
                  optimizer: Union[str, optax.GradientTransformation] = "sgd",
                  optimizer_params: Optional[dict] = None,
                  kvstore: Union[str, kvstore_lib.KVStore] = "local",
-                 mesh=None, mesh_manager=None, seed: int = 0):
+                 mesh=None, mesh_manager=None, seed: int = 0,
+                 remat: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         if isinstance(optimizer, str):
@@ -89,6 +90,11 @@ class Module:
         # reshards state through it (SURVEY.md §7 "mesh resize" hard part).
         self.mesh_manager = mesh_manager
         self.seed = seed
+        # Rematerialization: recompute activations in the backward pass
+        # instead of storing them — the reference's memory mirror
+        # (MXNET_BACKWARD_DO_MIRROR, SURVEY §5.6; BASELINE row 'Inception-v3
+        # w/ memory mirror'), as jax.checkpoint around the forward.
+        self.remat = remat
         self.state: Optional[TrainState] = None
         self._train_step = None
         self._eval_step = None
@@ -165,6 +171,10 @@ class Module:
                 new_stats = batch_stats
             logits = out[0] if isinstance(out, tuple) else out
             return loss_fn(logits, labels), (logits, new_stats)
+
+        if self.remat:
+            forward_loss = jax.checkpoint(forward_loss,
+                                          static_argnums=())
 
         def train_step(state: TrainState, data, labels, rng):
             dropout_rng = jax.random.fold_in(rng, state.step)
