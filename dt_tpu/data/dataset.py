@@ -26,10 +26,27 @@ class Dataset:
         raise NotImplementedError
 
     def transform(self, fn: Callable, lazy: bool = True) -> "Dataset":
-        return _TransformedDataset(self, fn)
+        """``lazy=True`` applies ``fn`` per access; ``lazy=False`` applies
+        it once now (gluon parity — errors surface immediately, cost paid
+        once)."""
+        out = _TransformedDataset(self, fn)
+        if lazy:
+            return out
+        return _ListDataset([out[i] for i in range(len(out))])
 
     def transform_first(self, fn: Callable) -> "Dataset":
         return self.transform(lambda *items: (fn(items[0]),) + items[1:])
+
+
+class _ListDataset(Dataset):
+    def __init__(self, items: List):
+        self._items = items
+
+    def __getitem__(self, idx):
+        return self._items[idx]
+
+    def __len__(self):
+        return len(self._items)
 
 
 class _TransformedDataset(Dataset):
@@ -156,7 +173,11 @@ class _LoaderIter(DataIter):
         self.reset()
 
     def reset(self):
-        self._order = list(iter(self._loader.sampler))
+        # Regenerate the order only if the current one was (partly)
+        # consumed: construction followed by a for-loop's reset() must not
+        # burn a RandomSampler epoch (reproducibility of seed -> order).
+        if self._cursor > 0 or not self._order:
+            self._order = list(iter(self._loader.sampler))
         self._cursor = 0
 
     def next(self) -> DataBatch:

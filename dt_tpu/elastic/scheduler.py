@@ -51,7 +51,9 @@ class Scheduler:
                  launch_callback: Optional[Callable[[str, int], None]] = None,
                  host_worker_log: Optional[str] = None,
                  expected_workers: Optional[int] = None,
-                 pre_change_hook: Optional[Callable[[int], None]] = None):
+                 pre_change_hook: Optional[Callable[[int], None]] = None,
+                 auto_evict_dead_s: Optional[float] = None,
+                 startup_grace_s: float = 120.0):
         """``initial_workers`` seeds the base set; else the first line-set of
         ``host_worker_file`` does (``postoffice.cc:247-259`` baseline read).
         ``launch_callback(host, epoch_begin)`` starts a worker process on
@@ -105,6 +107,23 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+        # Crash recovery beyond the reference: auto-evict workers whose
+        # heartbeats go silent for auto_evict_dead_s (the reference's
+        # GetDeadNodes only *reports*; a crashed worker would hang the
+        # synchronous job until an operator intervened).  Evicted hosts are
+        # removed from membership AND the host_worker file, pending
+        # collectives complete with the survivors, and the audit log gets a
+        # REMOVED line.  Base workers are evictable here — a crashed base
+        # worker would otherwise hang the job forever (the base-worker
+        # protection applies to operator-driven removals, not deaths).
+        self.auto_evict_dead_s = auto_evict_dead_s
+        # workers that never registered get a longer leash: process startup
+        # (python + jax import) takes seconds-to-minutes
+        self.startup_grace_s = max(startup_grace_s, auto_evict_dead_s or 0)
+        if auto_evict_dead_s:
+            self._evict_thread = threading.Thread(
+                target=self._evict_loop, daemon=True)
+            self._evict_thread.start()
         logger.info("scheduler listening on :%d, base workers %s",
                     self.port, self._workers)
 
@@ -225,6 +244,78 @@ class Scheduler:
         with self._lock:
             return sum(1 for h in self._workers
                        if now - self._heartbeats.get(h, 0.0) > timeout_s)
+
+    # ------------------------------------------------------------------
+    # dead-worker auto-eviction (crash recovery)
+    # ------------------------------------------------------------------
+
+    def _evict_loop(self):
+        period = max(self.auto_evict_dead_s / 4.0, 0.1)
+        while not self._stop.wait(period):
+            now = time.time()
+            with self._cv:
+                dead = [
+                    h for h in self._workers
+                    if now - self._heartbeats.get(h, 0.0) >
+                    (self.auto_evict_dead_s if h in self._registered
+                     else self.startup_grace_s)]
+                if not dead:
+                    continue
+                for h in dead:
+                    logger.warning("evicting dead worker %s (silent %.1fs)",
+                                   h, now - self._heartbeats.get(h, 0.0))
+                    self._workers.remove(h)
+                    self._registered.discard(h)
+                    self._removed_hosts.add(h)
+                    self._base.discard(h)
+                    self._append_log("REMOVED", h)
+                self._rewrite_host_file(dead)
+                self._complete_pending_locked()
+                self._cv.notify_all()
+
+    def _rewrite_host_file(self, evicted):
+        """Drop THIS pass's evicted hosts from host_worker so the next
+        barrier diff doesn't re-add them (atomic rewrite like the EC2
+        manager, ``launch.py:218-224``).  Only the just-evicted hosts are
+        filtered — an operator's pending re-add of a historically removed
+        host must survive.  Caller holds the lock."""
+        if not self.host_worker_file or \
+                not os.path.exists(self.host_worker_file):
+            return
+        listed = _read_hosts(self.host_worker_file)
+        kept = [h for h in listed if h not in set(evicted)]
+        if kept != listed:
+            tmp = self.host_worker_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write("\n".join(kept) + ("\n" if kept else ""))
+            os.replace(tmp, self.host_worker_file)
+
+    def _complete_pending_locked(self):
+        """After membership shrank, finish any collective now satisfied by
+        the survivors.  Caller holds the lock."""
+        live = set(self._workers)
+        # pending mc_barrier
+        if self._barrier_epoch is not None and live and \
+                self._barrier_arrived >= live:
+            epoch = self._barrier_epoch
+            result = self._apply_membership_change(epoch)
+            self._barrier_result[epoch] = result
+            self._last_completed_epoch = epoch
+            self._barrier_epoch = None
+            self._barrier_arrived = set()
+        # pending plain barrier
+        if self._plain_arrived and live and self._plain_arrived >= live:
+            self._plain_arrived = set()
+            self._plain_gen += 1
+        # pending allreduce rounds
+        for key, slot in self._reduce.items():
+            if slot["vals"] and live and set(slot["vals"]) >= live:
+                stacked = [slot["vals"][h][1] for h in self._workers]
+                slot["result"] = np.mean(stacked, axis=0)
+                for h, (h_seq, _) in slot["vals"].items():
+                    slot["served"][h] = (h_seq, slot["result"])
+                slot["vals"] = {}
+                slot["gen"] += 1
 
     # ------------------------------------------------------------------
     # membership-change barrier (the heart — SURVEY.md §3.3)

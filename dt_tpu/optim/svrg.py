@@ -56,14 +56,22 @@ def refresh_snapshot(state: SVRGState, params, full_grad) -> SVRGState:
     return SVRGState(state.inner, params, full_grad)
 
 
-def full_gradient(grad_fn: Callable, params, batches) -> Any:
+def full_gradient(grad_fn: Callable, params, batches,
+                  weights=None) -> Any:
     """Average ``grad_fn(params, batch)`` over all batches (the full-dataset
-    gradient at the snapshot)."""
+    gradient at the snapshot).
+
+    Batches are weighted equally; pass per-batch ``weights`` (e.g. example
+    counts) when batch sizes differ, or the partial last batch biases the
+    anchor gradient."""
     total = None
-    n = 0
-    for batch in batches:
-        g = grad_fn(params, batch)
+    wsum = 0.0
+    for i, batch in enumerate(batches):
+        w = 1.0 if weights is None else float(weights[i])
+        g = jax.tree_util.tree_map(lambda x: x * w, grad_fn(params, batch))
         total = g if total is None else jax.tree_util.tree_map(
             jnp.add, total, g)
-        n += 1
-    return jax.tree_util.tree_map(lambda t: t / n, total)
+        wsum += w
+    if total is None:
+        raise ValueError("full_gradient needs at least one batch")
+    return jax.tree_util.tree_map(lambda t: t / wsum, total)

@@ -63,10 +63,12 @@ def main():
     ap.add_argument("--num-epoch", type=int, default=6)
     ap.add_argument("--global-batch", type=int, default=32)
     ap.add_argument("--out", required=True)
+    ap.add_argument("--heartbeat", type=float, default=1.0)
     args = ap.parse_args()
 
     x, y = make_dataset()
-    ctrl = WorkerClient("127.0.0.1", args.scheduler_port, host=args.host)
+    ctrl = WorkerClient("127.0.0.1", args.scheduler_port, host=args.host,
+                        heartbeat_interval_s=args.heartbeat)
     kv = kvstore_lib.create("tpu_sync")
     kv.set_controller(ctrl)
 
@@ -97,8 +99,10 @@ def main():
 
     flat, _ = jax.flatten_util.ravel_pytree(
         (mod.state.params, mod.state.batch_stats))  # BN stats must sync too
+    acc = dict(mod.score(data.NDArrayIter(x, y, batch_size=32), "acc"))
     result = {
         "host": args.host,
+        "final_acc": acc["accuracy"],
         "final_step": int(mod.state.step),
         "param_sum": float(np.asarray(flat).sum()),
         "param_hash": float(np.abs(np.asarray(flat)).sum()),

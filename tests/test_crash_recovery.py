@@ -1,0 +1,80 @@
+"""Crash recovery: a SIGKILLed worker is auto-evicted and the job finishes.
+
+Beyond the reference (SURVEY §5.3: ps-lite heartbeats only *report* dead
+nodes — ``kv.get_num_dead_node`` — and a crashed worker hangs a dist_sync
+job): here the scheduler evicts silent workers, completes the pending
+collectives with the survivors, rewrites host_worker, and audit-logs the
+removal.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from dt_tpu.elastic import Scheduler
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "elastic_worker.py")
+
+
+def _write_hosts(path, hosts):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(hosts) + "\n")
+    os.replace(tmp, path)
+
+
+def _spawn(port, host, out, num_epoch):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["ELASTIC_TRAINING_ENABLED"] = "1"
+    return subprocess.Popen(
+        [sys.executable, WORKER, "--scheduler-port", str(port),
+         "--host", host, "--num-epoch", str(num_epoch), "--out", out,
+         "--heartbeat", "0.2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def test_sigkill_worker_is_evicted_and_job_completes(tmp_path):
+    hw = str(tmp_path / "host_worker")
+    _write_hosts(hw, ["w0", "w1", "w2"])
+    outs = {h: str(tmp_path / f"{h}.json") for h in ("w0", "w1", "w2")}
+    sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=2.0)
+    procs = {}
+    try:
+        num_epoch = 40  # long enough that the kill lands mid-run
+        for h in ("w0", "w1", "w2"):
+            procs[h] = _spawn(sched.port, h, outs[h], num_epoch)
+        # wait until training is underway, then SIGKILL w2 (no cleanup,
+        # no goodbye — the crash case)
+        deadline = time.time() + 120
+        while sched._last_completed_epoch < 2:
+            assert time.time() < deadline, "training never started"
+            time.sleep(0.1)
+        procs["w2"].kill()
+
+        for h in ("w0", "w1"):
+            rc = procs[h].wait(timeout=240)
+            assert rc == 0, f"{h} rc={rc}:\n" \
+                f"{procs[h].stdout.read().decode()[-3000:]}"
+
+        r0 = json.load(open(outs["w0"]))
+        r1 = json.load(open(outs["w1"]))
+        # survivors finished every epoch, in exact sync, as a 2-worker job
+        assert r0["final_step"] == r1["final_step"]
+        assert r0["param_hash"] == r1["param_hash"]
+        assert r0["num_workers_at_end"] == 2
+        # the eviction is audit-logged and host_worker was rewritten
+        log = open(hw + "_log").read()
+        assert "REMOVED w2" in log
+        hosts = [ln.strip() for ln in open(hw) if ln.strip()]
+        assert hosts == ["w0", "w1"]
+        assert not os.path.exists(outs["w2"])  # w2 died before finishing
+    finally:
+        sched.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
