@@ -25,6 +25,8 @@ from dt_tpu.models.squeezenet import SqueezeNet as SqueezeNet
 from dt_tpu.models.googlenet import GoogLeNet as GoogLeNet
 from dt_tpu.models.inception_v4 import (InceptionBN as InceptionBN,
                                         InceptionV4 as InceptionV4)
+from dt_tpu.models.inception_resnet_v2 import (
+    InceptionResNetV2 as InceptionResNetV2)
 from dt_tpu.models.resnext import ResNeXt as ResNeXt
 from dt_tpu.models.lstm_lm import LSTMLanguageModel as LSTMLanguageModel
 from dt_tpu.models.transformer import TransformerLM as TransformerLM
@@ -40,8 +42,9 @@ def register(name: str, factory: Callable[..., Any]):
 def create(name: str, **kwargs):
     """Create a model by the reference's network names: lenet, mlp, alexnet,
     vgg11/13/16/19[_bn], resnet18/34/50/101/152[_v2], resnet20/56/110 (CIFAR),
-    inception-v3, googlenet, resnext50/101/152, mobilenet[_v2],
-    densenet121/161/169/201, squeezenet, lstm_lm."""
+    inception-v3, inception-bn, inception-v4, inception-resnet-v2, googlenet,
+    resnext50/101/152, mobilenet[_v2], densenet121/161/169/201, squeezenet,
+    lstm_lm, transformer_lm."""
     key = name.lower().replace("-", "_")
     if key in _REGISTRY:
         return _REGISTRY[key](**kwargs)
@@ -65,6 +68,7 @@ def _setup_registry():
     register("googlenet", lambda **kw: GoogLeNet(**kw))
     register("inception_bn", lambda **kw: InceptionBN(**kw))
     register("inception_v4", lambda **kw: InceptionV4(**kw))
+    register("inception_resnet_v2", lambda **kw: InceptionResNetV2(**kw))
     for d in (50, 101, 152):
         register(f"resnext{d}", lambda d=d, **kw: ResNeXt(depth=d, **kw))
     register("mobilenet", lambda **kw: MobileNetV1(**kw))

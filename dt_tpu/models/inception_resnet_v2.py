@@ -1,0 +1,123 @@
+"""Inception-ResNet-v2.
+
+Reference: ``example/image-classification/symbols/inception-resnet-v2.py``
+(Szegedy et al. 2016) — the last of the reference's inception symbol family:
+inception branches with residual connections scaled before the add.
+"""
+
+from typing import Any
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.models.common import ConvBN
+from dt_tpu.ops import nn as ops
+
+
+class _BlockA(linen.Module):  # 35x35 residual
+    scale: float = 0.17
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        b1 = ConvBN(32, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(32, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(32, (3, 3), dtype=d)(b2, training)
+        b3 = ConvBN(32, (1, 1), dtype=d)(x, training)
+        b3 = ConvBN(48, (3, 3), dtype=d)(b3, training)
+        b3 = ConvBN(64, (3, 3), dtype=d)(b3, training)
+        mix = jnp.concatenate([b1, b2, b3], axis=-1)
+        up = linen.Conv(x.shape[-1], (1, 1), dtype=d)(mix)
+        return jax.nn.relu(x + self.scale * up)
+
+
+class _BlockB(linen.Module):  # 17x17 residual
+    scale: float = 0.1
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        b1 = ConvBN(192, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(128, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(160, (1, 7), dtype=d)(b2, training)
+        b2 = ConvBN(192, (7, 1), dtype=d)(b2, training)
+        mix = jnp.concatenate([b1, b2], axis=-1)
+        up = linen.Conv(x.shape[-1], (1, 1), dtype=d)(mix)
+        return jax.nn.relu(x + self.scale * up)
+
+
+class _BlockC(linen.Module):  # 8x8 residual
+    scale: float = 0.2
+    activate: bool = True
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training=True):
+        d = self.dtype
+        b1 = ConvBN(192, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(192, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(224, (1, 3), dtype=d)(b2, training)
+        b2 = ConvBN(256, (3, 1), dtype=d)(b2, training)
+        mix = jnp.concatenate([b1, b2], axis=-1)
+        up = linen.Conv(x.shape[-1], (1, 1), dtype=d)(mix)
+        out = x + self.scale * up
+        return jax.nn.relu(out) if self.activate else out
+
+
+class InceptionResNetV2(linen.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        d = self.dtype
+        # stem (299 -> 35)
+        x = ConvBN(32, (3, 3), (2, 2), "VALID", dtype=d)(x, training)
+        x = ConvBN(32, (3, 3), padding="VALID", dtype=d)(x, training)
+        x = ConvBN(64, (3, 3), dtype=d)(x, training)
+        x = ops.max_pool2d(x, 3, 2)
+        x = ConvBN(80, (1, 1), dtype=d)(x, training)
+        x = ConvBN(192, (3, 3), padding="VALID", dtype=d)(x, training)
+        x = ops.max_pool2d(x, 3, 2)
+        # mixed 5b
+        b1 = ConvBN(96, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(48, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(64, (5, 5), dtype=d)(b2, training)
+        b3 = ConvBN(64, (1, 1), dtype=d)(x, training)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, training)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, training)
+        b4 = ops.avg_pool2d(x, 3, 1, padding=1)
+        b4 = ConvBN(64, (1, 1), dtype=d)(b4, training)
+        x = jnp.concatenate([b1, b2, b3, b4], axis=-1)
+        for _ in range(10):
+            x = _BlockA(dtype=d)(x, training)
+        # reduction A (35 -> 17)
+        r1 = ConvBN(384, (3, 3), (2, 2), "VALID", dtype=d)(x, training)
+        r2 = ConvBN(256, (1, 1), dtype=d)(x, training)
+        r2 = ConvBN(256, (3, 3), dtype=d)(r2, training)
+        r2 = ConvBN(384, (3, 3), (2, 2), "VALID", dtype=d)(r2, training)
+        r3 = ops.max_pool2d(x, 3, 2)
+        x = jnp.concatenate([r1, r2, r3], axis=-1)
+        for _ in range(20):
+            x = _BlockB(dtype=d)(x, training)
+        # reduction B (17 -> 8)
+        r1 = ConvBN(256, (1, 1), dtype=d)(x, training)
+        r1 = ConvBN(384, (3, 3), (2, 2), "VALID", dtype=d)(r1, training)
+        r2 = ConvBN(256, (1, 1), dtype=d)(x, training)
+        r2 = ConvBN(288, (3, 3), (2, 2), "VALID", dtype=d)(r2, training)
+        r3 = ConvBN(256, (1, 1), dtype=d)(x, training)
+        r3 = ConvBN(288, (3, 3), dtype=d)(r3, training)
+        r3 = ConvBN(320, (3, 3), (2, 2), "VALID", dtype=d)(r3, training)
+        r4 = ops.max_pool2d(x, 3, 2)
+        x = jnp.concatenate([r1, r2, r3, r4], axis=-1)
+        for _ in range(9):
+            x = _BlockC(dtype=d)(x, training)
+        x = _BlockC(scale=1.0, activate=False, dtype=d)(x, training)
+        x = ConvBN(1536, (1, 1), dtype=d)(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        x = ops.dropout(x, 0.2, training=training,
+                        rng=self.make_rng("dropout") if training else None)
+        return linen.Dense(self.num_classes, dtype=d)(x)

@@ -63,6 +63,13 @@ def test_inception_v3_forward():
     assert out.shape == (1, 5)
 
 
+def test_inception_resnet_v2_forward():
+    model = models.create("inception_resnet_v2", num_classes=5)
+    x = jnp.ones((1, 299, 299, 3))
+    _, out = _init_and_apply(model, x)
+    assert out.shape == (1, 5)
+
+
 def test_resnet_v2_variant():
     model = models.create("resnet18_v2", num_classes=4)
     x = jnp.ones((1, 64, 64, 3))
