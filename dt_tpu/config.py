@@ -54,6 +54,23 @@ def env_str(name: str, default: str = "") -> str:
     return os.environ.get(name, default)
 
 
+def enable_compilation_cache(cache_dir: str = "") -> str:
+    """Persistent XLA compilation cache (SURVEY §7 mesh-resize mitigation:
+    recompiles after elastic world rebuilds hit the cache, keyed by program
+    + world size).  Reads ``DT_COMPILE_CACHE`` when ``cache_dir`` is empty.
+    ``Module.__init__`` calls this, so setting the env var on the launcher
+    command line enables it job-wide (workers inherit the environment)."""
+    import jax
+    cache_dir = cache_dir or os.environ.get("DT_COMPILE_CACHE", "")
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything, including small programs (elastic restarts pay
+        # full compile cost otherwise)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return cache_dir
+
+
 def maybe_force_cpu() -> bool:
     """Honor ``DT_FORCE_CPU=1``: flip jax to the CPU backend before any
     backend init.  Used by tests/CI where the TPU is absent — env var alone

@@ -29,7 +29,9 @@ class _BlockA(linen.Module):  # 35x35 residual
         b3 = ConvBN(48, (3, 3), dtype=d)(b3, training)
         b3 = ConvBN(64, (3, 3), dtype=d)(b3, training)
         mix = jnp.concatenate([b1, b2, b3], axis=-1)
-        up = linen.Conv(x.shape[-1], (1, 1), dtype=d)(mix)
+        # projection is Conv+BN without activation, like the reference's
+        # tower_out ConvFactory(with_act=False)
+        up = ConvBN(x.shape[-1], (1, 1), act=None, dtype=d)(mix, training)
         return jax.nn.relu(x + self.scale * up)
 
 
@@ -41,11 +43,12 @@ class _BlockB(linen.Module):  # 17x17 residual
     def __call__(self, x, training=True):
         d = self.dtype
         b1 = ConvBN(192, (1, 1), dtype=d)(x, training)
-        b2 = ConvBN(128, (1, 1), dtype=d)(x, training)
+        b2 = ConvBN(129, (1, 1), dtype=d)(x, training)  # 129 matches the
+        # reference symbol (its quirk, kept for parity)
         b2 = ConvBN(160, (1, 7), dtype=d)(b2, training)
         b2 = ConvBN(192, (7, 1), dtype=d)(b2, training)
         mix = jnp.concatenate([b1, b2], axis=-1)
-        up = linen.Conv(x.shape[-1], (1, 1), dtype=d)(mix)
+        up = ConvBN(x.shape[-1], (1, 1), act=None, dtype=d)(mix, training)
         return jax.nn.relu(x + self.scale * up)
 
 
@@ -62,7 +65,7 @@ class _BlockC(linen.Module):  # 8x8 residual
         b2 = ConvBN(224, (1, 3), dtype=d)(b2, training)
         b2 = ConvBN(256, (3, 1), dtype=d)(b2, training)
         mix = jnp.concatenate([b1, b2], axis=-1)
-        up = linen.Conv(x.shape[-1], (1, 1), dtype=d)(mix)
+        up = ConvBN(x.shape[-1], (1, 1), act=None, dtype=d)(mix, training)
         out = x + self.scale * up
         return jax.nn.relu(out) if self.activate else out
 

@@ -90,6 +90,10 @@ class Module:
         # reshards state through it (SURVEY.md §7 "mesh resize" hard part).
         self.mesh_manager = mesh_manager
         self.seed = seed
+        # Persistent compilation cache (no-op unless DT_COMPILE_CACHE is
+        # set): elastic world rebuilds re-hit cached programs instead of
+        # paying full recompiles (SURVEY §7 mesh-resize mitigation).
+        config_lib.enable_compilation_cache()
         # Rematerialization: recompute activations in the backward pass
         # instead of storing them — the reference's memory mirror
         # (MXNET_BACKWARD_DO_MIRROR, SURVEY §5.6; BASELINE row 'Inception-v3
