@@ -16,7 +16,9 @@ Mitigations from SURVEY.md §7 applied here:
 - epoch-boundary only (caller's contract),
 - snapshot in host RAM before teardown (``snapshot_state``),
 - the persistent compilation cache keyed by world size amortizes the
-  recompile (enable via ``jax.config.jax_compilation_cache_dir``).
+  recompile (set ``DT_COMPILE_CACHE=/path`` — ``Module`` applies it via
+  ``dt_tpu.config.enable_compilation_cache``, which also zeroes the
+  min-compile-time threshold so small rebuilt programs are cached too).
 """
 
 from __future__ import annotations

@@ -1,0 +1,40 @@
+"""Profiler surface tests (reference ``tests/python/unittest/test_profiler.py``)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dt_tpu.utils import profiler
+
+
+def test_trace_context_writes_trace(tmp_path):
+    out = str(tmp_path / "tr")
+    with profiler.trace(out):
+        jax.jit(lambda x: (x @ x.T).sum())(jnp.ones((64, 64))) \
+            .block_until_ready()
+    files = glob.glob(os.path.join(out, "**", "*"), recursive=True)
+    assert files, "no trace output written"
+
+
+def test_set_state_validates():
+    with pytest.raises(ValueError, match="run|stop"):
+        profiler.set_state("bogus")
+
+
+def test_annotate_composes():
+    with profiler.annotate("my_region"):
+        v = float(jnp.ones(3).sum())
+    assert v == 3.0
+
+
+def test_rank_prefixed_output(tmp_path):
+    out = str(tmp_path / "prof")
+    profiler.set_config(filename=out)
+    profiler.set_state("run", rank=2)
+    jax.jit(lambda x: x + 1)(jnp.ones(4)).block_until_ready()
+    profiler.set_state("stop")
+    assert glob.glob(os.path.join(str(tmp_path), "rank2_prof", "**", "*"),
+                     recursive=True)
