@@ -1,0 +1,183 @@
+"""Detection ops: anchors, IoU, NMS, multibox matching.
+
+Reference: ``src/operator/contrib/`` detection family — ``multibox_prior.cc``
+(anchor generation), ``multibox_target.cc`` (anchor<->ground-truth matching +
+loc offsets), ``multibox_detection.cc`` (decode + NMS), ``bounding_box.cc``
+(IoU / box ops) — the C++/CUDA core behind ``example/ssd``.  TPU-first: all
+fixed-shape, branch-free (masks instead of dynamic boxes), so every op jits;
+NMS is the O(n²) mask formulation (sorted scores + suppression matrix) that
+maps to MXU/VPU instead of the reference's sequential CPU/GPU kernels.
+
+Box layout: corners ``(x1, y1, x2, y2)`` normalized to [0, 1] unless noted.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def box_iou(a: Array, b: Array) -> Array:
+    """IoU matrix between (N, 4) and (M, 4) corner boxes -> (N, M)."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def multibox_prior(feature_hw: Tuple[int, int],
+                   sizes: Sequence[float] = (1.0,),
+                   ratios: Sequence[float] = (1.0,)) -> Array:
+    """Anchor boxes for one feature map -> (H*W*(S+R-1), 4) corners.
+
+    Reference: ``multibox_prior.cc`` — per cell, in the reference's ORDER:
+    every size at ratio 1 first, then ``sizes[0]`` with ``ratios[1:]``
+    (``ratios[0]`` is ignored — treated as 1), S+R-1 anchors/cell, centered
+    at ``(i+0.5)/W, (j+0.5)/H``; widths carry the ``in_height/in_width``
+    aspect correction so anchors are square in pixel space
+    (``multibox_prior.cc:50``).
+    """
+    h, w = feature_hw
+    ys = (jnp.arange(h) + 0.5) / h
+    xs = (jnp.arange(w) + 0.5) / w
+    cy, cx = jnp.meshgrid(ys, xs, indexing="ij")
+    aspect = h / w
+    uniq = [(s * aspect, s) for s in sizes]          # all sizes at ratio 1
+    uniq += [(sizes[0] * aspect * (r ** 0.5), sizes[0] / (r ** 0.5))
+             for r in ratios[1:]]                    # sizes[0] x ratios[1:]
+    anchors = []
+    for bw, bh in uniq:
+        x1 = cx - bw / 2
+        y1 = cy - bh / 2
+        anchors.append(jnp.stack([x1, y1, x1 + bw, y1 + bh], axis=-1))
+    out = jnp.stack(anchors, axis=2)  # (H, W, A, 4)
+    return out.reshape(-1, 4)
+
+
+def encode_boxes(anchors: Array, gt: Array,
+                 variances=(0.1, 0.1, 0.2, 0.2)) -> Array:
+    """Corner gt -> center-offset regression targets w.r.t. anchors
+    (reference multibox_target loc encoding)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    gw = jnp.clip(gt[:, 2] - gt[:, 0], 1e-8)
+    gh = jnp.clip(gt[:, 3] - gt[:, 1], 1e-8)
+    gcx = gt[:, 0] + gw / 2
+    gcy = gt[:, 1] + gh / 2
+    return jnp.stack([
+        (gcx - acx) / (aw * variances[0]),
+        (gcy - acy) / (ah * variances[1]),
+        jnp.log(gw / aw) / variances[2],
+        jnp.log(gh / ah) / variances[3],
+    ], axis=-1)
+
+
+def decode_boxes(anchors: Array, deltas: Array,
+                 variances=(0.1, 0.1, 0.2, 0.2)) -> Array:
+    """Inverse of :func:`encode_boxes` (reference multibox_detection)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    cx = deltas[:, 0] * variances[0] * aw + acx
+    cy = deltas[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(deltas[:, 2] * variances[2]) * aw
+    h = jnp.exp(deltas[:, 3] * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def multibox_target(anchors: Array, gt_boxes: Array, gt_labels: Array,
+                    iou_threshold: float = 0.5,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth (one image).
+
+    ``gt_boxes``: (M, 4) padded with zero-rows; ``gt_labels``: (M,) int with
+    -1 padding.  Returns (cls_target (N,), loc_target (N, 4), loc_mask (N,)):
+    cls 0 = background, k+1 = class k (reference multibox_target semantics:
+    best-anchor-per-gt always matches; others match when IoU > threshold).
+    """
+    valid = gt_labels >= 0
+    iou = box_iou(anchors, gt_boxes) * valid[None, :]
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    matched = best_iou > iou_threshold
+    # force-match: for each VALID gt, its best anchor; padding gts scatter
+    # to an out-of-range sentinel and are dropped (they must not clobber
+    # anchor 0's assignment)
+    n = anchors.shape[0]
+    best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+    idx = jnp.where(valid, best_anchor, n)
+    force = jnp.zeros(n, bool).at[idx].set(True, mode="drop")
+    gt_of_forced = jnp.zeros(n, jnp.int32) \
+        .at[idx].set(jnp.arange(gt_boxes.shape[0]), mode="drop")
+    assigned_gt = jnp.where(force, gt_of_forced, best_gt)
+    matched = matched | force
+    cls_target = jnp.where(matched, gt_labels[assigned_gt] + 1, 0)
+    loc_target = encode_boxes(anchors, gt_boxes[assigned_gt], variances)
+    loc_target = jnp.where(matched[:, None], loc_target, 0.0)
+    return cls_target, loc_target, matched.astype(jnp.float32)
+
+
+def nms(boxes: Array, scores: Array, iou_threshold: float = 0.5,
+        score_threshold: float = 0.0, labels: Array = None,
+        force_suppress: bool = False) -> Array:
+    """Non-max suppression -> keep mask (N,), branch-free.
+
+    Reference: the NMS stage of ``multibox_detection.cc``.  O(N²) pairwise
+    formulation: process boxes best-score-first; a box survives unless an
+    already-kept higher-scored box overlaps it above the threshold.  With
+    ``labels`` given and ``force_suppress=False`` (the reference default,
+    ``multibox_detection-inl.h:66``), only SAME-class boxes suppress each
+    other; ``force_suppress=True`` is class-agnostic.
+    """
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = box_iou(b, b)
+    n = boxes.shape[0]
+    if labels is not None and not force_suppress:
+        same = labels[order][:, None] == labels[order][None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    def body(i, keep):
+        # suppressed if any kept earlier box overlaps too much
+        over = (iou[i] > iou_threshold) & (jnp.arange(n) < i) & keep
+        return keep.at[i].set(~jnp.any(over))
+
+    keep_sorted = lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    keep_sorted = keep_sorted & (scores[order] > score_threshold)
+    keep = jnp.zeros(n, bool).at[order].set(keep_sorted)
+    return keep
+
+
+def multibox_detection(cls_probs: Array, loc_deltas: Array, anchors: Array,
+                       iou_threshold: float = 0.5,
+                       score_threshold: float = 0.01,
+                       force_suppress: bool = False,
+                       variances=(0.1, 0.1, 0.2, 0.2)):
+    """Decode + NMS for one image — per-class suppression by default
+    (``force_suppress=False``, the reference default), class-agnostic when
+    forced.
+
+    ``cls_probs``: (C+1, N) including background at row 0 (reference layout).
+    Returns (labels (N,), scores (N,), boxes (N, 4)) with label -1 for
+    suppressed/background entries (fixed shapes; filter host-side).
+    """
+    scores = jnp.max(cls_probs[1:], axis=0)
+    labels = jnp.argmax(cls_probs[1:], axis=0)
+    boxes = decode_boxes(anchors, loc_deltas, variances)
+    keep = nms(boxes, scores, iou_threshold, score_threshold,
+               labels=labels, force_suppress=force_suppress)
+    out_labels = jnp.where(keep, labels, -1)
+    return out_labels, scores, boxes

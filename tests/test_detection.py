@@ -1,0 +1,132 @@
+"""Detection op tests vs hand-computed oracles (reference
+``tests/python/unittest/test_contrib_*`` multibox/bbox coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dt_tpu.ops import detection as D
+
+
+def test_box_iou():
+    a = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+    b = jnp.asarray([[0.5, 0.5, 1.5, 1.5], [0.0, 0.0, 1.0, 1.0],
+                     [2.0, 2.0, 3.0, 3.0]])
+    iou = np.asarray(D.box_iou(a, b))[0]
+    np.testing.assert_allclose(iou, [0.25 / 1.75, 1.0, 0.0], rtol=1e-6)
+
+
+def test_multibox_prior_counts_centers_order_aspect():
+    anchors = D.multibox_prior((2, 3), sizes=(0.2, 0.4), ratios=(1.0, 2.0))
+    # S + R - 1 = 3 anchors per cell, 6 cells
+    assert anchors.shape == (2 * 3 * 3, 4)
+    a = np.asarray(anchors)
+    # first cell center (0.5/3, 0.5/2); width carries the h/w aspect
+    # correction (multibox_prior.cc:50): w = size * H/W
+    np.testing.assert_allclose((a[0, 0] + a[0, 2]) / 2, 0.5 / 3, rtol=1e-5)
+    np.testing.assert_allclose((a[0, 1] + a[0, 3]) / 2, 0.25, rtol=1e-5)
+    np.testing.assert_allclose(a[0, 2] - a[0, 0], 0.2 * 2 / 3, rtol=1e-5)
+    np.testing.assert_allclose(a[0, 3] - a[0, 1], 0.2, rtol=1e-5)
+    # reference ORDER per cell: sizes at ratio 1 first, then ratios[1:]
+    np.testing.assert_allclose(a[1, 2] - a[1, 0], 0.4 * 2 / 3, rtol=1e-5)
+    np.testing.assert_allclose(a[2, 2] - a[2, 0],
+                               0.2 * (2 / 3) * np.sqrt(2), rtol=1e-5)
+    # ratios[0] is ignored (reference reads ratios[1:] only)
+    only_r2 = D.multibox_prior((1, 1), sizes=(0.2,), ratios=(2.0,))
+    assert only_r2.shape == (1, 4)  # no 0.2-at-ratio-2 anchor generated
+
+
+def test_encode_decode_roundtrip():
+    anchors = D.multibox_prior((4, 4), sizes=(0.3,), ratios=(1.0, 0.5))
+    rng = np.random.RandomState(0)
+    # random valid corner boxes: x1<x2, y1<y2
+    lo = rng.uniform(0, 0.5, (anchors.shape[0], 2)).astype(np.float32)
+    wh = rng.uniform(0.05, 0.5, (anchors.shape[0], 2)).astype(np.float32)
+    gt = np.concatenate([lo, lo + wh], axis=1)
+    deltas = D.encode_boxes(anchors, jnp.asarray(gt))
+    back = np.asarray(D.decode_boxes(anchors, deltas))
+    np.testing.assert_allclose(back, gt, rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_matching():
+    anchors = jnp.asarray([
+        [0.0, 0.0, 0.5, 0.5],   # overlaps gt0 well
+        [0.5, 0.5, 1.0, 1.0],   # overlaps gt1 well
+        [0.0, 0.5, 0.4, 0.9],   # background
+    ])
+    gt_boxes = jnp.asarray([[0.05, 0.0, 0.5, 0.45],
+                            [0.55, 0.55, 0.95, 1.0],
+                            [0.0, 0.0, 0.0, 0.0]])  # padding
+    gt_labels = jnp.asarray([3, 7, -1])
+    cls, loc, mask = D.multibox_target(anchors, gt_boxes, gt_labels)
+    np.testing.assert_array_equal(np.asarray(cls), [4, 8, 0])  # +1 offset
+    np.testing.assert_array_equal(np.asarray(mask), [1, 1, 0])
+    assert float(jnp.abs(loc[2]).sum()) == 0.0  # background: zero targets
+
+
+def test_multibox_target_force_match():
+    """A gt whose best IoU is below threshold still gets its best anchor."""
+    anchors = jnp.asarray([[0.0, 0.0, 1.0, 1.0], [0.0, 0.0, 0.1, 0.1]])
+    gt_boxes = jnp.asarray([[0.4, 0.4, 0.45, 0.45]])  # tiny box, IoU << 0.5
+    gt_labels = jnp.asarray([2])
+    cls, _, mask = D.multibox_target(anchors, gt_boxes, gt_labels)
+    assert np.asarray(cls).max() == 3  # forced match happened somewhere
+    assert np.asarray(mask).sum() == 1
+
+
+def test_multibox_target_padding_does_not_clobber_anchor0():
+    """Regression: a padding gt row must not steal/erase anchor 0's forced
+    match."""
+    anchors = jnp.asarray([[0.0, 0.0, 1.0, 1.0], [0.8, 0.8, 0.9, 0.9]])
+    gt_boxes = jnp.asarray([[0.4, 0.4, 0.45, 0.45],
+                            [0.0, 0.0, 0.0, 0.0]])  # padding
+    gt_labels = jnp.asarray([2, -1])
+    cls, _, mask = D.multibox_target(anchors, gt_boxes, gt_labels)
+    np.testing.assert_array_equal(np.asarray(cls), [3, 0])
+    np.testing.assert_array_equal(np.asarray(mask), [1, 0])
+
+
+def test_nms_per_class_default():
+    """Different-class overlaps are NOT suppressed unless force_suppress."""
+    boxes = jnp.asarray([[0.0, 0.0, 1.0, 1.0], [0.02, 0.0, 1.0, 1.0]])
+    scores = jnp.asarray([0.9, 0.8])
+    labels = jnp.asarray([0, 1])
+    keep = np.asarray(D.nms(boxes, scores, 0.5, labels=labels))
+    np.testing.assert_array_equal(keep, [True, True])
+    keep_f = np.asarray(D.nms(boxes, scores, 0.5, labels=labels,
+                              force_suppress=True))
+    np.testing.assert_array_equal(keep_f, [True, False])
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([
+        [0.0, 0.0, 1.0, 1.0],
+        [0.05, 0.05, 1.0, 1.0],   # heavy overlap with box 0
+        [2.0, 2.0, 3.0, 3.0],     # disjoint
+    ])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    keep = np.asarray(D.nms(boxes, scores, iou_threshold=0.5))
+    np.testing.assert_array_equal(keep, [True, False, True])
+    # lower-scored first box loses instead
+    keep2 = np.asarray(D.nms(boxes, jnp.asarray([0.6, 0.95, 0.7]), 0.5))
+    np.testing.assert_array_equal(keep2, [False, True, True])
+
+
+def test_nms_jit_and_score_threshold():
+    f = jax.jit(lambda b, s: D.nms(b, s, 0.5, score_threshold=0.75))
+    boxes = jnp.asarray([[0.0, 0.0, 1.0, 1.0], [2.0, 2.0, 3.0, 3.0]])
+    keep = np.asarray(f(boxes, jnp.asarray([0.9, 0.5])))
+    np.testing.assert_array_equal(keep, [True, False])
+
+
+def test_multibox_detection_end_to_end():
+    anchors = D.multibox_prior((2, 2), sizes=(0.4,), ratios=(1.0,))
+    n = anchors.shape[0]
+    cls_probs = jnp.zeros((3, n)).at[1, 0].set(0.9).at[0].set(0.1) \
+        .at[2, 3].set(0.8)
+    loc = jnp.zeros((n, 4))
+    labels, scores, boxes = D.multibox_detection(cls_probs, loc, anchors)
+    la = np.asarray(labels)
+    assert la[0] == 0 and la[3] == 1  # class ids (0-based, bg removed)
+    np.testing.assert_allclose(np.asarray(boxes)[0], np.asarray(anchors)[0],
+                               rtol=1e-5)
