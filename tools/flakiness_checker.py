@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Re-run a test many times to detect flakes.
+
+Reference: ``tools/flakiness_checker.py`` — same CLI shape:
+
+    python tools/flakiness_checker.py tests/test_optim.py::test_adam_replay \
+        --trials 20
+"""
+
+import argparse
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("test", help="pytest node id (file[::test])")
+    ap.add_argument("--trials", type=int, default=10)
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    failures = 0
+    for i in range(args.trials):
+        r = subprocess.run([sys.executable, "-m", "pytest", args.test,
+                            "-x", "-q", "--no-header", "-p", "no:cacheprovider"],
+                           capture_output=True, text=True)
+        ok = r.returncode == 0
+        print(f"trial {i + 1}/{args.trials}: {'PASS' if ok else 'FAIL'}")
+        if not ok:
+            failures += 1
+            sys.stderr.write(r.stdout[-1500:])
+            if args.stop_on_fail:
+                break
+    print(f"flakiness: {failures}/{args.trials} failures")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
