@@ -1,0 +1,52 @@
+"""MNIST training (the reference's intro example).
+
+Reference: ``example/image-classification/train_mnist.py`` — MLP or LeNet on
+the idx-ubyte files (``--data-dir`` holding train-images-idx3-ubyte[.gz]
+etc.); synthetic fallback when absent.
+
+    python examples/train_mnist.py --network lenet --data-dir ./mnist
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import common  # noqa: E402
+
+
+def main():
+    ap = common.base_parser("MNIST")
+    ap.add_argument("--data-dir", default=None)
+    ap.set_defaults(network="mlp", num_classes=10, num_examples=60000,
+                    image_shape="28,28,1", batch_size=64, num_epochs=10,
+                    lr=0.05, lr_step_epochs="10")
+    args = ap.parse_args()
+    image_shape = common.setup(args)
+
+    from dt_tpu import data, parallel
+    kv = parallel.create(args.kv_store)
+    per_worker = max(args.batch_size // kv.num_workers, 1)
+    train = val = None
+    if args.data_dir:
+        def p(name):
+            return os.path.join(args.data_dir, name)
+        train = data.MNISTIter(p("train-images-idx3-ubyte"),
+                               p("train-labels-idx1-ubyte"),
+                               per_worker, flat=(args.network == "mlp"),
+                               shuffle=True, num_parts=kv.num_workers,
+                               part_index=kv.rank, seed=args.seed)
+        if os.path.exists(p("t10k-images-idx3-ubyte")) or \
+                os.path.exists(p("t10k-images-idx3-ubyte.gz")):
+            val = data.MNISTIter(p("t10k-images-idx3-ubyte"),
+                                 p("t10k-labels-idx1-ubyte"), per_worker,
+                                 flat=(args.network == "mlp"))
+    if train is None:
+        train, val = common.make_data(args, image_shape, kv)
+    steps = train.steps_per_epoch or 1
+    mod = common.make_module(args, steps, kv)
+    common.fit(args, mod, train, val)
+
+
+if __name__ == "__main__":
+    main()
