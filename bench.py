@@ -10,6 +10,15 @@ reference ``example/image-classification/README.md:300-320``).
 Full training step (fwd + bwd + SGD-momentum update + BN stats), bf16
 compute, synthetic input (the reference's ``--benchmark 1`` mode) so input
 IO can't mask compute throughput.
+
+Wedged-tunnel resilience (round-1 postmortem): a killed process holding the
+TPU wedges the axon tunnel for a long time, hanging ALL later jax init
+calls.  So the parent process never imports jax; it first runs a tiny
+*preflight* child (one jnp op, short timeout) and retries with backoff
+while that hangs — the tunnel does clear — then runs the real measurement
+child with the remaining budget.  The XLA persistent compile cache is
+enabled (``DT_COMPILE_CACHE``, defaulted next to this file) so ResNet-152's
+multi-minute first compile is paid once per image, not once per round.
 """
 
 import json
@@ -18,42 +27,124 @@ import subprocess
 import sys
 import time
 
-TIMEOUT_S = int(os.environ.get("DT_BENCH_TIMEOUT_S", "1500"))
+TOTAL_BUDGET_S = int(os.environ.get("DT_BENCH_TIMEOUT_S", "1500"))
+PREFLIGHT_TIMEOUT_S = int(os.environ.get("DT_BENCH_PREFLIGHT_TIMEOUT_S", "90"))
+_BACKOFFS_S = (15, 30, 60, 120, 120, 180, 180)
+BASELINE_IMGS_PER_SEC = 20.08  # reference ResNet-152 1-GPU img/s, batch 32
 
 
-def guarded_main():
-    """Run the measurement in a child process with a hard timeout so a
-    wedged accelerator runtime (hung backend init) still yields the JSON
-    line instead of hanging the driver."""
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
-                             "--run"],
-                            stdout=subprocess.PIPE, text=True)
-    try:
-        out, _ = proc.communicate(timeout=TIMEOUT_S)
-        line = next((ln for ln in out.strip().splitlines()
-                     if ln.startswith("{")), None)
-        if proc.returncode == 0 and line:
-            print(line)
-            return 0
-        err = f"bench child rc={proc.returncode}"
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        err = f"bench timed out after {TIMEOUT_S}s (wedged TPU runtime?)"
+def _emit_failure(err):
     print(json.dumps({
         "metric": "resnet152_train_imgs_per_sec_per_chip",
         "value": 0.0, "unit": "imgs/sec", "vs_baseline": 0.0,
         "error": err,
     }))
+
+
+def _child_env():
+    env = dict(os.environ)
+    env.setdefault("DT_COMPILE_CACHE",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".xla_cache"))
+    return env
+
+
+def _run_child(arg, timeout_s):
+    """Run this file in a child with ``arg``; return (rc, out) where rc is
+    None on timeout.  The child is its own process group so a hung backend
+    init can be killed — whole tree, via killpg — without signalling the
+    parent."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), arg],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=_child_env(), start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out
+    except subprocess.TimeoutExpired:
+        import signal
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return None, ""
+
+
+def guarded_main():
+    """Preflight-probe the accelerator (retrying while the tunnel is
+    wedged), then run the measurement child; always emit the JSON line."""
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    last_err = "preflight never attempted"
+    ok = False
+    for i, backoff in enumerate(_BACKOFFS_S):
+        remaining = deadline - time.monotonic()
+        if remaining <= PREFLIGHT_TIMEOUT_S:
+            last_err += " (budget exhausted during preflight retries)"
+            break
+        rc, out = _run_child("--preflight",
+                             min(PREFLIGHT_TIMEOUT_S, remaining))
+        if rc == 0:
+            ok = True
+            break
+        last_err = (f"preflight attempt {i + 1}: "
+                    + ("timed out (wedged TPU tunnel?)" if rc is None
+                       else f"rc={rc}: {out.strip()[-300:]}"))
+        if i + 1 < len(_BACKOFFS_S):
+            print(f"# {last_err}; backing off {backoff}s", file=sys.stderr)
+            time.sleep(min(backoff, max(0, deadline - time.monotonic())))
+    if not ok:
+        _emit_failure(f"preflight exhausted retries; last: {last_err}")
+        return 0
+
+    # measurement, with one retry on fast failure (a retry after a timeout
+    # would run against the tunnel our own kill just wedged — skip those)
+    for attempt in (1, 2):
+        remaining = deadline - time.monotonic()
+        if remaining <= 30:
+            _emit_failure(f"budget exhausted before measurement; {last_err}")
+            return 0
+        rc, out = _run_child("--run", remaining)
+        line = next((ln for ln in out.strip().splitlines()
+                     if ln.startswith("{")), None)
+        if rc == 0 and line:
+            print(line)
+            return 0
+        last_err = (f"measurement attempt {attempt}: "
+                    + ("timed out" if rc is None
+                       else f"rc={rc}: {out.strip()[-300:]}"))
+        print(f"# {last_err}", file=sys.stderr)
+        if rc is None:
+            break
+    _emit_failure(last_err)
+    return 0
+
+
+def preflight():
+    """Tiny end-to-end op on the default backend: proves device init,
+    compile, and execute all work before the expensive model run."""
+    from dt_tpu.config import maybe_force_cpu, enable_compilation_cache
+    maybe_force_cpu()
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    v = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.bfloat16))
+    jax.block_until_ready(v)
+    print(f"# preflight ok: backend={jax.default_backend()} "
+          f"devices={len(jax.devices())} v={float(v):.1f}", file=sys.stderr)
     return 0
 
 
 def main():
-    from dt_tpu.config import maybe_force_cpu
+    from dt_tpu.config import maybe_force_cpu, enable_compilation_cache
     maybe_force_cpu()  # DT_FORCE_CPU=1 only; default backend otherwise
+    enable_compilation_cache()
     import jax
     import jax.numpy as jnp
     import numpy as np
-    import optax
     from dt_tpu import models, optim
     from dt_tpu.ops import losses
     from dt_tpu.training.train_state import TrainState
@@ -89,8 +180,10 @@ def main():
     step = jax.jit(train_step, donate_argnums=(0,))
 
     # warmup / compile
+    t_compile = time.perf_counter()
     state, loss = step(state, x, y)
     jax.block_until_ready(loss)
+    t_compile = time.perf_counter() - t_compile
 
     iters = int(os.environ.get("DT_BENCH_ITERS", "20"))
     t0 = time.perf_counter()
@@ -100,16 +193,26 @@ def main():
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * iters / dt
-    baseline = 20.08  # reference ResNet-152 1-GPU img/s, batch 32
+    step_ms = dt / iters * 1e3
+    # MFU estimate: ResNet-152 fwd ≈ 11.56 GFLOP/img @224 (2x for bwd+fwd
+    # ≈ 3x fwd total); chip peak read from the device if exposed.
+    flops_per_img = 3 * 11.56e9
     print(json.dumps({
         "metric": "resnet152_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
-        "vs_baseline": round(imgs_per_sec / baseline, 2),
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),
+        "step_ms": round(step_ms, 2),
+        "compile_s": round(t_compile, 1),
+        "model_tflops_per_sec": round(imgs_per_sec * flops_per_img / 1e12,
+                                      2),
+        "backend": jax.default_backend(),
     }))
 
 
 if __name__ == "__main__":
     if "--run" in sys.argv:
         sys.exit(main())
+    if "--preflight" in sys.argv:
+        sys.exit(preflight())
     sys.exit(guarded_main())
