@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 _MAGIC = 0xCED7230A
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
 _IRHEADER = struct.Struct("<IfQQ")  # flag, label, id, id2
 
 
@@ -39,13 +40,38 @@ class RecordIOWriter:
             self._idx.write(f"{key if key is not None else self._key}\t"
                             f"{self._f.tell()}\n")
             self._key += 1
-        length = len(data)
-        assert length < (1 << 29), "record too large"
-        self._f.write(struct.pack("<II", _MAGIC, length))
-        self._f.write(data)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self._f.write(b"\x00" * pad)
+        assert len(data) < (1 << 29), "record too large"
+        # dmlc WriteRecord escape: a payload containing the magic word at a
+        # 4-byte-aligned offset would desync a chunked reader scanning for
+        # frame heads, so split there — the magic is dropped from the data
+        # and the frame seam stands in for it (cflag 1=first, 2=middle,
+        # 3=last part; the reader re-inserts the magic when joining).
+        # Fast path first (C-speed substring scan; a hit is ~1 per 17 GB of
+        # random payload), vectorized aligned-position scan only on a hit.
+        parts = []
+        start = 0
+        if _MAGIC_BYTES in data:
+            words = np.frombuffer(data, np.uint8,
+                                  len(data) // 4 * 4).view("<u4")
+            for i in (np.nonzero(words == _MAGIC)[0] * 4).tolist():
+                parts.append(data[start:i])
+                start = i + 4
+        parts.append(data[start:])
+        for j, part in enumerate(parts):
+            if len(parts) == 1:
+                cflag = 0
+            elif j == 0:
+                cflag = 1
+            elif j == len(parts) - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self._f.write(struct.pack("<II", _MAGIC,
+                                      (cflag << 29) | len(part)))
+            self._f.write(part)
+            pad = (4 - len(part) % 4) % 4
+            if pad:
+                self._f.write(b"\x00" * pad)
 
     def close(self):
         self._f.close()
@@ -84,7 +110,7 @@ class RecordIOReader:
         assert self.index is not None, "no index loaded"
         self._f.seek(self.index[key])
 
-    def read_record(self) -> Optional[bytes]:
+    def _read_frame(self) -> Optional[Tuple[int, bytes]]:
         hdr = self._f.read(8)
         if len(hdr) < 8:
             return None
@@ -96,27 +122,50 @@ class RecordIOReader:
         pad = (4 - length % 4) % 4
         if pad:
             self._f.read(pad)
-        return data
+        return lrec >> 29, data
+
+    def read_record(self) -> Optional[bytes]:
+        frame = self._read_frame()
+        if frame is None:
+            return None
+        cflag, data = frame
+        if cflag == 0:
+            return data
+        # multi-part record (writer escaped an embedded magic word):
+        # cflag 1 starts it; append parts until the cflag-3 tail, rejoining
+        # with the magic bytes each seam replaced (dmlc ReadRecord).
+        if cflag != 1:
+            raise IOError(f"orphan continuation frame (cflag={cflag})")
+        parts = [data]
+        while True:
+            frame = self._read_frame()
+            if frame is None:
+                raise IOError("truncated multi-part record")
+            cflag, data = frame
+            if cflag not in (2, 3):
+                raise IOError(f"bad continuation cflag={cflag}")
+            parts.append(data)
+            if cflag == 3:
+                return _MAGIC_BYTES.join(parts)
 
     def read_all(self) -> List[bytes]:
         try:
             from dt_tpu import native
         except Exception:
             native = None
-        try:
-            if native is None:
-                raise RuntimeError("native layer unavailable")
-            idx = native.native_index(self._path)
-            if idx is not None:
-                recs = native.native_read_batch(self._path, *idx)
-                if recs is not None:
-                    # keep cursor state identical to the Python path (EOF)
-                    self._f.seek(0, os.SEEK_END)
-                    return recs
-        except native.BadRecordFile:
-            raise  # genuinely corrupt file — same as Python path failing
-        except Exception:  # native layer optional; never block reads
-            pass
+        if native is not None:
+            try:
+                idx = native.native_index(self._path)
+                if idx is not None:
+                    recs = native.native_read_batch(self._path, *idx)
+                    if recs is not None:
+                        # keep cursor state identical to Python path (EOF)
+                        self._f.seek(0, os.SEEK_END)
+                        return recs
+            except native.BadRecordFile:
+                raise  # genuinely corrupt file — same as Python failing
+            except Exception:  # native layer optional; never block reads
+                pass
         self._f.seek(0)
         out = []
         while True:

@@ -5,6 +5,21 @@ Length-prefixed pickled dicts over TCP — the role ps-lite's protobuf
 Control-plane traffic is tiny (snapshots are the exception and stream as one
 message); a trusted-cluster assumption identical to the reference's.
 
+Because pickle is a code-execution primitive the reference's protobuf plane
+never carried, frames are authenticated: set ``DT_ELASTIC_SECRET`` (the
+launcher propagates env to workers) and every frame becomes
+``b"DTH1" | len | hmac(tag|len) | payload | hmac(tag|len|payload)`` —
+the *header* MAC is verified before any payload buffering (an
+unauthenticated peer cannot make the receiver allocate), and the payload
+MAC before unpickling.  With no secret set the legacy unauthenticated
+framing is used (trusted single-host clusters, tests).  Mixed
+configurations fail loudly and immediately: an authenticated receiver
+rejects a legacy frame on the 4-byte tag; a legacy receiver sees the tag
+bytes as an absurd length and rejects it oversize.  The scheduler's bind
+interface is likewise configurable (``DT_ELASTIC_BIND``, default
+``0.0.0.0``) so operators can pin the control plane to a private
+interface.
+
 Message is a dict with at least ``{"cmd": str}``.  Commands mirror the
 fork's ``Control::Command`` additions (``message.h:123``):
 
@@ -22,21 +37,68 @@ fork's ``Control::Command`` additions (``message.h:123``):
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
+import os
 import pickle
 import socket
 import struct
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 _LEN = struct.Struct("<Q")
 MAX_MSG = 1 << 33  # snapshots can be GBs in theory; sanity bound
+_MAC_SIZE = hashlib.sha256().digest_size
+_AUTH_TAG = b"DTH1"
+
+
+def _secret() -> Optional[bytes]:
+    s = os.environ.get("DT_ELASTIC_SECRET", "")
+    return s.encode() if s else None
+
+
+def bind_interface() -> str:
+    """Interface the scheduler listens on (``DT_ELASTIC_BIND``)."""
+    return os.environ.get("DT_ELASTIC_BIND", "0.0.0.0")
+
+
+def _mac(key: bytes, *parts: bytes) -> bytes:
+    m = _hmac.new(key, digestmod=hashlib.sha256)
+    for p in parts:
+        m.update(p)
+    return m.digest()
 
 
 def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
     payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    key = _secret()
+    if key is not None:
+        hdr = _AUTH_TAG + _LEN.pack(len(payload))
+        sock.sendall(hdr + _mac(key, hdr)
+                     + payload + _mac(key, hdr, payload))
+    else:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def recv_msg(sock: socket.socket) -> Dict[str, Any]:
+    key = _secret()
+    if key is not None:
+        hdr = _recv_exact(sock, len(_AUTH_TAG) + _LEN.size)
+        if hdr[:len(_AUTH_TAG)] != _AUTH_TAG:
+            raise IOError("unauthenticated frame on authenticated channel "
+                          "(peer missing DT_ELASTIC_SECRET?)")
+        # header MAC gates BEFORE the body is buffered: an attacker cannot
+        # make the receiver allocate length bytes without the key
+        if not _hmac.compare_digest(_recv_exact(sock, _MAC_SIZE),
+                                    _mac(key, hdr)):
+            raise IOError("frame header HMAC verification failed")
+        (length,) = _LEN.unpack(hdr[len(_AUTH_TAG):])
+        if length > MAX_MSG:
+            raise IOError(f"message too large: {length}")
+        payload = _recv_exact(sock, length)
+        if not _hmac.compare_digest(_recv_exact(sock, _MAC_SIZE),
+                                    _mac(key, hdr, payload)):
+            raise IOError("frame payload HMAC verification failed")
+        return pickle.loads(payload)
     hdr = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(hdr)
     if length > MAX_MSG:

@@ -101,7 +101,7 @@ class Scheduler:
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("0.0.0.0", port))
+        self._sock.bind((protocol.bind_interface(), port))
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()

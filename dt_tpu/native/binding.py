@@ -92,6 +92,10 @@ def native_index(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         raise BadRecordFile(f"cannot open {path}")
     if n == -2:
         raise BadRecordFile(f"bad RecordIO framing in {path}")
+    if n == -3:
+        # multi-part records present (escaped magic word): the Python
+        # reader reassembles the seams; not a native-layer failure.
+        return None
     try:
         offsets = np.ctypeslib.as_array(off_p, (n,)).copy() if n else \
             np.zeros(0, np.uint64)

@@ -25,7 +25,10 @@ constexpr uint64_t kLenMask = (1u << 29) - 1;
 extern "C" {
 
 // Scan `path`, return malloc'd arrays of payload offsets and lengths.
-// Returns record count, or -1 on IO error, -2 on format error.
+// Returns record count, or -1 on IO error, -2 on format error, -3 if the
+// file contains multi-part records (cflag != 0: the dmlc writer escaped an
+// embedded magic word) — those need seam reassembly, which the Python
+// reader does; callers treat -3 as "use the Python path".
 long long dtrec_index(const char* path, uint64_t** offsets_out,
                       uint64_t** lengths_out) {
   FILE* f = std::fopen(path, "rb");
@@ -44,6 +47,7 @@ long long dtrec_index(const char* path, uint64_t** offsets_out,
     if (got == 0) break;             // clean EOF
     if (got != sizeof(hdr)) break;   // truncated header: stop
     if (hdr[0] != kMagic) { std::fclose(f); return -2; }
+    if ((hdr[1] >> 29) != 0) { std::fclose(f); return -3; }
     uint64_t len = hdr[1] & kLenMask;
     uint64_t padded = (len + 3) & ~3ull;
     if (pos + sizeof(hdr) + len > fsize) break;  // truncated payload: stop

@@ -72,7 +72,10 @@ class FactorScheduler(LRScheduler):
         self.stop_factor_lr = stop_factor_lr
 
     def _main_lr(self, step):
-        n = (step // self.step).astype(jnp.float32)
+        # reference drops only when num_update exceeds count + step
+        # (strict >): update `step` itself still uses the pre-drop lr, so
+        # the n-th drop lands at step*n + 1, not step*n.
+        n = jnp.maximum((step - 1) // self.step, 0).astype(jnp.float32)
         lr = self.base_lr * jnp.power(self.factor, n)
         return jnp.maximum(lr, self.stop_factor_lr)
 
@@ -90,7 +93,9 @@ class MultiFactorScheduler(LRScheduler):
         self.factor = factor
 
     def _main_lr(self, step):
-        n = jnp.sum(step >= self.steps).astype(jnp.float32)
+        # strict >: the drop takes effect on the update AFTER the threshold
+        # (reference MultiFactorScheduler `num_update > self.step[...]`)
+        n = jnp.sum(step > self.steps).astype(jnp.float32)
         return self.base_lr * jnp.power(self.factor, n)
 
 

@@ -30,7 +30,11 @@ ScalarOrSchedule = Union[float, Callable[[jnp.ndarray], jnp.ndarray]]
 
 
 def _lr_at(lr: ScalarOrSchedule, count):
-    return lr(count) if callable(lr) else jnp.asarray(lr, jnp.float32)
+    """Schedules receive the reference's 1-based ``num_update`` (mxnet
+    increments the count BEFORE the lr lookup), not the 0-based slot
+    counter — the strict-greater drop thresholds in
+    :mod:`dt_tpu.optim.lr_scheduler` depend on this convention."""
+    return lr(count + 1) if callable(lr) else jnp.asarray(lr, jnp.float32)
 
 
 def _preprocess(g, w, rescale_grad, clip_gradient, wd):
@@ -168,9 +172,9 @@ def adam(learning_rate: ScalarOrSchedule = 0.001, beta1: float = 0.9,
 def adagrad(learning_rate: ScalarOrSchedule = 0.01, epsilon: float = 1e-7,
             weight_decay: float = 0.0, rescale_grad: float = 1.0,
             clip_gradient: Optional[float] = None) -> optax.GradientTransformation:
-    """AdaGrad.  Reference: AdaGrad (``optimizer.py``): ``hist += g²;
-    w -= lr * g / (sqrt(hist) + eps)``.  The reference's row_sparse lazy
-    update (only touched rows) is subsumed by XLA's dense scatter fusion."""
+    """AdaGrad.  Reference: AdaGrad (``optimizer.py``): ``hist += g²``
+    (wd NOT folded into the accumulated grad); ``w -= lr * (g /
+    sqrt(hist + eps) + wd * w)`` — wd is a separate decoupled term."""
 
     def init(params):
         return MomentumState(jnp.zeros((), jnp.int32), _zeros_like_f32(params))
@@ -179,9 +183,11 @@ def adagrad(learning_rate: ScalarOrSchedule = 0.01, epsilon: float = 1e-7,
         lr = _lr_at(learning_rate, state.count)
 
         def u(g, w, h):
-            g = _preprocess(g, w, rescale_grad, clip_gradient, weight_decay)
+            g = _preprocess(g, w, rescale_grad, clip_gradient, 0.0)
             new_h = h + g * g
-            return (-lr * g / (jnp.sqrt(new_h) + epsilon)).astype(w.dtype), new_h
+            upd = -lr * (g / jnp.sqrt(new_h + epsilon)
+                         + weight_decay * w.astype(jnp.float32))
+            return upd.astype(w.dtype), new_h
         updates, new_h = _multimap(u, 2, grads, params, state.mom)
         return updates, MomentumState(state.count + 1, new_h)
 

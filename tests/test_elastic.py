@@ -206,3 +206,59 @@ def test_allreduce_exact_values(sched):
     for t in ts:
         t.join(timeout=30)
     np.testing.assert_allclose(outs2["w0"], 15.0)
+
+
+def _closed_unanswered(sk):
+    """True if the peer closed without sending a byte (clean FIN or RST —
+    the RST happens when the peer closes with our data still unread)."""
+    try:
+        return sk.recv(1) == b""
+    except ConnectionResetError:
+        return True
+
+
+def test_hmac_authenticated_frames(tmp_path, monkeypatch):
+    """With DT_ELASTIC_SECRET set, frames carry an HMAC verified before
+    unpickling; a forged frame (wrong MAC) is dropped at the frame layer —
+    the connection closes with no response and the pickle payload is never
+    deserialized (the RCE primitive is unreachable without the key)."""
+    import pickle
+    import socket
+    import struct
+
+    monkeypatch.setenv("DT_ELASTIC_SECRET", "s3cret")
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0"])
+    s = Scheduler(host_worker_file=hw)
+    try:
+        c = WorkerClient("127.0.0.1", s.port, host="w0", is_new=False)
+        assert c.rank == 0  # authenticated round-trip works
+
+        class Evil:
+            def __reduce__(self):
+                return (pytest.fail, ("forged pickle was deserialized!",))
+
+        payload = pickle.dumps({"cmd": Evil()})
+        # (a) legacy/unauthenticated frame: rejected on the 4-byte tag
+        with socket.create_connection(("127.0.0.1", s.port),
+                                      timeout=5) as sk:
+            sk.settimeout(5)
+            sk.sendall(struct.pack("<Q", len(payload)) + b"\x00" * 32
+                       + payload)
+            # scheduler must close without answering (FIN or RST, no oracle)
+            assert _closed_unanswered(sk)
+        # (b) correct tag, forged header MAC claiming a huge body: rejected
+        # BEFORE the receiver buffers anything (no 8 GB allocation)
+        with socket.create_connection(("127.0.0.1", s.port),
+                                      timeout=5) as sk:
+            sk.settimeout(5)
+            sk.sendall(b"DTH1" + struct.pack("<Q", 1 << 32) + b"\x00" * 32)
+            assert _closed_unanswered(sk)
+        # authenticated requests still work afterwards
+        from dt_tpu.elastic import protocol
+        r = protocol.request("127.0.0.1", s.port,
+                             {"cmd": "num_dead", "timeout_s": 60.0},
+                             timeout=5.0)
+        assert "count" in r
+    finally:
+        s.close()

@@ -50,6 +50,20 @@ def test_native_matches_python_reader(tmp_path, built):
     assert py == payloads
 
 
+def test_native_defers_multipart_to_python(tmp_path, built):
+    """A file holding cflag continuation frames (escaped magic) makes the
+    native indexer return None so read_all falls through to the Python
+    reassembly path — and still yields the original payload."""
+    import struct
+    magic = struct.pack("<I", 0xCED7230A)
+    p = tmp_path / "esc.rec"
+    payloads = [b"pre!", b"abcd" + magic + b"efgh", b"post"]
+    _write(p, payloads)
+    assert native.native_index(str(p)) is None
+    with data.RecordIOReader(str(p)) as r:
+        assert r.read_all() == payloads
+
+
 def test_native_bad_file(tmp_path, built):
     p = tmp_path / "bad.rec"
     p.write_bytes(b"\x00" * 32)  # wrong magic

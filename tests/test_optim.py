@@ -79,16 +79,18 @@ def test_adam_replay():
 
 
 def test_adagrad_replay():
-    lr = 0.1
-    tx = optim.adagrad(lr)
+    """Reference AdaGrad: hist += g² (no wd in the accumulated grad);
+    w -= lr * (g / sqrt(hist + eps) + wd * w)."""
+    lr, wd = 0.1, 0.01
+    tx = optim.adagrad(lr, weight_decay=wd)
     w = np.array([1.0], np.float32)
     p = {"w": jnp.array(w)}
-    gs = [np.array([0.5], np.float32), np.array([0.5], np.float32)]
+    gs = [np.array([0.5], np.float32), np.array([-0.25], np.float32)]
     p2, _ = _run_steps(tx, p, [{"w": jnp.array(g)} for g in gs])
     h = np.zeros_like(w)
     for g in gs:
         h += g * g
-        w = w - lr * g / (np.sqrt(h) + 1e-7)
+        w = w - lr * (g / np.sqrt(h + 1e-7) + wd * w)
     np.testing.assert_allclose(np.array(p2["w"]), w, rtol=1e-5)
 
 
@@ -191,17 +193,23 @@ def test_create_unknown_raises():
 
 
 def test_factor_scheduler():
+    """Reference drops only when num_update > count + step (strict >):
+    update 10 itself still sees the pre-drop lr, update 11 the dropped."""
     s = optim.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
     assert float(s(0)) == 1.0
-    assert float(s(9)) == 1.0
-    np.testing.assert_allclose(float(s(10)), 0.5)
+    assert float(s(10)) == 1.0
+    np.testing.assert_allclose(float(s(11)), 0.5)
+    np.testing.assert_allclose(float(s(20)), 0.5)
     np.testing.assert_allclose(float(s(25)), 0.25)
 
 
 def test_multifactor_scheduler():
+    """Strict >: the drop lands on the update AFTER each threshold."""
     s = optim.MultiFactorScheduler(steps=[5, 15], factor=0.1, base_lr=1.0)
     assert float(s(4)) == 1.0
-    np.testing.assert_allclose(float(s(5)), 0.1, rtol=1e-6)
+    assert float(s(5)) == 1.0
+    np.testing.assert_allclose(float(s(6)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(s(15)), 0.1, rtol=1e-6)
     np.testing.assert_allclose(float(s(20)), 0.01, rtol=1e-6)
 
 
