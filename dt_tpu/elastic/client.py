@@ -121,8 +121,49 @@ class WorkerClient:
             value = np.asarray(value)
         seq = self._ar_seq.get(key, 0)
         self._ar_seq[key] = seq + 1
-        return self._req({"cmd": "allreduce", "host": self.host, "key": key,
-                          "seq": seq, "value": value})["value"]
+        out = self._req({"cmd": "allreduce", "host": self.host, "key": key,
+                         "seq": seq, "value": value})["value"]
+        if isinstance(out, dict) and "__error__" in out:
+            raise RuntimeError(f"allreduce {key}: {out['__error__']}")
+        return out
+
+    def allreduce_sparse(self, key: str, rs, capacity: Optional[int] = None):
+        """Row-sparse exact-average: ships (ids, rows) — O(touched rows)
+        on the wire instead of the dense table gradient, the reference's
+        row_sparse push/pull (``kvstore_dist.h:690-748``).  ``rs`` is a
+        :class:`dt_tpu.ops.sparse.RowSparse`; the result is one too,
+        padded with sentinel slots to ``capacity``.  The default capacity
+        is the next power of two above the MERGED row count — derived from
+        the scheduler's result, so every worker pads identically (replica
+        consistency) and the consuming jit sees at most log2(nnz) distinct
+        shapes over a run.  An explicit ``capacity`` must be the same on
+        every worker; merged rows beyond it are dropped identically
+        everywhere (a warning is logged)."""
+        from dt_tpu.ops.sparse import RowSparse
+        import jax.numpy as jnp
+        seq = self._ar_seq.get(key, 0)
+        self._ar_seq[key] = seq + 1
+        out = self._req({"cmd": "allreduce", "host": self.host, "key": key,
+                         "seq": seq,
+                         "value": {"ids": np.asarray(rs.indices),
+                                   "vals": np.asarray(rs.values),
+                                   "num_rows": rs.num_rows}})["value"]
+        if isinstance(out, dict) and "__error__" in out:
+            raise RuntimeError(f"allreduce_sparse {key}: {out['__error__']}")
+        merged = len(out["ids"])
+        if capacity is None:
+            capacity = 1 << max(merged - 1, 0).bit_length()
+        n = min(merged, capacity)
+        if merged > capacity:
+            logger.warning("allreduce_sparse %s: %d merged rows exceed "
+                           "capacity %d; excess rows dropped (identically "
+                           "on every worker)", key, merged, capacity)
+        ids = np.full((capacity,), rs.num_rows, np.int32)
+        vals = np.zeros((capacity,) + np.asarray(out["vals"]).shape[1:],
+                        np.asarray(out["vals"]).dtype)
+        ids[:n] = out["ids"][:n]
+        vals[:n] = out["vals"][:n]
+        return RowSparse(jnp.asarray(ids), jnp.asarray(vals), rs.num_rows)
 
     def close(self):
         self._stop.set()

@@ -454,6 +454,12 @@ class Scheduler:
             arr = np_dequantize_2bit(np.asarray(value["packed"]),
                                      int(value["n"]),
                                      float(value["threshold"]))
+        elif isinstance(value, dict) and "ids" in value:
+            # row-sparse contribution (ids, rows): the wire carries
+            # O(touched rows), not O(vocab) — the reference's row_sparse
+            # push path (kvstore_dist.h:690-748)
+            arr = ("rsp", np.asarray(value["ids"]),
+                   np.asarray(value["vals"]), int(value["num_rows"]))
         else:
             arr = np.asarray(value)
         with self._cv:
@@ -466,7 +472,11 @@ class Scheduler:
             slot["vals"][host] = (seq, arr)
             if set(slot["vals"]) >= set(self._workers):
                 stacked = [slot["vals"][h][1] for h in self._workers]
-                slot["result"] = np.mean(stacked, axis=0)
+                if any(isinstance(a, tuple) and a[0] == "rsp"
+                       for a in stacked):
+                    slot["result"] = self._merge_sparse(stacked)
+                else:
+                    slot["result"] = np.mean(stacked, axis=0)
                 for h, (h_seq, _) in slot["vals"].items():
                     slot["served"][h] = (h_seq, slot["result"])
                 slot["vals"] = {}
@@ -477,6 +487,30 @@ class Scheduler:
                 if not self._cv.wait(timeout=300):
                     raise TimeoutError(f"allreduce {key} stuck")
             return {"value": slot["result"]}
+
+    @staticmethod
+    def _merge_sparse(stacked) -> dict:
+        """Merge row-sparse contributions: concat, sum duplicates, divide
+        by the worker count — elementwise identical to averaging the
+        dense-with-zeros equivalents (the server's merged/NumWorkers()
+        for row_sparse keys, ``kvstore_dist_server.h:345-379``).  Mixed
+        dense/sparse contributions are a caller bug: every waiter gets an
+        ``__error__`` result (raised client-side) instead of one handler
+        thread dying while the rest time out."""
+        if not all(isinstance(a, tuple) and a[0] == "rsp" for a in stacked):
+            return {"__error__": "mixed dense and row-sparse contributions "
+                                 "for one allreduce key"}
+        num_rows = stacked[0][3]
+        all_ids = np.concatenate([a[1] for a in stacked])
+        all_vals = np.concatenate([a[2] for a in stacked], axis=0)
+        live = all_ids < num_rows
+        all_ids, all_vals = all_ids[live], all_vals[live]
+        uniq, inv = np.unique(all_ids, return_inverse=True)
+        summed = np.zeros((len(uniq),) + all_vals.shape[1:],
+                          all_vals.dtype)
+        np.add.at(summed, inv, all_vals)
+        return {"ids": uniq.astype(np.int32),
+                "vals": summed / len(stacked), "num_rows": num_rows}
 
 
 def _read_hosts(path: str) -> List[str]:

@@ -13,3 +13,4 @@ from dt_tpu.ops import nn as nn
 from dt_tpu.ops import losses as losses
 from dt_tpu.ops import tensor as tensor
 from dt_tpu.ops import rnn as rnn
+from dt_tpu.ops import sparse as sparse

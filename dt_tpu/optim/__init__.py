@@ -29,6 +29,12 @@ from dt_tpu.optim.optimizers import (
     lamb as lamb,
     with_multi_precision as with_multi_precision,
 )
+from dt_tpu.optim.sparse import (
+    sparse_sgd as sparse_sgd,
+    sparse_adagrad as sparse_adagrad,
+    SparseSGDState as SparseSGDState,
+    SparseAdaGradState as SparseAdaGradState,
+)
 from dt_tpu.optim.svrg import (
     svrg as svrg,
     SVRGState as SVRGState,

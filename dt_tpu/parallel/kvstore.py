@@ -71,14 +71,48 @@ class KVStore:
 
     def push(self, key: str, values):
         """Aggregate (mean) into the store — the server-side merge
-        (``kvstore_dist_server.h:710-739``) without the wire."""
+        (``kvstore_dist_server.h:710-739``) without the wire.  Values may
+        be row-sparse (``dt_tpu.ops.sparse.RowSparse``): only the touched
+        rows of the stored dense value change, the reference's row_sparse
+        push (``kvstore_dist.h:690-748``)."""
+        from dt_tpu.ops.sparse import RowSparse
         if not isinstance(values, (list, tuple)):
             values = [values]
+        if any(isinstance(v, RowSparse) for v in values):
+            if not all(isinstance(v, RowSparse) for v in values):
+                raise ValueError(
+                    "push: mixed dense and RowSparse values for one key — "
+                    "cast_storage them to a common stype first")
+            base = np.array(self._store[key], np.float64)
+            acc = np.zeros_like(base)
+            for v in values:
+                ids = np.asarray(v.indices)
+                vals = np.asarray(v.values, np.float64)
+                keep = ids < v.num_rows
+                np.add.at(acc, ids[keep], vals[keep])
+            touched = np.zeros(base.shape[0], bool)
+            for v in values:
+                ids = np.asarray(v.indices)
+                touched[ids[ids < v.num_rows]] = True
+            base[touched] = acc[touched] / len(values)
+            self._store[key] = base.astype(self._store[key].dtype)
+            return
         merged = np.mean([np.asarray(v) for v in values], axis=0)
         self._store[key] = merged
 
     def pull(self, key: str):
         return self._store[key]
+
+    def row_sparse_pull(self, key: str, row_ids):
+        """Pull only the requested rows (reference
+        ``KVStoreDist::PullRowSparse_``, ``kvstore_dist.h:317-376``) —
+        returns a ``RowSparse`` over the stored value."""
+        from dt_tpu.ops.sparse import RowSparse
+        import jax.numpy as jnp
+        dense = self._store[key]
+        ids = np.asarray(row_ids)
+        return RowSparse(jnp.asarray(ids, jnp.int32),
+                         jnp.asarray(dense[ids]), dense.shape[0])
 
     # -- barriers / elasticity --------------------------------------------
     def barrier(self):

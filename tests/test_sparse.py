@@ -1,0 +1,384 @@
+"""Sparse slice tests: RowSparse/CSR storage, cast_storage, sparse dot,
+sparse-grad embedding, lazy optimizer updates, and the row-sparse
+transport (kvstore + scheduler allreduce).
+
+Oracles are numpy or the dense equivalents — the reference's own test
+pattern for sparse ops (``tests/python/unittest/test_sparse_operator.py``
+checks sparse against dense); the kvstore rows mirror
+``tests/nightly/dist_sync_kvstore.py``'s row_sparse cases.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dt_tpu import optim, parallel
+from dt_tpu.ops import sparse
+
+
+# ---------------------------------------------------------------------------
+# storage types
+# ---------------------------------------------------------------------------
+
+
+def test_rowsparse_to_dense_duplicates_and_sentinels():
+    rs = sparse.RowSparse(jnp.array([1, 3, 1, 5], jnp.int32),
+                          jnp.arange(8, dtype=jnp.float32).reshape(4, 2),
+                          num_rows=5)  # id 5 == sentinel (num_rows)
+    d = np.asarray(rs.to_dense())
+    want = np.zeros((5, 2), np.float32)
+    want[1] = [0, 1]
+    want[3] = [2, 3]
+    want[1] += [4, 5]  # duplicate sums
+    # id 5 dropped
+    np.testing.assert_allclose(d, want)
+
+
+def test_cast_storage_row_sparse_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3).astype(np.float32)
+    x[[1, 4, 6]] = 0.0
+    rs = sparse.cast_storage(jnp.asarray(x), "row_sparse")
+    assert rs.num_rows == 8
+    np.testing.assert_allclose(np.asarray(rs.to_dense()), x)
+    # tight capacity: exactly the 5 occupied rows
+    rs5 = sparse.row_sparse_from_dense(jnp.asarray(x), nnz=5)
+    np.testing.assert_allclose(np.asarray(rs5.to_dense()), x)
+    # jits with static shapes
+    f = jax.jit(lambda a: sparse.row_sparse_from_dense(a, nnz=5).to_dense())
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x))), x)
+
+
+def test_sparse_retain():
+    x = np.diag(np.arange(1.0, 7.0)).astype(np.float32)
+    rs = sparse.row_sparse_from_dense(jnp.asarray(x))
+    kept = sparse.sparse_retain(rs, jnp.array([1, 4]))
+    want = np.zeros_like(x)
+    want[1, 1] = 2.0
+    want[4, 4] = 5.0
+    np.testing.assert_allclose(np.asarray(kept.to_dense()), want)
+
+
+def test_aggregate_duplicates():
+    rs = sparse.RowSparse(jnp.array([2, 0, 2, 7, 0], jnp.int32),
+                          jnp.ones((5, 3), jnp.float32),
+                          num_rows=7)  # 7 == sentinel
+    agg = sparse.aggregate_duplicates(rs)
+    # each live id appears exactly once among non-sentinel slots
+    ids = np.asarray(agg.indices)
+    live = ids[ids < 7]
+    assert sorted(live.tolist()) == [0, 2]
+    np.testing.assert_allclose(np.asarray(agg.to_dense()),
+                               np.asarray(rs.to_dense()))
+    vals = np.asarray(agg.values)
+    np.testing.assert_allclose(vals[ids == 0], 2 * np.ones((1, 3)))
+    np.testing.assert_allclose(vals[ids == 2], 2 * np.ones((1, 3)))
+
+
+def test_csr_roundtrip_and_dot():
+    rng = np.random.RandomState(1)
+    a = rng.randn(6, 5).astype(np.float32)
+    a[rng.rand(6, 5) < 0.6] = 0.0
+    rhs = rng.randn(5, 4).astype(np.float32)
+    csr = sparse.cast_storage(jnp.asarray(a), "csr")
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), a)
+    np.testing.assert_allclose(np.asarray(sparse.csr_dot_dense(csr, rhs)),
+                               a @ rhs, rtol=1e-5)
+    rhs2 = rng.randn(6, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sparse.csr_dot_dense(csr, rhs2, transpose_a=True)),
+        a.T @ rhs2, rtol=1e-5, atol=1e-6)
+    # tight capacity + jit
+    nse = int((a != 0).sum())
+    f = jax.jit(lambda x, r: sparse.csr_dot_dense(
+        sparse.csr_from_dense(x, nse=nse), r))
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(a), rhs)), a @ rhs,
+                               rtol=1e-5)
+
+
+def test_csr_empty_rows_and_full_row():
+    a = np.zeros((4, 3), np.float32)
+    a[2] = [1.0, 2.0, 3.0]  # one full row, others empty
+    csr = sparse.csr_from_dense(jnp.asarray(a), nse=3)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), a)
+    r = np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(sparse.csr_dot_dense(csr, r)), a)
+
+
+# ---------------------------------------------------------------------------
+# sparse-grad embedding
+# ---------------------------------------------------------------------------
+
+
+def test_embedding_sparse_grad_matches_dense():
+    vocab, dim = 11, 4
+    rng = np.random.RandomState(2)
+    table = jnp.asarray(rng.randn(vocab, dim).astype(np.float32))
+    ids = jnp.asarray([[1, 3, 1], [7, 3, 0]], jnp.int32)
+    tgt = jnp.asarray(rng.randn(2, 3, dim).astype(np.float32))
+
+    def loss_of_rows(rows, tgt):
+        return jnp.mean((rows - tgt) ** 2)
+
+    loss, (g_rs, (g_tgt,)) = sparse.embedding_value_and_grad(
+        loss_of_rows, argnums=(0,))(table, ids, tgt)
+    assert g_tgt.shape == tgt.shape
+
+    def dense_loss(tb):
+        return loss_of_rows(sparse.embedding_lookup(tb, ids), tgt)
+
+    want_loss, want_g = jax.value_and_grad(dense_loss)(table)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_rs.to_dense()),
+                               np.asarray(want_g), rtol=1e-5, atol=1e-7)
+    assert g_rs.nnz == 6  # ids.size — dense [vocab, dim] never materialized
+
+
+# ---------------------------------------------------------------------------
+# lazy optimizer updates
+# ---------------------------------------------------------------------------
+
+
+def _rs(ids, vals, n):
+    return sparse.RowSparse(jnp.asarray(ids, jnp.int32),
+                            jnp.asarray(vals, jnp.float32), n)
+
+
+def test_sparse_sgd_plain_oracle():
+    lr, wd = 0.1, 0.01
+    opt = optim.sparse_sgd(lr, weight_decay=wd)
+    w = np.arange(12, dtype=np.float32).reshape(6, 2) / 10
+    table = jnp.asarray(w)
+    st = opt.init(table)
+    ids = [1, 4, 1]
+    g = np.ones((3, 2), np.float32)
+    table, st = jax.jit(opt.update)(_rs(ids, g, 6), st, table)
+    # oracle: duplicates sum, then touched rows only
+    w[1] -= lr * (2.0 + wd * w[1])
+    w[4] -= lr * (1.0 + wd * w[4])
+    np.testing.assert_allclose(np.asarray(table), w, rtol=1e-5)
+
+
+def test_sparse_sgd_lazy_momentum_untouched_rows_frozen():
+    """Lazy semantics (optimizer_op.cc lazy_update): momentum of rows NOT
+    in the gradient neither decays nor moves the weight."""
+    opt = optim.sparse_sgd(0.1, momentum=0.9)
+    table = jnp.zeros((4, 2))
+    st = opt.init(table)
+    # step 1 touches row 0 only -> row 0 gains momentum
+    table, st = opt.update(_rs([0], np.ones((1, 2)), 4), st, table)
+    m_after_1 = np.asarray(st.mom).copy()
+    w_after_1 = np.asarray(table).copy()
+    # step 2 touches row 3 only -> row 0's momentum and weight frozen
+    table, st = opt.update(_rs([3], np.ones((1, 2)), 4), st, table)
+    np.testing.assert_allclose(np.asarray(st.mom)[0], m_after_1[0])
+    np.testing.assert_allclose(np.asarray(table)[0], w_after_1[0])
+    assert not np.allclose(np.asarray(table)[3], 0.0)
+
+
+def test_sparse_sgd_std_update_matches_dense():
+    """std_update=False lazy flag off: identical trajectory to the dense
+    SGD on the dense-with-zeros gradient (the reference's equivalence)."""
+    lr, mom, wd = 0.1, 0.9, 0.01
+    sp = optim.sparse_sgd(lr, momentum=mom, weight_decay=wd,
+                          lazy_update=False)
+    dn = optim.sgd(lr, momentum=mom, weight_decay=wd)
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(5, 3).astype(np.float32)
+    table_s = jnp.asarray(w0)
+    st_s = sp.init(table_s)
+    p_d = {"t": jnp.asarray(w0)}
+    st_d = dn.init(p_d)
+    for step in range(4):
+        ids = rng.randint(0, 5, size=3)
+        vals = rng.randn(3, 3).astype(np.float32)
+        rs = _rs(ids, vals, 5)
+        table_s, st_s = sp.update(rs, st_s, table_s)
+        g_dense = {"t": rs.to_dense()}
+        upd, st_d = dn.update(g_dense, st_d, p_d)
+        import optax
+        p_d = optax.apply_updates(p_d, upd)
+    np.testing.assert_allclose(np.asarray(table_s), np.asarray(p_d["t"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_sparse_sgd_std_update_plain_wd_matches_dense():
+    """std path with momentum=0: every row pays wd every step, matching
+    the dense optimizer on the dense-with-zeros gradient."""
+    import optax
+    lr, wd = 0.1, 0.05
+    sp = optim.sparse_sgd(lr, weight_decay=wd, lazy_update=False)
+    dn = optim.sgd(lr, weight_decay=wd)
+    rng = np.random.RandomState(7)
+    w0 = rng.randn(5, 2).astype(np.float32)
+    table_s = jnp.asarray(w0)
+    st_s = sp.init(table_s)
+    p_d = {"t": jnp.asarray(w0)}
+    st_d = dn.init(p_d)
+    for step in range(3):
+        rs = _rs(rng.randint(0, 5, 2), rng.randn(2, 2), 5)
+        table_s, st_s = sp.update(rs, st_s, table_s)
+        upd, st_d = dn.update({"t": rs.to_dense()}, st_d, p_d)
+        p_d = optax.apply_updates(p_d, upd)
+    np.testing.assert_allclose(np.asarray(table_s), np.asarray(p_d["t"]),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_kvstore_push_mixed_raises():
+    kv = parallel.create("local")
+    kv.init("k", np.zeros((4, 2), np.float32))
+    with pytest.raises(ValueError, match="mixed"):
+        kv.push("k", [_rs([0], np.ones((1, 2)), 4),
+                      np.ones((4, 2), np.float32)])
+
+
+def test_sparse_adagrad_oracle_and_dense_match():
+    lr, wd, eps = 0.5, 0.01, 1e-7
+    sp = optim.sparse_adagrad(lr, weight_decay=wd, epsilon=eps)
+    rng = np.random.RandomState(4)
+    w0 = rng.randn(6, 2).astype(np.float32)
+    table = jnp.asarray(w0)
+    st = sp.init(table)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for step in range(3):
+        ids = rng.randint(0, 6, size=4)
+        vals = rng.randn(4, 2).astype(np.float32)
+        rs = _rs(ids, vals, 6)
+        table, st = jax.jit(sp.update)(rs, st, table)
+        # numpy oracle with duplicate aggregation
+        gd = np.zeros_like(w)
+        np.add.at(gd, ids, vals)
+        touched = np.zeros(6, bool)
+        touched[ids] = True
+        h[touched] += gd[touched] ** 2
+        w[touched] -= lr * (gd[touched] / np.sqrt(h[touched] + eps)
+                            + wd * w[touched])
+    np.testing.assert_allclose(np.asarray(table), w, rtol=1e-4, atol=1e-6)
+    # when EVERY row is touched each step, lazy == dense adagrad
+    sp2 = optim.sparse_adagrad(lr, weight_decay=wd, epsilon=eps)
+    dn2 = optim.adagrad(lr, weight_decay=wd, epsilon=eps)
+    t_s = jnp.asarray(w0)
+    st_s = sp2.init(t_s)
+    p_d = {"t": jnp.asarray(w0)}
+    st_d = dn2.init(p_d)
+    import optax
+    for step in range(3):
+        vals = rng.randn(6, 2).astype(np.float32)
+        t_s, st_s = sp2.update(_rs(np.arange(6), vals, 6), st_s, t_s)
+        upd, st_d = dn2.update({"t": jnp.asarray(vals)}, st_d, p_d)
+        p_d = optax.apply_updates(p_d, upd)
+    np.testing.assert_allclose(np.asarray(t_s), np.asarray(p_d["t"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: embedding model trains sparse == dense
+# ---------------------------------------------------------------------------
+
+
+def test_embedding_model_sparse_training_matches_dense():
+    """Tiny bag-of-tokens classifier: embedding -> mean pool -> fixed
+    linear head.  Sparse path (row-sparse grads + lazy adagrad) must match
+    the dense path (dense grads + dense adagrad) because adagrad's lazy
+    update on touched rows IS the dense update when untouched rows have
+    zero grad (VERDICT round-1 'Done =' criterion)."""
+    vocab, dim, ncls = 17, 5, 3
+    rng = np.random.RandomState(5)
+    head = jnp.asarray(rng.randn(dim, ncls).astype(np.float32))
+    table_s = jnp.asarray(rng.randn(vocab, dim).astype(np.float32) * 0.1)
+    table_d = table_s
+
+    def loss_of_rows(rows, labels):
+        logits = rows.mean(axis=1) @ head
+        return -jnp.mean(jax.nn.log_softmax(logits)[
+            jnp.arange(labels.shape[0]), labels])
+
+    sp = optim.sparse_adagrad(0.2)
+    st_s = sp.init(table_s)
+    dn = optim.adagrad(0.2)
+    st_d = dn.init({"t": table_d})
+    import optax
+    vg = sparse.embedding_value_and_grad(loss_of_rows)
+
+    @jax.jit
+    def step_sparse(table, st, ids, y):
+        loss, (g_rs, _) = vg(table, ids, y)
+        table, st = sp.update(g_rs, st, table)
+        return table, st, loss
+
+    @jax.jit
+    def step_dense(table, st, ids, y):
+        def f(tb):
+            return loss_of_rows(sparse.embedding_lookup(tb, ids), y)
+        loss, g = jax.value_and_grad(f)(table)
+        upd, st = dn.update({"t": g}, st, {"t": table})
+        return optax.apply_updates({"t": table}, upd)["t"], st, loss
+
+    for i in range(10):
+        ids = jnp.asarray(rng.randint(0, vocab, (4, 6)), jnp.int32)
+        y = jnp.asarray(rng.randint(0, ncls, (4,)), jnp.int32)
+        table_s, st_s, loss_s = step_sparse(table_s, st_s, ids, y)
+        table_d, st_d, loss_d = step_dense(table_d, st_d, ids, y)
+        np.testing.assert_allclose(float(loss_s), float(loss_d), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(table_s), np.asarray(table_d),
+                               rtol=1e-4, atol=1e-6)
+    assert float(loss_s) < 1.2  # it actually learned something
+
+
+# ---------------------------------------------------------------------------
+# transport: kvstore + scheduler allreduce
+# ---------------------------------------------------------------------------
+
+
+def test_kvstore_row_sparse_push_pull():
+    kv = parallel.create("local")
+    kv.init("emb", np.ones((6, 2), np.float32))
+    kv.push("emb", [_rs([1, 3], np.full((2, 2), 4.0), 6),
+                    _rs([1], np.full((1, 2), 2.0), 6)])
+    out = kv.pull("emb")
+    np.testing.assert_allclose(out[1], 3.0)   # (4+2)/2
+    np.testing.assert_allclose(out[3], 2.0)   # (4+0)/2
+    np.testing.assert_allclose(out[0], 1.0)   # untouched
+    rs = kv.row_sparse_pull("emb", np.array([3, 0]))
+    np.testing.assert_allclose(np.asarray(rs.values),
+                               [[2.0, 2.0], [1.0, 1.0]])
+
+
+def test_scheduler_allreduce_sparse(tmp_path):
+    from dt_tpu.elastic import Scheduler, WorkerClient
+    hw = str(tmp_path / "hosts")
+    with open(hw, "w") as f:
+        f.write("w0\nw1\n")
+    s = Scheduler(host_worker_file=hw)
+    try:
+        cs = [WorkerClient("127.0.0.1", s.port, host=h, is_new=False)
+              for h in ("w0", "w1")]
+        outs = {}
+
+        def push(c, ids, vals):
+            outs[c.host] = c.allreduce_sparse(
+                "emb", _rs(ids, vals, 10), capacity=6)
+
+        ts = [threading.Thread(target=push, args=(cs[0], [2, 5, 2],
+                                                  np.ones((3, 2)))),
+              threading.Thread(target=push, args=(cs[1], [5, 9],
+                                                  2 * np.ones((2, 2))))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert set(outs) == {"w0", "w1"}
+        want = np.zeros((10, 2), np.float32)
+        want[2] = 1.0   # (2*1 + 0)/2
+        want[5] = 1.5   # (1 + 2)/2
+        want[9] = 1.0   # (0 + 2)/2
+        for h, rs in outs.items():
+            assert rs.nnz == 6  # padded to capacity -> step-invariant jit
+            np.testing.assert_allclose(np.asarray(rs.to_dense()), want,
+                                       rtol=1e-6)
+    finally:
+        s.close()
