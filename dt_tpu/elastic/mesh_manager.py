@@ -91,6 +91,12 @@ class MeshManager:
     def teardown(self):
         if self._initialized:
             jax.distributed.shutdown()
+            # the XLA client caches the old world's device topology; drop
+            # it so the next initialize() builds a client for the NEW world
+            # (without this, jax.devices() keeps showing removed hosts'
+            # devices and collectives hang)
+            import jax.extend.backend as jex_backend
+            jex_backend.clear_backends()
             self._initialized = False
         self.mesh = None
 

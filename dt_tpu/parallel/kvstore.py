@@ -85,15 +85,13 @@ class KVStore:
                     "cast_storage them to a common stype first")
             base = np.array(self._store[key], np.float64)
             acc = np.zeros_like(base)
+            touched = np.zeros(base.shape[0], bool)
             for v in values:
                 ids = np.asarray(v.indices)
                 vals = np.asarray(v.values, np.float64)
                 keep = ids < v.num_rows
                 np.add.at(acc, ids[keep], vals[keep])
-            touched = np.zeros(base.shape[0], bool)
-            for v in values:
-                ids = np.asarray(v.indices)
-                touched[ids[ids < v.num_rows]] = True
+                touched[ids[keep]] = True
             base[touched] = acc[touched] / len(values)
             self._store[key] = base.astype(self._store[key].dtype)
             return

@@ -53,6 +53,21 @@ def softmax_ce_loss(logits, labels):
     return losses_lib.softmax_cross_entropy(logits, labels)
 
 
+def _local_np(x) -> np.ndarray:
+    """Fetch an array to host.  Multi-host: a batch-sharded global array
+    spans non-addressable devices, so fetch only THIS process's shards —
+    they are exactly this process's batch rows (assembled by
+    ``jax.make_array_from_process_local_data``), matching the local labels
+    the metric compares against."""
+    if jax.process_count() > 1 and hasattr(x, "addressable_shards") and \
+            not x.is_fully_addressable:
+        shards = sorted(x.addressable_shards,
+                        key=lambda s: (s.index[0].start or 0) if s.index
+                        else 0)
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    return np.asarray(jax.device_get(x))
+
+
 def _softmax_np(logits: np.ndarray) -> np.ndarray:
     """Metrics follow the reference convention that predictions are
     PROBABILITIES (SoftmaxOutput emitted probs); models here emit logits, so
@@ -234,6 +249,14 @@ class Module:
         self._apply_step = jax.jit(apply_step)
 
     def _place(self, arr):
+        if jax.process_count() > 1:
+            # multi-host: this process holds only ITS batch shard; assemble
+            # the global array from per-process local data (device_put of a
+            # host-local array would be wrong here — it assumes the full
+            # global batch is addressable locally)
+            return jax.make_array_from_process_local_data(
+                mesh_lib.data_sharding(self.mesh, np.ndim(arr)),
+                np.asarray(arr))
         if self.mesh.size > 1:
             return jax.device_put(jnp.asarray(arr),
                                   mesh_lib.data_sharding(self.mesh,
@@ -401,7 +424,7 @@ class Module:
         callback — same ordering as the reference's synchronous loop, just
         deferred one step so device dispatch never drains for metrics."""
         lab, n_real, lg = pending
-        probs = _softmax_np(np.asarray(jax.device_get(lg)))
+        probs = _softmax_np(_local_np(lg))
         eval_metric.update(lab[:n_real], probs[:n_real])
         nbatch += 1
         if batch_end_callback is not None:
@@ -446,16 +469,19 @@ class Module:
                 break
             logits = self._eval_step(self.state, self._place(batch.data))
             n_real = batch.data.shape[0] - batch.pad
-            probs = _softmax_np(np.asarray(jax.device_get(logits)))
+            # multi-host: local logits shard vs local labels (same rows)
+            probs = _softmax_np(_local_np(logits))
             eval_metric.update(np.asarray(batch.label)[:n_real],
                                probs[:n_real])
         return eval_metric.get_name_value()
 
     def predict(self, data) -> np.ndarray:
+        """Multi-host note: ``data`` is this process's local shard and the
+        returned predictions are for those local rows."""
         if self._eval_step is None:
             self._build_steps()
         out = self._eval_step(self.state, self._place(np.asarray(data)))
-        return np.asarray(jax.device_get(out))
+        return _local_np(out)
 
 
 def _peek_batch(data_iter):

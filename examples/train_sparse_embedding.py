@@ -53,6 +53,11 @@ def main():
     ctrl = auto_client()
     nworkers = ctrl.num_workers if ctrl is not None else 1
     rank = ctrl.rank if ctrl is not None else 0
+    if args.dense and nworkers > 1:
+        ap.error("--dense compares against a LOCAL dense step; under the "
+                 "elastic launcher the sparse path applies the cross-worker "
+                 "average, so the comparison is only meaningful "
+                 "single-process")
 
     rng = np.random.RandomState(args.seed)
     # synthetic clustered token stream: tokens co-occur within blocks, so

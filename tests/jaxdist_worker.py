@@ -1,0 +1,101 @@
+"""Worker process for the 2-process ``jax.distributed`` CPU test.
+
+Spawned by ``tests/test_jax_distributed.py`` (never run under pytest
+directly).  Each process owns ONE virtual CPU device; the pair forms a
+2-device global mesh — the smallest honest model of a multi-host TPU pod
+(one process per host, cross-process gradient allreduce).
+
+Flow (the VERDICT round-1 'Done =' criterion for the multi-host path):
+``MeshManager.initialize`` (executes the ``jax.distributed`` branch) ->
+``Module.fit`` one epoch (batch assembled via
+``jax.make_array_from_process_local_data``) -> dump params ->
+``MeshManager.rebuild`` with a NEW coordinator (full teardown/re-init
+dance, same world size: the "replace a host" case) -> fit -> dump ->
+rank 1 exits (the "-1 process" case) -> rank 0 rebuilds to a
+single-process world and fits a third epoch.
+
+Reference analog: ps-lite rendezvous (``van.cc:95-185``) + world resize
+(``postoffice.cc:71-187``) driven by ``tests/nightly/dist_sync_kvstore.py``.
+"""
+
+import os
+import sys
+
+
+def main():
+    out_dir = sys.argv[1]
+    pid = int(sys.argv[2])
+    port1 = sys.argv[3]
+    port2 = sys.argv[4]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import numpy as np
+
+    from dt_tpu import data, models
+    from dt_tpu.elastic.mesh_manager import MeshManager
+    from dt_tpu.training import Module
+
+    def dump(tag, state):
+        flat, _ = jax.flatten_util.ravel_pytree(
+            jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
+                                   state.params))
+        np.save(os.path.join(out_dir, f"params_{tag}_r{pid}.npy"),
+                np.asarray(flat))
+
+    def make_module(mesh):
+        mod = Module(models.create("mlp", num_classes=4, hidden=(16,)),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1,
+                                       "momentum": 0.9},
+                     mesh=mesh)
+        return mod
+
+    def fit_one_epoch(mod, num_parts, part_index, global_batch=8):
+        rng = np.random.RandomState(42)  # SAME dataset on every process
+        x = rng.uniform(-1, 1, (64, 6, 6, 1)).astype(np.float32)
+        y = rng.randint(0, 4, 64).astype(np.int32)
+        it = data.NDArrayIter(x, y, batch_size=global_batch // num_parts,
+                              num_parts=num_parts, part_index=part_index)
+        mod.fit(it, num_epoch=1)
+
+    mm = MeshManager(coordinator_address=f"127.0.0.1:{port1}")
+
+    # --- world 1: two processes, one device each -------------------------
+    mesh = mm.initialize(num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+    mod = make_module(mesh)
+    fit_one_epoch(mod, num_parts=2, part_index=pid)
+    dump("epoch1", mod.state)
+    print(f"rank {pid}: epoch1 done", flush=True)
+
+    # --- rebuild, same size, NEW coordinator (the "replace host" case) ---
+    mesh, state = mm.rebuild(mod.state, num_processes=2, process_id=pid,
+                             coordinator_address=f"127.0.0.1:{port2}")
+    assert jax.process_count() == 2
+    mod2 = make_module(mesh)
+    mod2.state = state
+    fit_one_epoch(mod2, num_parts=2, part_index=pid)
+    dump("epoch2", mod2.state)
+    print(f"rank {pid}: epoch2 done", flush=True)
+
+    # --- -1 process: rank 1 leaves, rank 0 continues alone --------------
+    if pid == 1:
+        mm.teardown()
+        print("rank 1: removed, exiting", flush=True)
+        return
+    mesh, state = mm.rebuild(mod2.state, num_processes=1, process_id=0)
+    assert jax.process_count() == 1
+    assert len(jax.devices()) == 1
+    mod3 = make_module(mesh)
+    mod3.state = state
+    fit_one_epoch(mod3, num_parts=1, part_index=0)
+    dump("epoch3", mod3.state)
+    print("rank 0: epoch3 done (solo world)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
