@@ -2,9 +2,11 @@
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
 import flax.linen as linen
+import jax
 import jax.numpy as jnp
 
 from dt_tpu.ops import nn as nn_ops
@@ -18,10 +20,58 @@ BN_MOMENTUM = 0.9
 BN_EPS = 1e-5
 
 
+class FusedBatchNorm(linen.Module):
+    """BatchNorm whose EVAL path runs the Pallas fused scale/bias kernel
+    (``dt_tpu.ops.pallas.kernels.fused_bn_inference``) — the cuDNN fused-BN
+    analog (``src/operator/nn/batch_norm.cu``).  Variable layout (params
+    ``scale``/``bias``, batch_stats ``mean``/``var``) matches
+    ``linen.BatchNorm`` exactly, so checkpoints swap between the two.
+    Training mode is plain jnp (differentiable, updates running stats)."""
+
+    use_running_average: bool = False
+    momentum: float = BN_MOMENTUM
+    epsilon: float = BN_EPS
+    dtype: Dtype = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = (self.use_running_average
+                  if use_running_average is None else use_running_average)
+        c = x.shape[-1]
+        scale = self.param("scale", linen.initializers.ones, (c,))
+        bias = self.param("bias", linen.initializers.zeros, (c,))
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+        if use_ra:
+            from dt_tpu.ops.pallas.kernels import fused_bn_inference
+            return fused_bn_inference(x, scale, bias, ra_mean.value,
+                                      ra_var.value,
+                                      eps=self.epsilon).astype(self.dtype)
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+        var = jnp.var(x.astype(jnp.float32), axis=axes)
+        if not self.is_initializing():
+            ra_mean.value = self.momentum * ra_mean.value \
+                + (1.0 - self.momentum) * mean
+            ra_var.value = self.momentum * ra_var.value \
+                + (1.0 - self.momentum) * var
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        y = (x.astype(jnp.float32) - mean) * (inv * scale) + bias
+        return y.astype(self.dtype)
+
+
 def bn(training: bool, dtype: Dtype = jnp.float32, name: Optional[str] = None
-       ) -> linen.BatchNorm:
+       ) -> linen.Module:
     """The one BatchNorm construction every model uses (keeps momentum/eps
-    conventions in a single place)."""
+    conventions in a single place).  ``DT_PALLAS_BN=1`` swaps in
+    :class:`FusedBatchNorm` (identical variable layout) so eval/predict
+    paths run the Pallas fused kernel."""
+    if os.environ.get("DT_PALLAS_BN") == "1":
+        return FusedBatchNorm(use_running_average=not training,
+                              momentum=BN_MOMENTUM, epsilon=BN_EPS,
+                              dtype=dtype, name=name)
     return linen.BatchNorm(use_running_average=not training,
                            momentum=BN_MOMENTUM, epsilon=BN_EPS, dtype=dtype,
                            name=name)

@@ -193,6 +193,7 @@ def _lstm_point_kernel(gates_ref, c_ref, h_out_ref, c_out_ref, *, hidden: int):
     c_out_ref[:] = c_new.astype(c_out_ref.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def lstm_pointwise(gates: jax.Array, c: jax.Array,
                    block_rows: int = 256,
                    interpret: Optional[bool] = None
@@ -203,18 +204,28 @@ def lstm_pointwise(gates: jax.Array, c: jax.Array,
     Matches ``dt_tpu.ops.rnn.lstm_cell`` post-matmul math (gate order
     i,f,g,o).  One VMEM pass instead of ~10 separate HLO elementwise ops —
     the fusion cuDNN's fused LSTM did for the reference.
+
+    Differentiable: a custom VJP recomputes the cheap activations on the
+    backward pass (jnp ops, XLA-fused) so the fused cell trains — the
+    rematerialize-activations strategy cuDNN's LSTM backward uses.
     """
+    return _lstm_pointwise_fwd(gates, c, block_rows, interpret)[0]
+
+
+def _lstm_pointwise_fwd(gates, c, block_rows, interpret):
     if interpret is None:
         interpret = _default_interpret()
+    orig_gates = gates  # residual keeps the PRIMAL dtype for the cotangent
     gates = gates.astype(jnp.float32)  # nonlinearities read f32 pre-acts
     b, four_h = gates.shape
     hidden = four_h // 4
     # tile over batch so gates blocks fit VMEM at large B*H
     rows = min(block_rows, b)
     padded = _round_up(b, rows)
+    gates_p, c_p = gates, c
     if padded != b:
-        gates = jnp.pad(gates, ((0, padded - b), (0, 0)))
-        c = jnp.pad(c, ((0, padded - b), (0, 0)))
+        gates_p = jnp.pad(gates, ((0, padded - b), (0, 0)))
+        c_p = jnp.pad(c, ((0, padded - b), (0, 0)))
     h_out, c_out = pl.pallas_call(
         functools.partial(_lstm_point_kernel, hidden=hidden),
         out_shape=(jax.ShapeDtypeStruct((padded, hidden), jnp.float32),
@@ -229,8 +240,37 @@ def lstm_pointwise(gates: jax.Array, c: jax.Array,
                    pl.BlockSpec((rows, hidden), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM)),
         interpret=interpret,
-    )(gates, c)
-    return h_out[:b], c_out[:b]
+    )(gates_p, c_p)
+    return (h_out[:b], c_out[:b]), (orig_gates, c)
+
+
+def _lstm_pointwise_bwd(block_rows, interpret, res, cts):
+    """LSTM cell backward from the saved pre-activations (recompute the
+    activations — VPU-cheap — instead of storing four per-gate tensors)."""
+    gates, c = res
+    gh, gc_out = cts
+    c32 = c.astype(jnp.float32)
+    gh = gh.astype(jnp.float32)
+    gc_out = gc_out.astype(jnp.float32)
+    gates_dtype = gates.dtype
+    gates = gates.astype(jnp.float32)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c32 + i * g
+    tc = jnp.tanh(c_new)
+    dc_new = gc_out + gh * o * (1.0 - tc * tc)
+    d_i = dc_new * g * i * (1.0 - i)
+    d_f = dc_new * c32 * f * (1.0 - f)
+    d_g = dc_new * i * (1.0 - g * g)
+    d_o = gh * tc * o * (1.0 - o)
+    d_gates = jnp.concatenate([d_i, d_f, d_g, d_o],
+                              axis=-1).astype(gates_dtype)
+    d_c = (dc_new * f).astype(c.dtype)
+    return d_gates, d_c
+
+
+lstm_pointwise.defvjp(_lstm_pointwise_fwd, _lstm_pointwise_bwd)
 
 
 def lstm_cell_fused(x: jax.Array, h: jax.Array, c: jax.Array, w,

@@ -61,7 +61,13 @@ def _local_np(x) -> np.ndarray:
     the metric compares against."""
     if jax.process_count() > 1 and hasattr(x, "addressable_shards") and \
             not x.is_fully_addressable:
-        shards = sorted(x.addressable_shards,
+        # one shard per distinct global index: replicas (e.g. over a model
+        # axis) would otherwise duplicate rows
+        by_index = {}
+        for s in x.addressable_shards:
+            key = tuple((sl.start, sl.stop) for sl in s.index)
+            by_index.setdefault(key, s)
+        shards = sorted(by_index.values(),
                         key=lambda s: (s.index[0].start or 0) if s.index
                         else 0)
         return np.concatenate([np.asarray(s.data) for s in shards], axis=0)

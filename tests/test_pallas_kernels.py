@@ -97,3 +97,81 @@ def test_kernels_jit_compatible():
                                     jnp.zeros(8), jnp.ones(8),
                                     interpret=True)
     assert f(jnp.ones((4, 8))).shape == (4, 8)
+
+
+def test_fused_lstm_sequence_trains_and_matches_oracle():
+    """The hot-path wiring (VERDICT round-1 item 5): rnn.lstm(fused=True)
+    runs the Pallas cell inside the scan and is TRAINABLE — the custom VJP
+    gradient matches the oracle path's jax.grad to float tolerance."""
+    rng = jax.random.PRNGKey(7)
+    T, B, I, H = 5, 4, 8, 8
+    ws = rnn.init_lstm_weights(rng, 1, I, H)
+    x = jax.random.normal(jax.random.PRNGKey(8), (T, B, I))
+    h0 = jnp.zeros((1, B, H))
+    c0 = jnp.zeros((1, B, H))
+
+    def loss(w, fused):
+        outs, hT, cT = rnn.lstm(x, h0, c0, [w], fused=fused)
+        return jnp.sum(outs ** 2) + jnp.sum(hT) + jnp.sum(cT)
+
+    lo, go = jax.value_and_grad(lambda w: loss(w, False))(ws[0])
+    lp, gp = jax.value_and_grad(lambda w: loss(w, True))(ws[0])
+    np.testing.assert_allclose(float(lp), float(lo), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gp),
+                    jax.tree_util.tree_leaves(go)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_env_flag_gates_fused_cell(monkeypatch):
+    monkeypatch.setenv("DT_PALLAS_RNN", "1")
+    assert rnn._use_fused(None) is True
+    monkeypatch.delenv("DT_PALLAS_RNN")
+    assert rnn._use_fused(None) is False
+    assert rnn._use_fused(True) is True
+
+
+def test_fused_batchnorm_matches_linen_and_swaps_state():
+    """models.common.FusedBatchNorm: same variable layout as
+    linen.BatchNorm, same eval outputs (Pallas kernel), same training-mode
+    running-stat updates — checkpoints swap freely (DT_PALLAS_BN gate)."""
+    import flax.linen as linen
+    from dt_tpu.models import common
+
+    x = jax.random.normal(jax.random.PRNGKey(9), (4, 6, 6, 8))
+    ref = linen.BatchNorm(use_running_average=False, momentum=0.9,
+                          epsilon=1e-5)
+    fused = common.FusedBatchNorm(use_running_average=False)
+    v_ref = ref.init(jax.random.PRNGKey(0), x)
+    v_fused = fused.init(jax.random.PRNGKey(0), x)
+    assert jax.tree_util.tree_structure(v_ref) == \
+        jax.tree_util.tree_structure(v_fused)
+
+    # one training step: same outputs + same running-stat updates
+    y_ref, m_ref = ref.apply(v_ref, x, mutable=["batch_stats"])
+    y_f, m_f = fused.apply(v_ref, x, mutable=["batch_stats"])  # SWAPPED vars
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(m_f),
+                    jax.tree_util.tree_leaves(m_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # eval path (the Pallas kernel, interpret off-TPU) matches linen eval
+    stats = m_ref["batch_stats"]
+    ref_e = linen.BatchNorm(use_running_average=True, momentum=0.9,
+                            epsilon=1e-5)
+    fused_e = common.FusedBatchNorm(use_running_average=True)
+    vars_e = {"params": v_ref["params"], "batch_stats": stats}
+    np.testing.assert_allclose(
+        np.asarray(fused_e.apply(vars_e, x)),
+        np.asarray(ref_e.apply(vars_e, x)), rtol=1e-5, atol=1e-5)
+
+
+def test_bn_env_flag_swaps_module(monkeypatch):
+    from dt_tpu.models import common
+    monkeypatch.setenv("DT_PALLAS_BN", "1")
+    assert isinstance(common.bn(True), common.FusedBatchNorm)
+    monkeypatch.delenv("DT_PALLAS_BN")
+    import flax.linen as linen
+    assert isinstance(common.bn(True), linen.BatchNorm)
