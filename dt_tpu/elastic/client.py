@@ -42,6 +42,7 @@ class WorkerClient:
         self.rank: int = resp["rank"]
         self.workers: List[str] = resp["workers"]
         self._ar_seq: Dict[str, int] = {}
+        self._prof_seq = 0  # last applied remote-profiler command
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval_s,),
@@ -76,10 +77,43 @@ class WorkerClient:
     def _heartbeat_loop(self, interval: float):
         while not self._stop.is_set():
             try:
-                self._req({"cmd": "heartbeat", "host": self.host}, timeout=10)
+                resp = self._req({"cmd": "heartbeat", "host": self.host,
+                                  "pseq": self._prof_seq}, timeout=10)
+                for c in resp.get("profile_cmds", []):
+                    self._apply_profile_cmd(c)
             except (OSError, RuntimeError):
                 pass  # scheduler gone; dead-node detection is its problem
             self._stop.wait(interval)
+
+    def _apply_profile_cmd(self, c: dict) -> None:
+        """Apply one remote profiler command locally (rank-prefixed output),
+        the worker side of the reference's server-profiler protocol
+        (``kvstore_dist_server.h:275-322``)."""
+        from dt_tpu.utils import profiler
+        try:
+            profiler.apply_remote(c["action"], c.get("params") or {},
+                                  rank=self.rank)
+        except Exception:  # profiler trouble must not kill heartbeats
+            logger.exception("remote profiler command %r failed", c)
+        self._prof_seq = max(self._prof_seq, c["seq"])
+
+    def profile_command(self, action: str, params: Optional[dict] = None
+                        ) -> int:
+        """Broadcast a profiler command to every worker — reference
+        ``kv.set_server_profiler_command`` (``kvstore_dist.h:102-110``).
+        Applied SYNCHRONOUSLY on this worker (so run→step→dump in caller
+        code profiles the step even within one heartbeat interval); other
+        workers apply at their next heartbeat.  ``post_seq`` makes
+        at-least-once retries idempotent on the scheduler."""
+        self._prof_post = getattr(self, "_prof_post", 0) + 1
+        seq = self._req({"cmd": "profile", "action": action,
+                         "params": params or {}, "host": self.host,
+                         "post_seq": self._prof_post})["seq"]
+        # mark seen BEFORE applying: our own heartbeat must not re-apply
+        self._prof_seq = max(self._prof_seq, seq)
+        self._apply_profile_cmd({"seq": seq, "action": action,
+                                 "params": params or {}})
+        return seq
 
     # ------------------------------------------------------------------
     # the KVStore-controller surface (consumed by dt_tpu.parallel.kvstore)

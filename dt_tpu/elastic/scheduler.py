@@ -98,6 +98,10 @@ class Scheduler:
         self._snapshot_lock = threading.Lock()
         # allreduce state: key -> {host: array}; generation counting
         self._reduce: Dict[str, dict] = {}
+        # remote profiler control (rank 0 drives all workers)
+        self._profile_cmds: List[dict] = []
+        self._profile_seq = 0
+        self._profile_posted: Dict[tuple, int] = {}  # retry dedup
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -180,7 +184,34 @@ class Scheduler:
         if cmd == "heartbeat":
             with self._lock:
                 self._heartbeats[msg["host"]] = time.time()
-            return {}
+                pseq = int(msg.get("pseq", 0))
+                newer = [c for c in self._profile_cmds if c["seq"] > pseq]
+            # profiler control rides the heartbeat (the reference's
+            # KVStoreServerProfilerCommand round, kvstore_dist.h:102-110)
+            return {"profile_cmds": newer} if newer else {}
+        if cmd == "profile":
+            # rank-0-drives-all profiling (kvstore_dist_server.h:275-322):
+            # record the command; every worker picks it up on its next
+            # heartbeat and applies it locally with a rank prefix.
+            # (host, post_seq) dedups at-least-once client retries — a
+            # re-sent command returns its original seq instead of being
+            # re-enqueued after later commands.
+            with self._lock:
+                key = (msg.get("host"), msg.get("post_seq"))
+                if key[0] is not None and key in self._profile_posted:
+                    return {"seq": self._profile_posted[key]}
+                self._profile_seq += 1
+                self._profile_cmds.append(
+                    {"seq": self._profile_seq,
+                     "action": msg["action"],
+                     "params": msg.get("params") or {}})
+                del self._profile_cmds[:-32]  # bounded history
+                if key[0] is not None:
+                    self._profile_posted[key] = self._profile_seq
+                    while len(self._profile_posted) > 128:
+                        self._profile_posted.pop(
+                            next(iter(self._profile_posted)))
+                return {"seq": self._profile_seq}
         if cmd == "mc_barrier":
             return self._mc_barrier(msg["host"], int(msg["epoch"]),
                                     msg.get("info") or {})

@@ -38,3 +38,68 @@ def test_rank_prefixed_output(tmp_path):
     profiler.set_state("stop")
     assert glob.glob(os.path.join(str(tmp_path), "rank2_prof", "**", "*"),
                      recursive=True)
+
+
+def test_remote_profiler_protocol(tmp_path, monkeypatch):
+    """The server-profiler round (kvstore_dist_server.h:275-322 analog):
+    one worker posts profile commands through the scheduler; EVERY worker
+    applies them at its next heartbeat, with its own rank prefix."""
+    import threading
+    import time
+
+    from dt_tpu.elastic import Scheduler, WorkerClient
+
+    applied = []
+    lock = threading.Lock()
+
+    def rec_set_config(**kw):
+        with lock:
+            applied.append(("set_config", kw))
+
+    def rec_set_state(state="stop", rank=None):
+        with lock:
+            applied.append(("set_state", state, rank))
+
+    monkeypatch.setattr(profiler, "set_config", rec_set_config)
+    monkeypatch.setattr(profiler, "set_state", rec_set_state)
+
+    hw = str(tmp_path / "hosts")
+    with open(hw, "w") as f:
+        f.write("w0\nw1\n")
+    s = Scheduler(host_worker_file=hw)
+    try:
+        cs = [WorkerClient("127.0.0.1", s.port, host=h, is_new=False,
+                           heartbeat_interval_s=0.1)
+              for h in ("w0", "w1")]
+
+        class KV:  # minimal kvstore carrying the controller
+            _controller = cs[0]
+
+        profiler.set_config_all(KV, filename=str(tmp_path / "prof"))
+        profiler.set_state_all(KV, "run")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with lock:
+                states = [a for a in applied if a[0] == "set_state"]
+                configs = [a for a in applied if a[0] == "set_config"]
+            if len(states) >= 2 and len(configs) >= 2:
+                break
+            time.sleep(0.05)
+        # both workers applied the config and started, each with ITS rank
+        assert len(configs) >= 2
+        assert {a[2] for a in states} == {0, 1}, states
+        assert all(a[1] == "run" for a in states)
+        # commands are applied once per worker, not re-applied every beat
+        time.sleep(0.5)
+        with lock:
+            n_states = len([a for a in applied if a[0] == "set_state"])
+        assert n_states == 2, applied
+        for c in cs:
+            c.close()
+    finally:
+        s.close()
+
+
+def test_apply_remote_unknown_action():
+    with pytest.raises(ValueError, match="unknown remote profiler"):
+        profiler.apply_remote("explode", {}, rank=0)
