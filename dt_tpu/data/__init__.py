@@ -17,6 +17,7 @@ from dt_tpu.data.io import (
     LibSVMIter as LibSVMIter,
     ResizeIter as ResizeIter,
     PrefetchingIter as PrefetchingIter,
+    DevicePrefetchIter as DevicePrefetchIter,
     SyntheticImageIter as SyntheticImageIter,
     ElasticDataIterator as ElasticDataIterator,
 )

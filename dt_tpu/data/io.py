@@ -326,6 +326,74 @@ class PrefetchingIter(DataIter):
         return item
 
 
+class DevicePrefetchIter(DataIter):
+    """Double-buffered host->device transfer: ``jax.device_put`` the NEXT
+    batch (async dispatch) while the trainer computes on the current one.
+
+    Reference analog: the C++ ``PrefetcherIter`` feeding pinned-memory
+    copies ahead of the GPU (``src/io/iter_prefetcher.h``); on TPU the
+    transfer rides the async dispatch stream, so priming one batch ahead
+    fully hides host->HBM latency.  Stack on top of an ImageRecordIter
+    (decode pool) or PrefetchingIter (host pipeline):
+    ``DevicePrefetchIter(PrefetchingIter(ImageRecordIter(...)))``.
+
+    ``sharding``: optional ``jax.sharding.Sharding`` for the data (and
+    label, rank-adjusted) placement; default = default device.
+    """
+
+    def __init__(self, data_iter: DataIter, sharding=None):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.sharding = sharding
+        self._ahead: Optional[DataBatch] = None
+        self._exhausted = False
+
+    def _put(self, batch: DataBatch) -> DataBatch:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def place(x):
+            if x is None or not isinstance(x, np.ndarray):
+                return x
+            s = self.sharding
+            if s is not None and isinstance(s, NamedSharding):
+                # rank-adjust: batch-dim sharding only, trailing dims whole
+                spec = list(s.spec) + [None] * max(0, x.ndim - len(s.spec))
+                s = NamedSharding(s.mesh, PartitionSpec(*spec[:x.ndim]))
+            return jax.device_put(x, s)
+
+        return DataBatch(place(batch.data), place(batch.label), batch.pad,
+                         bucket_key=batch.bucket_key)
+
+    def reset(self):
+        self.data_iter.reset()
+        self._ahead = None
+        self._exhausted = False
+
+    @property
+    def steps_per_epoch(self):
+        return self.data_iter.steps_per_epoch
+
+    def next(self) -> DataBatch:
+        if self._ahead is None:
+            if self._exhausted:  # keep raising until reset(), like every
+                raise StopIteration  # other DataIter
+            try:
+                self._ahead = self._put(self.data_iter.next())
+            except StopIteration:
+                self._exhausted = True
+                raise
+        current = self._ahead
+        try:
+            # dispatch NEXT batch's transfer before returning; jax copies
+            # asynchronously, overlapping with the caller's compute
+            self._ahead = self._put(self.data_iter.next())
+        except StopIteration:
+            self._ahead = None
+            self._exhausted = True  # raise at the NEXT call, not now
+        return current
+
+
 class SyntheticImageIter(DataIter):
     """Deterministic synthetic image batches (benchmark-mode input).
 

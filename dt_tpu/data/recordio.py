@@ -216,18 +216,26 @@ class ImageRecordIter:
 
     Reference: ``ImageRecordIter`` (``src/io/iter_image_recordio_2.cc``) with
     ``num_parts``/``part_index`` sharding
-    (``src/io/image_iter_common.h:127-162``).  JPEG decode uses PIL (the
-    reference uses libturbo-JPEG under OMP; host decode is not the TPU
-    bottleneck at these batch sizes — wrap in
-    :class:`dt_tpu.data.io.PrefetchingIter` to overlap).  Records whose
-    payload length equals ``prod(data_shape)`` (+raw float32 = 4x) are treated
-    as raw arrays, so tests and synthetic packs need no image codec.
+    (``src/io/image_iter_common.h:127-162``).  JPEG decode is PARALLEL
+    across the batch on a thread pool (``num_decode_threads``, default
+    ``DT_DECODE_THREADS`` or the CPU count — the role OMP played in the
+    reference's TJimdecode loop, ``iter_image_recordio_2.cc:75``); PIL/
+    libjpeg releases the GIL during decode so threads scale.  Decode of
+    the NEXT ``pipeline_batches`` batches is submitted before the current
+    one is returned, so decode overlaps consumption even without an outer
+    :class:`dt_tpu.data.io.PrefetchingIter` (add one — or
+    ``DevicePrefetchIter`` — to also overlap host->device transfer).
+    Records whose payload length equals ``prod(data_shape)`` (+raw
+    float32 = 4x) are treated as raw arrays, so tests and synthetic packs
+    need no image codec.
     """
 
     def __init__(self, path_imgrec: str, data_shape: Sequence[int],
                  batch_size: int, path_imgidx: Optional[str] = None,
                  shuffle: bool = False, num_parts: int = 1, part_index: int = 0,
-                 augmenter=None, seed: int = 0, dtype: str = "float32"):
+                 augmenter=None, seed: int = 0, dtype: str = "float32",
+                 num_decode_threads: Optional[int] = None,
+                 pipeline_batches: int = 2):
         from dt_tpu.data.io import DataBatch  # local import, avoid cycle
         self._DataBatch = DataBatch
         self.data_shape = tuple(data_shape)  # (H, W, C)
@@ -239,6 +247,17 @@ class ImageRecordIter:
         self.dtype = dtype
         self._seed = seed
         self._epoch = 0
+        if num_decode_threads is None:
+            num_decode_threads = int(os.environ.get(
+                "DT_DECODE_THREADS", min(os.cpu_count() or 1, 16)))
+        self._pool = None
+        if num_decode_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=num_decode_threads,
+                thread_name_prefix="dt_decode")
+        self._pipeline_batches = max(pipeline_batches, 1)
+        self._inflight: list = []  # [(sel, pad, [futures|images])]
         reader = RecordIOReader(path_imgrec, path_imgidx)
         self._records = reader.read_all()
         reader.close()
@@ -251,6 +270,7 @@ class ImageRecordIter:
             rng.shuffle(idx)
         self._order = idx[self.part_index::self.num_parts]
         self._cursor = 0
+        self._inflight = []
 
     def reset(self):
         self._epoch += 1
@@ -273,24 +293,52 @@ class ImageRecordIter:
         arr = np.asarray(img, np.uint8)
         return arr.astype(self.dtype)
 
-    def next(self):
+    def _decode_one(self, i: int):
+        # decode ONLY — augmenters are stateful (shared RandomState) and
+        # run serially at collection time, in batch order, so a seeded
+        # augmenter reproduces the exact serial-path draw sequence
+        lab, _, payload = unpack_label(self._records[i])
+        img = self._decode(payload)
+        return img, (lab[0] if lab.size == 1 else lab)
+
+    def _next_selection(self):
+        """(sel, pad) for the batch at the current cursor, advancing it."""
         n = len(self._order)
         if self._cursor >= n:
-            raise StopIteration
+            return None
         end = min(self._cursor + self.batch_size, n)
         sel = self._order[self._cursor:end]
         pad = self._cursor + self.batch_size - end
         if pad:  # wrap-pad like the reference's round_batch
             sel = np.concatenate([sel, self._order[:pad]])
         self._cursor += self.batch_size
-        imgs, labels = [], []
-        for i in sel:
-            lab, _, payload = unpack_label(self._records[i])
-            img = self._decode(payload)
-            if self.augmenter is not None:
-                img = self.augmenter(img)
-            imgs.append(img)
-            labels.append(lab[0] if lab.size == 1 else lab)
+        return sel, pad
+
+    def _submit(self, sel):
+        if self._pool is None:
+            return sel  # decode lazily at collection time
+        return [self._pool.submit(self._decode_one, i) for i in sel]
+
+    def next(self):
+        # keep `pipeline_batches` batches of decode work in flight so the
+        # pool decodes batch N+1 while the trainer consumes batch N (the
+        # reference's chunk-ahead OMP decode)
+        while len(self._inflight) < self._pipeline_batches:
+            nxt = self._next_selection()
+            if nxt is None:
+                break
+            self._inflight.append((nxt[1], self._submit(nxt[0])))
+        if not self._inflight:
+            raise StopIteration
+        pad, work = self._inflight.pop(0)
+        if self._pool is None:
+            results = [self._decode_one(i) for i in work]
+        else:
+            results = [f.result() for f in work]
+        if self.augmenter is not None:
+            results = [(self.augmenter(img), lab) for img, lab in results]
+        imgs = [r[0] for r in results]
+        labels = [r[1] for r in results]
         data = np.stack(imgs).astype(self.dtype)
         label = np.asarray(labels)
         return self._DataBatch(data, label, pad)

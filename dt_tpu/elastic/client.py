@@ -42,7 +42,10 @@ class WorkerClient:
         self.rank: int = resp["rank"]
         self.workers: List[str] = resp["workers"]
         self._ar_seq: Dict[str, int] = {}
-        self._prof_seq = 0  # last applied remote-profiler command
+        # profiler sync starts AT the current command seq: a joiner must
+        # not replay a long-finished profiling session's command history
+        self._prof_seq = int(resp.get("profile_seq", 0))
+        self._prof_lock = threading.Lock()  # heartbeat vs caller thread
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval_s,),
@@ -88,14 +91,20 @@ class WorkerClient:
     def _apply_profile_cmd(self, c: dict) -> None:
         """Apply one remote profiler command locally (rank-prefixed output),
         the worker side of the reference's server-profiler protocol
-        (``kvstore_dist_server.h:275-322``)."""
+        (``kvstore_dist_server.h:275-322``).  Serialized under a lock with
+        a monotonic seq guard: a stale in-flight heartbeat can neither
+        re-apply an old command after a newer synchronous one nor race the
+        caller thread."""
         from dt_tpu.utils import profiler
-        try:
-            profiler.apply_remote(c["action"], c.get("params") or {},
-                                  rank=self.rank)
-        except Exception:  # profiler trouble must not kill heartbeats
-            logger.exception("remote profiler command %r failed", c)
-        self._prof_seq = max(self._prof_seq, c["seq"])
+        with self._prof_lock:
+            if c["seq"] <= self._prof_seq:
+                return
+            self._prof_seq = c["seq"]
+            try:
+                profiler.apply_remote(c["action"], c.get("params") or {},
+                                      rank=self.rank)
+            except Exception:  # profiler trouble must not kill heartbeats
+                logger.exception("remote profiler command %r failed", c)
 
     def profile_command(self, action: str, params: Optional[dict] = None
                         ) -> int:
@@ -109,8 +118,8 @@ class WorkerClient:
         seq = self._req({"cmd": "profile", "action": action,
                          "params": params or {}, "host": self.host,
                          "post_seq": self._prof_post})["seq"]
-        # mark seen BEFORE applying: our own heartbeat must not re-apply
-        self._prof_seq = max(self._prof_seq, seq)
+        # apply synchronously; the seq guard makes the heartbeat's copy of
+        # this same command a no-op
         self._apply_profile_cmd({"seq": seq, "action": action,
                                  "params": params or {}})
         return seq

@@ -253,9 +253,17 @@ class Scheduler:
                 self._workers.append(host)
             self._registered.add(host)
             self._heartbeats[host] = time.time()
+            # a (re)registering worker starts a fresh profiler-post
+            # sequence — purge its stale retry-dedup entries so its first
+            # post after a restart isn't swallowed by an old (host, 1) key
+            for key in [k for k in self._profile_posted if k[0] == host]:
+                del self._profile_posted[key]
             self._cv.notify_all()
+            # profile_seq: joiners sync PAST the buffered command history
+            # (don't replay a long-finished profiling session on new hosts)
             return {"rank": self._workers.index(host),
-                    "workers": list(self._workers)}
+                    "workers": list(self._workers),
+                    "profile_seq": self._profile_seq}
 
     def wait_for_workers(self, n: Optional[int] = None, timeout: float = 120):
         """Block until n workers registered (rendezvous;

@@ -70,10 +70,13 @@ def make_data(args, image_shape, kv):
     from dt_tpu import data
     per_worker = max(args.batch_size // kv.num_workers, 1)
     if args.data_train and os.path.exists(args.data_train):
-        train = data.ImageRecordIter(
+        # thread-pool decode inside ImageRecordIter + background batch
+        # assembly: together they keep a TPU-rate consumer fed
+        # (reference: OMP decode + PrefetcherIter)
+        train = data.PrefetchingIter(data.ImageRecordIter(
             args.data_train, image_shape, per_worker, shuffle=True,
             num_parts=kv.num_workers, part_index=kv.rank,
-            dtype=args.dtype, seed=args.seed)
+            dtype=args.dtype, seed=args.seed))
         val = None
         if args.data_val and os.path.exists(args.data_val):
             val = data.ImageRecordIter(args.data_val, image_shape,
