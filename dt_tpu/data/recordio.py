@@ -309,8 +309,11 @@ class ImageRecordIter:
         end = min(self._cursor + self.batch_size, n)
         sel = self._order[self._cursor:end]
         pad = self._cursor + self.batch_size - end
-        if pad:  # wrap-pad like the reference's round_batch
-            sel = np.concatenate([sel, self._order[:pad]])
+        if pad:  # wrap-pad like the reference's round_batch; tile for
+            # shards smaller than the pad so the batch is always full-size
+            reps = -(-pad // n)
+            sel = np.concatenate([sel] + [self._order] * reps)[
+                :self.batch_size]
         self._cursor += self.batch_size
         return sel, pad
 

@@ -242,6 +242,22 @@ def test_pack_unpack_label():
     assert payload == b"x"
 
 
+def test_image_record_iter_tiny_shard_full_batch(tmp_path):
+    """batch_size > 2x shard size still yields a FULL fixed-shape batch
+    (wrap-pad tiles the shard) — a jitted step compiled for batch_size
+    must never see a short batch."""
+    p = str(tmp_path / "tiny.rec")
+    with data.RecordIOWriter(p) as w:
+        for i in range(3):
+            img = np.full((4, 4, 3), i, np.uint8)
+            w.write(data.pack_label(img.tobytes(), float(i), rec_id=i))
+    it = data.ImageRecordIter(p, (4, 4, 3), batch_size=8)
+    batches = list(it)
+    assert len(batches) == 1
+    assert batches[0].data.shape == (8, 4, 4, 3)
+    assert batches[0].pad == 5  # 3 real examples
+
+
 def test_image_record_iter_raw(tmp_path):
     """Raw-array records: pack 10 fake 4x4x3 images, iterate sharded."""
     p = str(tmp_path / "imgs.rec")

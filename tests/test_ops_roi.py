@@ -1,0 +1,270 @@
+"""ROI / proposal / deformable-conv ops vs direct numpy oracles.
+
+Oracles re-implement the reference loops (roi_pooling.cc ROIPoolForward,
+psroi_pooling.cc PSROIPoolForwardCPU, contrib/roi_align.cc,
+contrib/proposal.cc, deformable_convolution.cc) literally in numpy; the
+lax formulations in dt_tpu.ops.roi must match them exactly.
+"""
+
+import math
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dt_tpu.ops import roi, nn
+
+
+def _roi_pool_oracle(data, rois, pooled, scale):
+    # data NHWC
+    n, h, w, c = data.shape
+    ph, pw = pooled
+    out = np.zeros((len(rois), ph, pw, c), data.dtype)
+    for i, r in enumerate(rois):
+        b = int(r[0])
+        x1, y1, x2, y2 = (round(v * scale) for v in r[1:])
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for a in range(ph):
+            for bj in range(pw):
+                hs = min(max(int(math.floor(a * bh)) + y1, 0), h)
+                he = min(max(int(math.ceil((a + 1) * bh)) + y1, 0), h)
+                ws = min(max(int(math.floor(bj * bw)) + x1, 0), w)
+                we = min(max(int(math.ceil((bj + 1) * bw)) + x1, 0), w)
+                if he <= hs or we <= ws:
+                    continue
+                out[i, a, bj] = data[b, hs:he, ws:we].max(axis=(0, 1))
+    return out
+
+
+def test_roi_pool_matches_oracle():
+    rng = np.random.RandomState(0)
+    data = rng.randn(2, 12, 16, 5).astype(np.float32)
+    rois = np.array([
+        [0, 0, 0, 7, 7],
+        [1, 4, 2, 15, 11],
+        [0, 6, 6, 6, 6],      # degenerate 1x1
+        [1, 30, 30, 40, 40],  # out of range -> clipped/empty bins
+    ], np.float32)
+    got = roi.roi_pool(jnp.asarray(data), jnp.asarray(rois), (3, 3), 0.5)
+    want = _roi_pool_oracle(data, rois, (3, 3), 0.5)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_psroi_pool_matches_oracle():
+    rng = np.random.RandomState(1)
+    p, d = 3, 4
+    data = rng.randn(2, 9, 9, p * p * d).astype(np.float32)
+    rois = np.array([[0, 1, 1, 7, 7], [1, 0, 2, 8, 6]], np.float32)
+    scale = 0.5
+    got = np.asarray(roi.psroi_pool(jnp.asarray(data), jnp.asarray(rois),
+                                    d, p, scale))
+    # oracle (psroi_pooling.cc loop), NHWC
+    n, h, w, _ = data.shape
+    want = np.zeros((len(rois), p, p, d), np.float32)
+    for i, r in enumerate(rois):
+        b = int(r[0])
+        x1 = round(r[1]) * scale
+        y1 = round(r[2]) * scale
+        x2 = (round(r[3]) + 1.0) * scale
+        y2 = (round(r[4]) + 1.0) * scale
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / p, rw / p
+        for ph in range(p):
+            for pw in range(p):
+                hs = min(max(int(math.floor(ph * bh + y1)), 0), h)
+                he = min(max(int(math.ceil((ph + 1) * bh + y1)), 0), h)
+                ws = min(max(int(math.floor(pw * bw + x1)), 0), w)
+                we = min(max(int(math.ceil((pw + 1) * bw + x1)), 0), w)
+                gh = min(max(ph * p // p, 0), p - 1)
+                gw = min(max(pw * p // p, 0), p - 1)
+                for ct in range(d):
+                    ch = (ct * p + gh) * p + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    want[i, ph, pw, ct] = data[b, hs:he, ws:we, ch].mean()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def _bilinear_oracle(feat, y, x):
+    h, w, _ = feat.shape
+    if y < -1 or y > h or x < -1 or x > w:
+        return np.zeros(feat.shape[-1], feat.dtype)
+    y, x = max(y, 0.0), max(x, 0.0)
+    y0, x0 = int(y), int(x)
+    if y0 >= h - 1:
+        y0 = h - 1
+        y = float(y0)
+    if x0 >= w - 1:
+        x0 = w - 1
+        x = float(x0)
+    y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+    ly, lx = y - y0, x - x0
+    return (feat[y0, x0] * (1 - ly) * (1 - lx) + feat[y0, x1] * (1 - ly) * lx
+            + feat[y1, x0] * ly * (1 - lx) + feat[y1, x1] * ly * lx)
+
+
+def test_roi_align_matches_oracle():
+    rng = np.random.RandomState(2)
+    data = rng.randn(1, 10, 10, 3).astype(np.float32)
+    rois = np.array([[0, 2, 2, 14, 10], [0, 0, 0, 4, 4]], np.float32)
+    scale, r, p = 0.5, 2, 2
+    got = np.asarray(roi.roi_align(jnp.asarray(data), jnp.asarray(rois),
+                                   (p, p), scale, sample_ratio=r))
+    want = np.zeros((len(rois), p, p, 3), np.float32)
+    for i, rr in enumerate(rois):
+        x1, y1, x2, y2 = (v * scale for v in rr[1:])
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        bh, bw = rh / p, rw / p
+        for ph in range(p):
+            for pw in range(p):
+                acc = np.zeros(3, np.float32)
+                for iy in range(r):
+                    for ix in range(r):
+                        yy = y1 + ph * bh + (iy + 0.5) * bh / r
+                        xx = x1 + pw * bw + (ix + 0.5) * bw / r
+                        acc += _bilinear_oracle(data[int(rr[0])], yy, xx)
+                want[i, ph, pw] = acc / (r * r)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_generate_anchors_reference_values():
+    # the canonical Faster-RCNN 16-stride anchors (proposal.cc defaults),
+    # ratio-major scale-minor; first ratio=0.5 scale=8 anchor is
+    # [-84, -40, 99, 55] in the classic implementation
+    a = np.asarray(roi.generate_anchors(16, (8, 16, 32), (0.5, 1, 2)))
+    assert a.shape == (9, 4)
+    np.testing.assert_allclose(a[0], [-84, -40, 99, 55])
+    np.testing.assert_allclose(a[4], [-120, -120, 135, 135])  # ratio1 s16
+    # anchors are centered on the base cell center 7.5
+    np.testing.assert_allclose((a[:, 0] + a[:, 2]) / 2, 7.5)
+
+
+def test_proposal_decode_clip_and_nms():
+    rng = np.random.RandomState(3)
+    h, w, a = 4, 5, 2
+    scores = rng.rand(h, w, a).astype(np.float32)
+    deltas = (rng.randn(h, w, a, 4) * 0.1).astype(np.float32)
+    im_info = np.array([60.0, 70.0, 1.0], np.float32)
+    boxes, scr = roi.proposal(
+        jnp.asarray(scores), jnp.asarray(deltas), jnp.asarray(im_info),
+        stride=16, scales=(2, 4), ratios=(1.0,), pre_nms_top_n=40,
+        post_nms_top_n=10, nms_threshold=0.7, min_size=4)
+    boxes, scr = np.asarray(boxes), np.asarray(scr)
+    assert boxes.shape == (10, 4) and scr.shape == (10,)
+    # all inside the image
+    assert (boxes[:, 0] >= 0).all() and (boxes[:, 2] <= 69).all()
+    assert (boxes[:, 1] >= 0).all() and (boxes[:, 3] <= 59).all()
+    # scores non-increasing (kept in score order)
+    assert (np.diff(scr) <= 1e-6).all()
+    # surviving pairs respect the NMS threshold (ignoring pad duplicates)
+    uniq = np.unique(boxes, axis=0)
+    iou = np.asarray(roi.box_iou(jnp.asarray(uniq), jnp.asarray(uniq)))
+    off = iou - np.eye(len(uniq))
+    assert off.max() <= 0.7 + 1e-6
+
+
+def test_multi_proposal_batches():
+    rng = np.random.RandomState(4)
+    scores = rng.rand(2, 3, 3, 1).astype(np.float32)
+    deltas = np.zeros((2, 3, 3, 1, 4), np.float32)
+    im_info = np.array([[48, 48, 1.0], [48, 48, 1.0]], np.float32)
+    boxes, scr = roi.multi_proposal(
+        jnp.asarray(scores), jnp.asarray(deltas), jnp.asarray(im_info),
+        stride=16, scales=(2,), ratios=(1.0,), pre_nms_top_n=18,
+        post_nms_top_n=5, nms_threshold=0.5)
+    assert boxes.shape == (2, 5, 4) and scr.shape == (2, 5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 8, 8, 4).astype(np.float32)
+    wgt = rng.randn(3, 3, 4, 6).astype(np.float32)
+    off = np.zeros((2, 8, 8, 1 * 3 * 3 * 2), np.float32)
+    got = roi.deformable_conv2d(jnp.asarray(x), jnp.asarray(off),
+                                jnp.asarray(wgt), padding=(1, 1))
+    want = nn.conv2d(jnp.asarray(x), jnp.asarray(wgt), stride=(1, 1),
+                     padding=(1, 1))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    # an integer (dy, dx) = (0, 1) offset on every tap samples one pixel to
+    # the right: identical to a regular conv with asymmetric x padding
+    # (0 left, 2 right) instead of (1, 1)
+    from jax import lax
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 6, 6, 2).astype(np.float32)
+    wgt = rng.randn(3, 3, 2, 3).astype(np.float32)
+    off = np.zeros((1, 6, 6, 18), np.float32)
+    off[..., 1::2] = 1.0  # dx taps
+    got = roi.deformable_conv2d(jnp.asarray(x), jnp.asarray(off),
+                                jnp.asarray(wgt), padding=(1, 1))
+    want = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(wgt), window_strides=(1, 1),
+        padding=((1, 1), (0, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_groups_and_stride():
+    rng = np.random.RandomState(7)
+    x = rng.randn(1, 8, 8, 4).astype(np.float32)
+    wgt = rng.randn(3, 3, 4, 2).astype(np.float32)
+    off = (rng.randn(1, 4, 4, 2 * 3 * 3 * 2) * 0.5).astype(np.float32)
+    got = roi.deformable_conv2d(jnp.asarray(x), jnp.asarray(off),
+                                jnp.asarray(wgt), stride=(2, 2),
+                                padding=(1, 1), deformable_groups=2)
+    assert got.shape == (1, 4, 4, 2)
+    # oracle: direct loop with per-group bilinear sampling, zero outside
+    def bil(feat, y, xx):
+        h, w, _ = feat.shape
+        if y <= -1 or y >= h or xx <= -1 or xx >= w:
+            return np.zeros(feat.shape[-1], np.float32)
+        y0, x0 = math.floor(y), math.floor(xx)
+        ly, lx = y - y0, xx - x0
+        acc = np.zeros(feat.shape[-1], np.float32)
+        for dy, wy in ((0, 1 - ly), (1, ly)):
+            for dx, wx in ((0, 1 - lx), (1, lx)):
+                yy, xc = y0 + dy, x0 + dx
+                if 0 <= yy < h and 0 <= xc < w:
+                    acc += wy * wx * feat[yy, xc]
+        return acc
+
+    want = np.zeros((1, 4, 4, 2), np.float32)
+    offr = off.reshape(1, 4, 4, 2, 3, 3, 2)
+    for oy in range(4):
+        for ox in range(4):
+            acc = np.zeros(2, np.float32)
+            for ky in range(3):
+                for kx in range(3):
+                    for g in range(2):
+                        dy, dx = offr[0, oy, ox, g, ky, kx]
+                        y = oy * 2 + ky - 1 + dy
+                        xx = ox * 2 + kx - 1 + dx
+                        v = bil(x[0, :, :, g * 2:(g + 1) * 2], y, xx)
+                        acc += v @ wgt[ky, kx, g * 2:(g + 1) * 2]
+            want[0, oy, ox] = acc
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_roi_ops_jit_and_grad():
+    import jax
+    rng = np.random.RandomState(8)
+    data = jnp.asarray(rng.randn(1, 8, 8, 3).astype(np.float32))
+    rois = jnp.asarray(np.array([[0, 0, 0, 7, 7]], np.float32))
+
+    @jax.jit
+    def f(d):
+        return roi.roi_align(d, rois, (2, 2), 1.0, sample_ratio=2).sum()
+
+    g = jax.grad(f)(data)
+    assert np.isfinite(np.asarray(g)).all()
+    # gradient mass is conserved for an interior roi (average pooling):
+    # each (bin, channel) average carries total weight 1 -> 2*2 bins * 3 ch
+    np.testing.assert_allclose(float(np.asarray(g).sum()), 12.0, rtol=1e-5)

@@ -1,0 +1,97 @@
+"""SSD model: shapes, jittable train step, loss decreases, detect contract.
+
+Reference: ``example/ssd`` training/eval flow over the contrib multibox ops.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+from dt_tpu import models
+from dt_tpu.models.ssd import ssd_loss, ssd_detect
+
+
+def _synthetic_batch(rng, b=2, size=64, m=3, num_classes=3):
+    imgs = rng.rand(b, size, size, 3).astype(np.float32)
+    boxes = np.zeros((b, m, 4), np.float32)
+    labels = np.full((b, m), -1, np.int64)
+    for i in range(b):
+        for j in range(rng.randint(1, m + 1)):
+            cx, cy = rng.uniform(0.3, 0.7, 2)
+            w, h = rng.uniform(0.2, 0.4, 2)
+            boxes[i, j] = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+            labels[i, j] = rng.randint(0, num_classes)
+    return imgs, boxes, labels
+
+
+def test_ssd_forward_shapes():
+    model = models.create("ssd", num_classes=3)
+    x = jnp.zeros((2, 64, 64, 3))
+    vars_ = model.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    cls, box, anchors = model.apply(vars_, x, training=False)
+    n = anchors.shape[0]
+    assert cls.shape == (2, n, 4) and box.shape == (2, n, 4)
+    # 64/8=8 .. 64/128=0 -> feature maps 8,4,2,1,1; 4 anchors per cell
+    assert n == (8 * 8 + 4 * 4 + 2 * 2 + 1 + 1) * 4
+    # anchors roughly inside the unit square (edge anchors may overhang)
+    a = np.asarray(anchors)
+    assert (a[:, 2] > a[:, 0]).all() and (a[:, 3] > a[:, 1]).all()
+
+
+def test_ssd_train_step_learns():
+    rng = np.random.RandomState(0)
+    model = models.create("ssd", num_classes=3)
+    imgs, boxes, labels = _synthetic_batch(rng)
+    x = jnp.asarray(imgs)
+    vars_ = model.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    params, bstats = vars_["params"], vars_["batch_stats"]
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, bstats, opt, x, gtb, gtl):
+        def loss_of(p):
+            (cls, box, anchors), mut = model.apply(
+                {"params": p, "batch_stats": bstats}, x, training=True,
+                mutable=["batch_stats"])
+            return ssd_loss(cls, box, anchors, gtb, gtl), \
+                mut["batch_stats"]
+        (loss, bs), g = jax.value_and_grad(loss_of, has_aux=True)(params)
+        up, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, up), bs, opt, loss
+
+    gtb, gtl = jnp.asarray(boxes), jnp.asarray(labels)
+    losses = []
+    for _ in range(12):
+        params, bstats, opt, loss = step(params, bstats, opt, x, gtb, gtl)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_ssd_detect_contract():
+    rng = np.random.RandomState(1)
+    model = models.create("ssd", num_classes=3)
+    imgs, _, _ = _synthetic_batch(rng)
+    x = jnp.asarray(imgs)
+    vars_ = model.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    cls, box, anchors = model.apply(vars_, x, training=False)
+    labels, scores, boxes = ssd_detect(cls, box, anchors)
+    n = anchors.shape[0]
+    assert labels.shape == (2, n) and boxes.shape == (2, n, 4)
+    lab = np.asarray(labels)
+    assert ((lab >= -1) & (lab < 3)).all()
+    # surviving same-class pairs respect NMS threshold per image
+    for i in range(2):
+        keep = lab[i] >= 0
+        if keep.sum() < 2:
+            continue
+        from dt_tpu.ops.detection import box_iou
+        kb = np.asarray(boxes)[i][keep]
+        kl = lab[i][keep]
+        iou = np.asarray(box_iou(jnp.asarray(kb), jnp.asarray(kb)))
+        same = kl[:, None] == kl[None, :]
+        off = np.where(same, iou, 0.0) - np.eye(len(kb))
+        assert off.max() <= 0.45 + 1e-6

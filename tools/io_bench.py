@@ -25,9 +25,12 @@ def main():
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--rounds", type=int, default=3)
-    ap.add_argument("--rec", default="/tmp/dt_io_bench.rec",
-                    help="pack target (reused if it exists)")
+    ap.add_argument("--rec", default=None,
+                    help="pack target (default keyed on --images/--size so "
+                         "a stale pack is never silently reused)")
     args = ap.parse_args()
+    if args.rec is None:
+        args.rec = f"/tmp/dt_io_bench_{args.images}x{args.size}.rec"
 
     import numpy as np
     from PIL import Image
@@ -49,9 +52,10 @@ def main():
 
     shape = (args.size, args.size, 3)
 
-    def measure(threads, label):
+    def measure(threads, label, augmenter=None):
         it = data.ImageRecordIter(args.rec, shape, args.batch_size,
-                                  num_decode_threads=threads)
+                                  num_decode_threads=threads,
+                                  augmenter=augmenter)
         best = 0.0
         for _ in range(args.rounds):
             n = 0
@@ -68,6 +72,12 @@ def main():
     base = measure(1, "decode_1_thread")
     nthreads = min(os.cpu_count() or 1, 16)
     par = measure(nthreads, f"decode_{nthreads}_threads")
+    # augmenter-inclusive: the augmenter runs serially at collection time
+    # (stateful RNG), so this shows how much of the parallel-decode win
+    # the serial stage gives back
+    from dt_tpu.data.augment import imagenet_train_augmenter
+    aug = imagenet_train_augmenter(size=args.size)
+    measure(nthreads, f"decode_{nthreads}_threads_aug", augmenter=aug)
     print(json.dumps({"config": "speedup", "threads": nthreads,
                       "speedup": round(par / base, 2)}))
 
