@@ -154,13 +154,24 @@ def main():
     batch = int(os.environ.get("DT_BENCH_BATCH", "32"))
     net = os.environ.get("DT_BENCH_MODEL", "resnet152")
     size = int(os.environ.get("DT_BENCH_IMAGE", "224"))
+    def phase(msg):
+        print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+              flush=True)
+
     model = models.create(net, num_classes=1000, dtype=jnp.bfloat16)
     x = jnp.asarray(np.random.RandomState(0)
                     .uniform(-1, 1, (batch, size, size, 3)), jnp.bfloat16)
     y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
 
-    variables = model.init({"params": jax.random.PRNGKey(0)}, x,
-                           training=False)
+    # init must be jitted: eager init dispatches hundreds of tiny ops
+    # individually over the axon tunnel (minutes of RTT for ResNet-152);
+    # one compiled program pays the cost once
+    phase(f"compiling init ({net}, batch {batch})")
+    variables = jax.jit(
+        lambda k: model.init({"params": k}, x, training=False))(
+        jax.random.PRNGKey(0))
+    jax.block_until_ready(variables)
+    phase("init done")
     tx = optim.create("sgd", learning_rate=0.1, momentum=0.9,
                       weight_decay=1e-4)
     state = TrainState.create(model.apply, variables["params"], tx,
@@ -180,10 +191,12 @@ def main():
     step = jax.jit(train_step, donate_argnums=(0,))
 
     # warmup / compile
+    phase("compiling train step")
     t_compile = time.perf_counter()
     state, loss = step(state, x, y)
     jax.block_until_ready(loss)
     t_compile = time.perf_counter() - t_compile
+    phase(f"train step compiled in {t_compile:.0f}s; measuring")
 
     iters = int(os.environ.get("DT_BENCH_ITERS", "20"))
     t0 = time.perf_counter()

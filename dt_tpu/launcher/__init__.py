@@ -1,3 +1,5 @@
 """Job launcher.  Reference: ``tools/launch.py`` (SURVEY.md §2.3)."""
 
-from dt_tpu.launcher.launch import main as main, launch_local as launch_local
+from dt_tpu.launcher.launch import (main as main,
+                                    launch_local as launch_local,
+                                    launch_ssh as launch_ssh)

@@ -11,9 +11,20 @@ worker processes with the env contract the fit loop reads
 and for joiners ``NEW_WORKER``/``EPOCH_BEGIN`` — ``base_module.py:503-506``).
 The scheduler's launch callback re-invokes the SAME training command for
 workers added via the host_worker file (``TRAINING_CMD``,
-``elastic_training.cc:26-62``).  ``ssh`` launching of remote hosts is the
-same protocol with the Popen swapped for ssh; multi-host TPU pods use their
-own orchestration (GKE/xmanager) and only need the env contract.
+``elastic_training.cc:26-62``).
+
+``ssh`` launcher: the same protocol with each Popen swapped for
+``ssh <host> 'export ...; cd ...; exec <cmd>'`` — the reference's
+dmlc-tracker ssh submit (``tools/launch.py:40-85`` →
+``dmlc_tracker/ssh.py``), with the env contract carried in the remote
+command line (ssh does not forward the environment).  The scheduler stays
+in this process (the root host); elastic ADDs ssh into the new host via the
+same channel, and host death is handled by the scheduler's heartbeat
+auto-eviction (the EC2 instance-lifecycle daemon's terminate/relaunch
+semantics minus the boto3 calls).  ``--ssh-cmd`` is injectable so the
+protocol is testable without sshd (see tests/test_launcher_ssh.py).
+Multi-host TPU pods use their own orchestration (GKE/xmanager) and only
+need the env contract.
 """
 
 from __future__ import annotations
@@ -42,6 +53,19 @@ def _worker_env(base: dict, scheduler_port: int, worker_id: str,
         env["ELASTIC_TRAINING_ENABLED"] = "1"
     env.update(extra or {})
     return env
+
+
+def _reap_all(procs: dict) -> dict:
+    """Wait for every proc, re-snapshotting until stable: the scheduler's
+    launch thread may still be inserting elastic joiners while base
+    workers are being reaped."""
+    rcs = {}
+    while True:
+        pending = [(h, p) for h, p in list(procs.items()) if h not in rcs]
+        if not pending:
+            return rcs
+        for h, p in pending:
+            rcs[h] = p.wait()
 
 
 def launch_local(num_workers: int, command: List[str],
@@ -77,18 +101,90 @@ def launch_local(num_workers: int, command: List[str],
                 command, env=_worker_env(os.environ, sched.port, h, hostfile,
                                          elastic,
                                          {"TRAINING_CMD": " ".join(command)}))
-        rcs = {}
+        return _reap_all(procs)
+    finally:
+        sched.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+
+
+_FORWARD_ENV_PREFIXES = ("DMLC_", "DT_", "PYTHONPATH", "WORKER_HOST_FILE",
+                         "ELASTIC_TRAINING_ENABLED", "NEW_WORKER",
+                         "EPOCH_BEGIN", "TRAINING_CMD", "XLA_FLAGS",
+                         "JAX_PLATFORMS")
+
+
+def _ssh_popen(host: str, command: List[str], env: dict, ssh_cmd: str,
+               workdir: str) -> subprocess.Popen:
+    """Start ``command`` on ``host`` over ssh, carrying the launch env in
+    the remote command line (dmlc_tracker/ssh.py's export-prefix style)."""
+    import shlex
+    exports = "".join(
+        f"export {k}={shlex.quote(str(v))}; " for k, v in sorted(env.items())
+        if any(k.startswith(p) for p in _FORWARD_ENV_PREFIXES))
+    remote = (exports + f"cd {shlex.quote(workdir)}; exec "
+              + " ".join(shlex.quote(c) for c in command))
+    return subprocess.Popen(shlex.split(ssh_cmd) + [host, remote])
+
+
+def _default_root_uri() -> str:
+    import socket
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def launch_ssh(num_workers: int, command: List[str], hostfile: str,
+               elastic: bool = False, scheduler_port: int = 0,
+               ssh_cmd: str = "ssh -o StrictHostKeyChecking=no",
+               root_uri: Optional[str] = None,
+               workdir: Optional[str] = None):
+    """Scheduler in this process, one worker per hostfile line over ssh;
+    returns worker exit codes keyed by host.
+
+    Reference: ``tools/launch.py`` ssh path — root host runs the tracker
+    (here: the elastic Scheduler) and every listed host gets the training
+    command with the DMLC_* rendezvous env; elastic additions re-use the
+    same ssh channel (``elastic_training.cc:26-62``
+    launchCommandOnNewWorker, which shells out to ssh via launch.py).
+    """
+    from dt_tpu.elastic import Scheduler
+    from dt_tpu.elastic.scheduler import _read_hosts
+
+    hosts = _read_hosts(hostfile)[:num_workers]
+    if len(hosts) < num_workers:
+        raise ValueError(
+            f"hostfile lists {len(hosts)} hosts, need {num_workers}")
+    uri = root_uri or _default_root_uri()
+    wd = workdir or os.getcwd()
+    procs = {}
+
+    def env_for(host, extra=None):
+        env = _worker_env(os.environ, sched.port, host, hostfile, elastic,
+                          {"TRAINING_CMD": " ".join(command),
+                           **(extra or {})})
+        env["DMLC_PS_ROOT_URI"] = uri
+        return env
+
+    def launch_new(host: str, epoch: int):
+        logger.info("ssh-launching elastic worker %s (EPOCH_BEGIN=%d)",
+                    host, epoch)
+        procs[host] = _ssh_popen(
+            host, command,
+            env_for(host, {"NEW_WORKER": "1", "EPOCH_BEGIN": str(epoch)}),
+            ssh_cmd, wd)
+
+    sched = Scheduler(host_worker_file=hostfile, initial_workers=hosts,
+                      launch_callback=launch_new if elastic else None,
+                      port=scheduler_port)
+    logger.info("scheduler on %s:%d; ssh-starting %d workers", uri,
+                sched.port, num_workers)
+    try:
         for h in hosts:
-            rcs[h] = procs[h].wait()
-        # elastic joiners may still be running — and the scheduler's launch
-        # thread may still be inserting; iterate over snapshots until stable
-        while True:
-            pending = [(h, p) for h, p in list(procs.items()) if h not in rcs]
-            if not pending:
-                break
-            for h, p in pending:
-                rcs[h] = p.wait()
-        return rcs
+            procs[h] = _ssh_popen(h, command, env_for(h), ssh_cmd, wd)
+        return _reap_all(procs)
     finally:
         sched.close()
         for p in procs.values():
@@ -102,10 +198,15 @@ def main(argv=None) -> int:
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-H", "--hostfile", default=None,
                     help="host_worker file (elastic membership source)")
-    ap.add_argument("--launcher", choices=["local"], default="local")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
     ap.add_argument("--elastic-training-enabled", default="False",
                     help="True enables the epoch-boundary membership protocol")
     ap.add_argument("--scheduler-port", type=int, default=0)
+    ap.add_argument("--ssh-cmd", default="ssh -o StrictHostKeyChecking=no",
+                    help="ssh launcher: command prefix used to reach hosts")
+    ap.add_argument("--root-uri", default=None,
+                    help="ssh launcher: address workers dial back to "
+                         "(default: this host's IP)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if args.command and args.command[0] == "--":
@@ -114,8 +215,15 @@ def main(argv=None) -> int:
         ap.error("no training command given")
     elastic = str(args.elastic_training_enabled).lower() in ("1", "true")
     logging.basicConfig(level=logging.INFO)
-    rcs = launch_local(args.num_workers, args.command, args.hostfile,
-                       elastic, args.scheduler_port)
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("ssh launcher requires -H hostfile")
+        rcs = launch_ssh(args.num_workers, args.command, args.hostfile,
+                         elastic, args.scheduler_port, args.ssh_cmd,
+                         args.root_uri)
+    else:
+        rcs = launch_local(args.num_workers, args.command, args.hostfile,
+                           elastic, args.scheduler_port)
     bad = {h: rc for h, rc in rcs.items() if rc != 0}
     if bad:
         logger.error("workers failed: %s", bad)

@@ -14,3 +14,7 @@ from dt_tpu.ops import losses as losses
 from dt_tpu.ops import tensor as tensor
 from dt_tpu.ops import rnn as rnn
 from dt_tpu.ops import sparse as sparse
+from dt_tpu.ops import detection as detection
+from dt_tpu.ops import roi as roi
+from dt_tpu.ops import warp as warp
+from dt_tpu.ops.custom import custom_op as custom_op

@@ -1,0 +1,138 @@
+"""Spatial-warp ops: grid generator, bilinear sampler, spatial transformer,
+FlowNet correlation.
+
+Reference: ``src/operator/grid_generator.cc`` (affine / optical-flow "warp"
+sampling grids in [-1, 1] coords), ``src/operator/bilinear_sampler.cc``
+(grid-directed bilinear sampling with zero outside),
+``src/operator/spatial_transformer.cc`` (affine STN = grid + sampler),
+``src/operator/correlation.cc`` (FlowNet cost-volume correlation).
+
+Layouts are this framework's NHWC: grids are (B, H, W, 2) with the last
+axis ``(x, y)`` (the reference's (B, 2, H, W) channel order, moved last);
+correlation emits displacement channels last.  TPU-first: the sampler is
+the shared gather-based bilinear core (``ops.roi.bilinear_sample``);
+correlation is displacement-sliced elementwise products reduced by a
+depthwise box filter, so XLA sees dense slices + reductions, not gathers.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dt_tpu.ops.roi import bilinear_sample
+
+Array = jax.Array
+
+
+def affine_grid(theta: Array, target_shape: Tuple[int, int]) -> Array:
+    """Affine sampling grid -> (B, H, W, 2) of (x, y) in [-1, 1].
+
+    ``theta``: (B, 6) or (B, 2, 3) row-major affine maps taking *target*
+    (x, y, 1) to *source* (x, y), both in [-1, 1] coords — reference
+    ``grid_generator-inl.h:86-111`` (affine branch: dst grid rows are
+    ``x = -1 + 2*(i mod W)/(W-1)``, ``y = -1 + 2*(i div W)/(H-1)``, 1).
+    """
+    h, w = target_shape
+    theta = theta.reshape(-1, 2, 3)
+    xs = -1.0 + jnp.arange(w) * (2.0 / (w - 1)) if w > 1 else jnp.zeros(w)
+    ys = -1.0 + jnp.arange(h) * (2.0 / (h - 1)) if h > 1 else jnp.zeros(h)
+    gx, gy = jnp.meshgrid(xs, ys)                     # (H, W) each
+    dst = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+    src = jnp.einsum("bij,hwj->bhwi", theta, dst)     # (B, H, W, 2)
+    return src
+
+
+def warp_grid(flow: Array) -> Array:
+    """Optical-flow sampling grid (reference "warp" transform_type).
+
+    ``flow``: (B, H, W, 2) pixel-displacement field (x, y last).  Returns
+    (B, H, W, 2) normalized grid: ``(flow + dst_index) / ((size-1)/2) - 1``
+    (``grid_generator-inl.h:113-130``).
+    """
+    b, h, w, _ = flow.shape
+    gx, gy = jnp.meshgrid(jnp.arange(w, dtype=flow.dtype),
+                          jnp.arange(h, dtype=flow.dtype))
+    dst = jnp.stack([gx, gy], axis=-1)
+    denom = jnp.asarray([(w - 1) / 2.0, (h - 1) / 2.0], flow.dtype)
+    return (flow + dst) / denom - 1.0
+
+
+def bilinear_sampler(data: Array, grid: Array) -> Array:
+    """Sample ``data`` (B, H, W, C) at ``grid`` (B, H', W', 2) of (x, y)
+    in [-1, 1] -> (B, H', W', C).
+
+    Reference ``bilinear_sampler.cc``: ``x_real = (x+1)(W-1)/2``; corners
+    outside the image contribute 0 (per-corner ``between`` checks) —
+    exactly the shared sampler's "zero" mode.
+    """
+    b, h, w, c = data.shape
+    xr = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    yr = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    return jax.vmap(lambda f, y, x: bilinear_sample(f, y, x, mode="zero"))(
+        data, yr, xr)
+
+
+def spatial_transformer(data: Array, theta: Array,
+                        target_shape: Tuple[int, int]) -> Array:
+    """Affine spatial transformer network head (reference
+    ``spatial_transformer.cc``: affine grid + bilinear sampling — the only
+    mode the reference implements)."""
+    return bilinear_sampler(data, affine_grid(theta, target_shape))
+
+
+def correlation(data1: Array, data2: Array, kernel_size: int = 1,
+                max_displacement: int = 1, stride1: int = 1,
+                stride2: int = 1, pad_size: int = 0,
+                is_multiply: bool = True) -> Array:
+    """FlowNet correlation / cost volume -> (B, OH, OW, D*D) where
+    ``D = 2*(max_displacement//stride2) + 1``.
+
+    Reference ``correlation.cc`` CorrelationForward: both inputs are
+    zero-padded by ``pad_size``; output position (i, j) anchors a
+    ``kernel_size``² window at ``(i*stride1 + max_displacement, ...)`` in
+    padded data1 and correlates it with the window displaced by
+    ``(s2p, s2o)`` in padded data2, one displacement per output channel
+    (row-major: s2p outer, s2o inner), normalized by
+    ``kernel_size² * C``.  ``is_multiply=False`` uses |a - b| instead of
+    a*b.  Output spatial size: ``ceil((padded - 2*(max_displacement +
+    kernel_radius)) / stride1)``.
+    """
+    assert kernel_size % 2 == 1, "kernel_size must be odd"
+    b, h, w, c = data1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    ph, pw = h + 2 * pad_size, w + 2 * pad_size
+    oh = int(math.ceil((ph - 2 * border) / stride1))
+    ow = int(math.ceil((pw - 2 * border) / stride1))
+    assert oh > 0 and ow > 0, "output collapses; increase pad_size"
+    r = max_displacement // stride2
+    d = 2 * r + 1
+
+    pad = ((0, 0), (pad_size, pad_size), (pad_size, pad_size), (0, 0))
+    p1 = jnp.pad(data1, pad)
+    p2 = jnp.pad(data2, pad)
+    eh = (oh - 1) * stride1 + kernel_size
+    ew = (ow - 1) * stride1 + kernel_size
+    md = max_displacement
+    a = lax.slice(p1, (0, md, md, 0), (b, md + eh, md + ew, c))
+
+    def box_reduce(x):
+        # k x k window sum, stride1 subsample -> (B, OH, OW)
+        return lax.reduce_window(
+            x, jnp.zeros((), x.dtype), lax.add,
+            (1, kernel_size, kernel_size), (1, stride1, stride1), "valid")
+
+    chans = []
+    for s2p in range(-r * stride2, r * stride2 + 1, stride2):
+        for s2o in range(-r * stride2, r * stride2 + 1, stride2):
+            bslice = lax.slice(p2, (0, md + s2p, md + s2o, 0),
+                               (b, md + s2p + eh, md + s2o + ew, c))
+            prod = a * bslice if is_multiply else jnp.abs(a - bslice)
+            chans.append(box_reduce(prod.sum(axis=-1)))
+    out = jnp.stack(chans, axis=-1)                   # (B, OH, OW, D*D)
+    return out / (kernel_size * kernel_size * c)

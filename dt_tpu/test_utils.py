@@ -1,0 +1,176 @@
+"""Public numerical test fixtures.
+
+Reference: ``python/mxnet/test_utils.py`` — the assertion/fixture toolkit
+the reference ships as a *public API* (users test their own ops with it):
+``assert_almost_equal``, ``check_numeric_gradient`` (finite differences),
+``check_consistency`` (same computation across contexts/dtypes),
+``rand_ndarray`` (dense + sparse), and the seeded-test decorator from
+``tests/python/unittest/common.py`` (``@with_seed``).
+
+TPU translation: "consistency across ctx/dtype" becomes consistency across
+dtypes and across interpreters (numpy vs jit vs a second dtype) on one
+backend; finite differences check ``jax.grad`` instead of the symbolic
+backward pass.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import random
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["assert_almost_equal", "check_numeric_gradient",
+           "check_consistency", "rand_ndarray", "with_seed",
+           "default_rtol_atol"]
+
+_DTYPE_TOL = {
+    "float64": (1e-7, 1e-9),
+    "float32": (1e-4, 1e-6),
+    "bfloat16": (5e-2, 1e-2),
+    "float16": (1e-2, 1e-3),
+}
+
+
+def default_rtol_atol(*dtypes):
+    """Loosest (rtol, atol) across the given dtypes (reference
+    ``check_consistency`` tolerance-by-dtype table)."""
+    rtol, atol = 0.0, 0.0
+    for d in dtypes:
+        r, a = _DTYPE_TOL.get(np.dtype(d).name, (1e-4, 1e-6))
+        rtol, atol = max(rtol, r), max(atol, a)
+    return rtol or 1e-4, atol or 1e-6
+
+
+def assert_almost_equal(a, b, rtol: Optional[float] = None,
+                        atol: Optional[float] = None, names=("a", "b")):
+    """Relative-threshold comparison (reference ``assert_almost_equal``:
+    tolerance picked from the operand dtypes when not given)."""
+    dta = str(getattr(a, "dtype", "float32"))
+    dtb = str(getattr(b, "dtype", "float32"))
+    a = np.asarray(a, dtype=np.float64 if dta == "bfloat16" else None)
+    b = np.asarray(b, dtype=np.float64 if dtb == "bfloat16" else None)
+    if rtol is None or atol is None:
+        r, t = default_rtol_atol(dta, dtb)
+        rtol = r if rtol is None else rtol
+        atol = t if atol is None else atol
+    np.testing.assert_allclose(
+        a, b, rtol=rtol, atol=atol,
+        err_msg=f"{names[0]} !~ {names[1]} (rtol={rtol}, atol={atol})")
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence[np.ndarray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3,
+                           argnums: Optional[Sequence[int]] = None):
+    """Finite-difference check of ``jax.grad`` (reference
+    ``check_numeric_gradient``: central differences against backward).
+
+    ``fn(*inputs) -> scalar`` (jax scalar ok).  The evaluations run in
+    float32 (x64 stays off), so ``eps`` balances truncation O(eps²)
+    against f32 cancellation O(ulp/eps): 1e-3 puts both near 1e-4,
+    matching the default ``atol``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    argnums = tuple(argnums if argnums is not None else range(len(inputs)))
+    f32 = [jnp.asarray(np.asarray(x), jnp.float32) for x in inputs]
+    grads = jax.grad(lambda *a: jnp.asarray(fn(*a), jnp.float32).sum(),
+                     argnums=argnums)(*f32)
+    for gi, ai in zip(grads, argnums):
+        base = [np.array(np.asarray(x), np.float64) for x in inputs]
+        num = np.zeros_like(base[ai])
+        flat = base[ai].reshape(-1)
+        nflat = num.reshape(-1)
+        for k in range(flat.size):
+            orig = flat[k]
+            flat[k] = orig + eps
+            up = float(np.asarray(fn(*[jnp.asarray(b, jnp.float32)
+                                       for b in base])).sum())
+            flat[k] = orig - eps
+            dn = float(np.asarray(fn(*[jnp.asarray(b, jnp.float32)
+                                       for b in base])).sum())
+            flat[k] = orig
+            nflat[k] = (up - dn) / (2 * eps)
+        assert_almost_equal(np.asarray(gi), num, rtol, atol,
+                            names=(f"grad[{ai}]", "numeric"))
+
+
+def check_consistency(fn: Callable, inputs: Sequence[np.ndarray],
+                      dtypes=("float32", "bfloat16"),
+                      jit_check: bool = True):
+    """Run ``fn`` across dtypes (and eager vs jit) and assert agreement at
+    each dtype pair's loosest tolerance — the reference's cross-context
+    ``check_consistency`` with dtype/compile variation standing in for
+    CPU-vs-GPU."""
+    import jax
+    import jax.numpy as jnp
+
+    results = {}
+    for dt in dtypes:
+        args = [jnp.asarray(np.asarray(x)).astype(jnp.dtype(dt))
+                for x in inputs]
+        results[dt] = np.asarray(fn(*args), np.float64)
+        if jit_check:
+            jitted = np.asarray(jax.jit(fn)(*args), np.float64)
+            r, a = default_rtol_atol(dt)
+            assert_almost_equal(results[dt], jitted, r, a,
+                                names=(f"eager[{dt}]", f"jit[{dt}]"))
+    ref_dt = dtypes[0]
+    for dt in dtypes[1:]:
+        r, a = default_rtol_atol(ref_dt, dt)
+        assert_almost_equal(results[ref_dt], results[dt], r, a,
+                            names=(f"{ref_dt}", f"{dt}"))
+    return results
+
+
+def rand_ndarray(shape, stype: str = "default", density: float = 0.5,
+                 dtype="float32", rng: Optional[np.random.RandomState] = None):
+    """Random dense / row_sparse / csr array (reference ``rand_ndarray``).
+
+    ``default`` returns a jnp array; ``row_sparse`` returns
+    ``ops.sparse.RowSparse``; ``csr`` returns ``ops.sparse.CSR``.
+    """
+    import jax.numpy as jnp
+    from dt_tpu.ops import sparse
+
+    rng = rng or np.random.RandomState(np.random.randint(1 << 31))
+    dense = rng.uniform(-1, 1, shape).astype(dtype)
+    if stype == "default":
+        return jnp.asarray(dense)
+    if stype == "row_sparse":
+        keep = rng.rand(shape[0]) < density
+        dense[~keep] = 0
+        nnz = max(int(keep.sum()), 1)
+        return sparse.row_sparse_from_dense(jnp.asarray(dense), nnz=nnz)
+    if stype == "csr":
+        mask = rng.rand(*shape) < density
+        dense[~mask] = 0
+        return sparse.csr_from_dense(jnp.asarray(dense),
+                                     nse=max(int(mask.sum()), 1))
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+def with_seed(seed: Optional[int] = None):
+    """Decorator: seed numpy/python RNGs per test, log the seed on failure
+    so it can be reproduced (reference ``tests/python/unittest/common.py``
+    ``@with_seed``)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            s = seed
+            if s is None:
+                s = int.from_bytes(os.urandom(4), "little")
+            np.random.seed(s)
+            random.seed(s)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                print(f"*** test failure with seed {s}: re-run with "
+                      f"@with_seed({s}) to reproduce ***")
+                raise
+        return wrapper
+    return deco

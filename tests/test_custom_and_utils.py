@@ -1,0 +1,133 @@
+"""Custom-op callback wrapper + public test_utils fixtures.
+
+Reference: ``python/mxnet/operator.py`` CustomOp (host-Python op with
+declared shapes, differentiable) and ``python/mxnet/test_utils.py``.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dt_tpu import test_utils
+from dt_tpu.ops.custom import custom_op
+
+
+def _matmul_op():
+    def fwd(x, w):
+        return x @ w
+
+    def bwd(inputs, outputs, gys):
+        x, w = inputs
+        (gy,) = gys
+        return gy @ w.T, x.T @ gy
+
+    return custom_op(fwd, bwd,
+                     infer_shape=lambda xs, ws: [(xs[0], ws[1])],
+                     name="py_matmul")
+
+
+def test_custom_op_forward_under_jit():
+    op = _matmul_op()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 5).astype(np.float32))
+    y = jax.jit(lambda a, b: op(a, b))(x, w)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) @ np.asarray(w),
+                               rtol=1e-5)
+
+
+def test_custom_op_backward_matches_analytic():
+    op = _matmul_op()
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    w = jnp.asarray(rng.randn(3, 5).astype(np.float32))
+
+    gx, gw = jax.grad(lambda a, b: op(a, b).sum(), argnums=(0, 1))(x, w)
+    ones = np.ones((4, 5), np.float32)
+    np.testing.assert_allclose(np.asarray(gx), ones @ np.asarray(w).T,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(x).T @ ones,
+                               rtol=1e-5)
+
+
+def test_custom_op_multi_output_and_default_shape():
+    def fwd(x):
+        return np.sin(x), np.cos(x)
+
+    op = custom_op(fwd, infer_shape=lambda s: [s, s])
+    x = jnp.asarray(np.linspace(0, 1, 6, dtype=np.float32).reshape(2, 3))
+    s, c = jax.jit(op)(x)
+    np.testing.assert_allclose(np.asarray(s), np.sin(np.asarray(x)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(c), np.cos(np.asarray(x)),
+                               rtol=1e-6)
+
+    ident = custom_op(lambda x: x * 2)     # default: shape of first input
+    y = jax.jit(ident)(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 2)
+
+
+def test_custom_op_under_vmap():
+    op = custom_op(lambda x: x.sum(axis=-1, keepdims=True),
+                   infer_shape=lambda s: [s[:-1] + (1,)])
+    x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(4, 2, 3))
+    y = jax.vmap(op)(x)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(x).sum(-1, keepdims=True))
+
+
+def test_assert_almost_equal_dtype_tolerance():
+    a = np.float32([1.0, 2.0])
+    test_utils.assert_almost_equal(a, a + 1e-7)
+    with pytest.raises(AssertionError):
+        test_utils.assert_almost_equal(a, a + 0.1)
+    # bf16 comparisons get loose tolerance automatically
+    b = jnp.asarray([1.0, 2.0], jnp.bfloat16)
+    test_utils.assert_almost_equal(b, np.float32([1.005, 2.01]))
+
+
+def test_check_numeric_gradient_catches_wrong_grad():
+    from dt_tpu.ops import nn
+
+    # correct op passes
+    test_utils.check_numeric_gradient(
+        lambda x: jnp.tanh(x).sum(), [np.random.RandomState(2).randn(3, 2)])
+
+    # an op with a deliberately wrong custom gradient fails
+    @jax.custom_vjp
+    def bad(x):
+        return jnp.tanh(x)
+
+    bad.defvjp(lambda x: (jnp.tanh(x), x),
+               lambda x, g: (g * 0.5,))    # wrong: not (1 - tanh^2)
+    with pytest.raises(AssertionError):
+        test_utils.check_numeric_gradient(
+            lambda x: bad(x).sum(), [np.random.RandomState(3).randn(3, 2)])
+
+
+def test_check_consistency_dtypes_and_jit():
+    from dt_tpu.ops import nn
+    x = np.random.RandomState(4).randn(4, 8).astype(np.float32)
+    test_utils.check_consistency(lambda a: jax.nn.softmax(a, axis=-1), [x])
+
+
+def test_rand_ndarray_stypes():
+    rng = np.random.RandomState(5)
+    d = test_utils.rand_ndarray((4, 3), rng=rng)
+    assert d.shape == (4, 3)
+    rs = test_utils.rand_ndarray((6, 3), "row_sparse", density=0.5, rng=rng)
+    dense = np.asarray(rs.to_dense())
+    assert dense.shape == (6, 3)
+    zero_rows = (dense == 0).all(axis=1).sum()
+    assert 0 < zero_rows < 6
+    csr = test_utils.rand_ndarray((5, 4), "csr", density=0.3, rng=rng)
+    assert np.asarray(csr.to_dense()).shape == (5, 4)
+
+
+def test_with_seed_reproducible():
+    @test_utils.with_seed(123)
+    def draw():
+        return np.random.rand(3)
+
+    np.testing.assert_array_equal(draw(), draw())

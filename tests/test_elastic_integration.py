@@ -115,9 +115,15 @@ def test_elastic_add_remove_cycle(tmp_path):
 
 
 def test_elastic_accuracy_matches_static(tmp_path):
-    """BASELINE north-star at CPU scale: an add+remove cycle must not cost
-    accuracy vs an uninterrupted run (<0.2% top-1 at ImageNet scale; the
-    reference never tested this)."""
+    """BASELINE north-star at CPU scale: an add+remove cycle with FIXED
+    global batch must track the uninterrupted run's held-out validation
+    curve after the change and land at the same final accuracy (<0.2%
+    top-1 at ImageNet scale — the reference's convergence gate,
+    ``example/image-classification/README.md:325-329`` — tested here at
+    toy scale with the tightest bound the task's noise floor allows;
+    the reference never tested elasticity at all)."""
+
+    num_epoch = 15
 
     def run(tag, elastic_cycle):
         hw = str(tmp_path / f"hw_{tag}")
@@ -125,7 +131,6 @@ def test_elastic_accuracy_matches_static(tmp_path):
         outs = {h: str(tmp_path / f"{tag}_{h}.json")
                 for h in ("w0", "w1", "w2")}
         procs = {}
-        num_epoch = 8
 
         def launch_new(host, epoch):
             procs[host] = _spawn(sched.port, host, outs[host], num_epoch,
@@ -135,9 +140,9 @@ def test_elastic_accuracy_matches_static(tmp_path):
         def operator(epoch):
             if not elastic_cycle:
                 return
-            if epoch == 2:
+            if epoch == 3:
                 _write_hosts(hw, ["w0", "w1", "w2"])
-            elif epoch == 5:
+            elif epoch == 7:
                 _write_hosts(hw, ["w0", "w1"])
 
         sched = Scheduler(host_worker_file=hw,
@@ -147,19 +152,36 @@ def test_elastic_accuracy_matches_static(tmp_path):
             for h in ("w0", "w1"):
                 procs[h] = _spawn(sched.port, h, outs[h], num_epoch)
             for h in ("w0", "w1"):
-                rc = procs[h].wait(timeout=240)
+                rc = procs[h].wait(timeout=300)
                 assert rc == 0, \
                     f"{tag}/{h}:\n{procs[h].stdout.read().decode()[-2000:]}"
             if "w2" in procs:
                 procs["w2"].wait(timeout=60)
-            return json.load(open(outs[f"w0"]))["final_acc"]
+            return json.load(open(outs[f"w0"]))
         finally:
             sched.close()
             for p in procs.values():
                 if p.poll() is None:
                     p.kill()
 
-    static_acc = run("static", elastic_cycle=False)
-    elastic_acc = run("elastic", elastic_cycle=True)
-    assert static_acc > 0.8, static_acc  # the task is learnable at all
-    assert abs(elastic_acc - static_acc) < 0.08, (static_acc, elastic_acc)
+    static = run("static", elastic_cycle=False)
+    elastic = run("elastic", elastic_cycle=True)
+    assert static["final_acc"] > 0.8, static  # learnable at all
+
+    # both runs reach the margin task's ceiling region
+    assert static["final_val_acc"] >= 0.97, static["final_val_acc"]
+
+    # final held-out accuracy within 1% (8x tighter than the round-1 gate;
+    # one val-sample quantum is 1/512 ~ 0.2% — the BASELINE granularity)
+    assert abs(elastic["final_val_acc"] - static["final_val_acc"]) \
+        <= 0.01 + 1e-9, (static["final_val_acc"], elastic["final_val_acc"])
+
+    # post-change validation curve tracks the static run: after the
+    # remove (epoch 7) both runs are 2-worker again; each tail epoch's
+    # val acc must stay within 1.5% and the tail mean within 1%
+    sc = dict(static["acc_curve"])
+    ec = dict(elastic["acc_curve"])
+    tail = range(num_epoch - 3, num_epoch)
+    deltas = [abs(ec[e] - sc[e]) for e in tail]
+    assert max(deltas) <= 0.015 + 1e-9, (deltas, sc, ec)
+    assert sum(deltas) / len(deltas) <= 0.01 + 1e-9, (deltas, sc, ec)

@@ -1,0 +1,132 @@
+"""ssh launcher protocol test with an injected fake-ssh shim.
+
+Reference: ``tools/launch.py`` ssh path (dmlc-tracker ssh submit).  The
+shim executes the remote command line locally through ``env -i sh -c``
+(clean environment, like a fresh ssh session), so the export-prefix env
+contract, rendezvous, and worker lifecycle run for real — only sshd is
+faked (the reference's CI does the same with its local tracker).
+"""
+
+import os
+import stat
+import sys
+import textwrap
+
+import pytest
+
+from dt_tpu.launcher import launch_ssh
+
+
+def _fake_ssh(tmp_path):
+    """A script invoked as `fake_ssh <host> <remote command>` that runs the
+    remote command locally under a scrubbed environment and logs which
+    host was dialed."""
+    shim = tmp_path / "fake_ssh"
+    shim.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        host="$1"; shift
+        echo "$host" >> {tmp_path}/ssh_dials.log
+        exec env -i PATH="$PATH" HOME="$HOME" sh -c "$1"
+    """))
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    return str(shim)
+
+
+def _trainee(tmp_path, extra=""):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "trainee.py"
+    lines = [
+        "import os, sys",
+        f"sys.path.insert(0, {repo!r})",
+        "os.environ.pop('XLA_FLAGS', None)",
+        "from dt_tpu.elastic.client import auto_client",
+        "c = auto_client()",
+        "assert c is not None, 'env contract missing over ssh'",
+        "c.barrier()",
+        f"out = os.path.join({str(tmp_path)!r},"
+        " os.environ['DT_WORKER_ID'] + '.ok')",
+        "open(out, 'w').write(f'{c.rank}/{c.num_workers}')",
+        extra,
+        "c.close()",
+    ]
+    script.write_text("\n".join(lines))
+    return str(script)
+
+
+def test_launch_ssh_runs_workers_via_shim(tmp_path):
+    hostfile = tmp_path / "host_worker"
+    hostfile.write_text("alpha\nbeta\n")
+    script = _trainee(tmp_path)
+    rcs = launch_ssh(2, [sys.executable, script], str(hostfile),
+                     elastic=True, ssh_cmd=_fake_ssh(tmp_path),
+                     root_uri="127.0.0.1", workdir=str(tmp_path))
+    assert all(rc == 0 for rc in rcs.values()), rcs
+    got = sorted(open(str(tmp_path / f"{h}.ok")).read()
+                 for h in ("alpha", "beta"))
+    assert got == ["0/2", "1/2"]
+    dialed = open(str(tmp_path / "ssh_dials.log")).read().split()
+    assert sorted(dialed) == ["alpha", "beta"]
+
+
+def test_launch_ssh_env_contract_without_inheritance(tmp_path):
+    """The scrubbed 'remote' sees the DMLC_*/DT_* contract purely via the
+    command-line exports, and never the launcher's unrelated local env."""
+    hostfile = tmp_path / "host_worker"
+    hostfile.write_text("solo\n")
+    script = _trainee(tmp_path, extra=(
+        "assert os.environ['DMLC_PS_ROOT_URI'] == '127.0.0.1'\n"
+        "assert os.environ['DMLC_ROLE'] == 'worker'\n"
+        "assert os.environ['ELASTIC_TRAINING_ENABLED'] == '1'\n"
+        "assert 'LOCAL_ONLY_SENTINEL' not in os.environ, 'env leaked'"))
+    os.environ["LOCAL_ONLY_SENTINEL"] = "1"
+    try:
+        rcs = launch_ssh(1, [sys.executable, script], str(hostfile),
+                         elastic=True, ssh_cmd=_fake_ssh(tmp_path),
+                         root_uri="127.0.0.1", workdir=str(tmp_path))
+    finally:
+        os.environ.pop("LOCAL_ONLY_SENTINEL", None)
+    assert rcs == {"solo": 0}, rcs
+
+
+def test_launch_ssh_elastic_add_dials_new_host(tmp_path):
+    """Adding a host to host_worker mid-run makes the scheduler ssh into
+    it (the reference's launchCommandOnNewWorker over ssh,
+    ``elastic_training.cc:26-62``), and the joiner participates."""
+    hostfile = tmp_path / "host_worker"
+    hostfile.write_text("alpha\nbeta\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "trainee.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {repo!r})
+        os.environ.pop("XLA_FLAGS", None)
+        from dt_tpu.elastic.client import auto_client
+        c = auto_client()
+        begin = int(os.environ.get("EPOCH_BEGIN", "0"))
+        me = os.environ["DT_WORKER_ID"]
+        for epoch in range(begin, 4):
+            if me == "alpha" and epoch == 2:
+                # operator adds gamma at the epoch-2 boundary
+                tmp = {str(tmp_path)!r} + "/host_worker.tmp"
+                open(tmp, "w").write("alpha\\nbeta\\ngamma\\n")
+                os.replace(tmp, {str(tmp_path)!r} + "/host_worker")
+            c.membership_change_barrier({{"EPOCH_BEGIN": epoch}})
+        out = os.path.join({str(tmp_path)!r}, me + ".ok")
+        open(out, "w").write(f"{{c.rank}}/{{c.num_workers}}")
+        c.close()
+    """))
+    rcs = launch_ssh(2, [sys.executable, str(script)], str(hostfile),
+                     elastic=True, ssh_cmd=_fake_ssh(tmp_path),
+                     root_uri="127.0.0.1", workdir=str(tmp_path))
+    assert all(rc == 0 for rc in rcs.values()), rcs
+    dialed = open(str(tmp_path / "ssh_dials.log")).read().split()
+    assert sorted(set(dialed)) == ["alpha", "beta", "gamma"]
+    assert open(str(tmp_path / "gamma.ok")).read().endswith("/3")
+
+
+def test_launch_ssh_requires_enough_hosts(tmp_path):
+    hostfile = tmp_path / "host_worker"
+    hostfile.write_text("only-one\n")
+    with pytest.raises(ValueError):
+        launch_ssh(2, ["true"], str(hostfile),
+                   ssh_cmd=_fake_ssh(tmp_path), root_uri="127.0.0.1")

@@ -1,0 +1,155 @@
+"""Warp ops (grid/sampler/STN/correlation) vs reference-loop numpy oracles.
+
+Oracles transcribe the reference CPU loops (grid_generator-inl.h,
+bilinear_sampler.cc, correlation.cc CorrelationForward) directly.
+"""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.ops import warp
+
+
+def test_affine_grid_identity():
+    theta = jnp.asarray([[1, 0, 0, 0, 1, 0]], jnp.float32)  # identity
+    g = np.asarray(warp.affine_grid(theta, (3, 5)))
+    assert g.shape == (1, 3, 5, 2)
+    np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, 2], [0, 0], atol=1e-6)
+
+
+def test_affine_grid_translation_scale():
+    # x' = 0.5x + 0.1, y' = 2y - 0.3 applied to the dst lattice
+    theta = jnp.asarray([[0.5, 0, 0.1, 0, 2.0, -0.3]], jnp.float32)
+    g = np.asarray(warp.affine_grid(theta, (4, 4)))
+    xs = -1 + np.arange(4) * 2 / 3
+    np.testing.assert_allclose(g[0, 0, :, 0], 0.5 * xs + 0.1, atol=1e-6)
+    np.testing.assert_allclose(g[0, :, 0, 1], 2.0 * xs - 0.3, atol=1e-6)
+
+
+def test_warp_grid_zero_flow_is_identity_lattice():
+    flow = jnp.zeros((2, 3, 4, 2))
+    g = np.asarray(warp.warp_grid(flow))
+    np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+    # one-pixel x flow moves the grid by 2/(W-1)
+    flow1 = jnp.zeros((1, 3, 4, 2)).at[..., 0].set(1.0)
+    g1 = np.asarray(warp.warp_grid(flow1))
+    np.testing.assert_allclose(g1[0, 0, 0], [-1 + 2 / 3, -1], atol=1e-6)
+
+
+def _sampler_oracle(data, grid):
+    # bilinear_sampler.cc loop (NHWC transcription)
+    b, h, w, c = data.shape
+    _, oh, ow, _ = grid.shape
+    out = np.zeros((b, oh, ow, c), np.float32)
+    for n in range(b):
+        for i in range(oh):
+            for j in range(ow):
+                x = (grid[n, i, j, 0] + 1) * (w - 1) / 2
+                y = (grid[n, i, j, 1] + 1) * (h - 1) / 2
+                ty, tx = int(math.floor(y)), int(math.floor(x))
+                wy, wx = 1 - (y - ty), 1 - (x - tx)
+                for dy, wwy in ((0, wy), (1, 1 - wy)):
+                    for dx, wwx in ((0, wx), (1, 1 - wx)):
+                        yy, xx = ty + dy, tx + dx
+                        if 0 <= yy <= h - 1 and 0 <= xx <= w - 1:
+                            out[n, i, j] += wwy * wwx * data[n, yy, xx]
+    return out
+
+
+def test_bilinear_sampler_matches_oracle():
+    rng = np.random.RandomState(0)
+    data = rng.randn(2, 5, 6, 3).astype(np.float32)
+    grid = rng.uniform(-1.3, 1.3, (2, 4, 4, 2)).astype(np.float32)
+    got = np.asarray(warp.bilinear_sampler(jnp.asarray(data),
+                                           jnp.asarray(grid)))
+    np.testing.assert_allclose(got, _sampler_oracle(data, grid),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_sampler_identity_grid_roundtrip():
+    rng = np.random.RandomState(1)
+    data = rng.randn(1, 4, 4, 2).astype(np.float32)
+    theta = jnp.asarray([[1, 0, 0, 0, 1, 0]], jnp.float32)
+    out = warp.spatial_transformer(jnp.asarray(data), theta, (4, 4))
+    np.testing.assert_allclose(np.asarray(out), data, rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_transformer_grad_flows():
+    rng = np.random.RandomState(2)
+    data = jnp.asarray(rng.randn(1, 6, 6, 2).astype(np.float32))
+
+    def loss(theta):
+        return warp.spatial_transformer(data, theta, (3, 3)).sum()
+
+    g = jax.grad(loss)(jnp.asarray([[1, 0, 0, 0, 1, 0]], jnp.float32))
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def _correlation_oracle(d1, d2, k, md, s1, s2, pad, is_mult):
+    b, h, w, c = d1.shape
+    kr = (k - 1) // 2
+    border = md + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    oh = int(math.ceil((ph - 2 * border) / s1))
+    ow = int(math.ceil((pw - 2 * border) / s1))
+    r = md // s2
+    d = 2 * r + 1
+    p1 = np.zeros((b, ph, pw, c), np.float32)
+    p2 = np.zeros((b, ph, pw, c), np.float32)
+    p1[:, pad:pad + h, pad:pad + w] = d1
+    p2[:, pad:pad + h, pad:pad + w] = d2
+    out = np.zeros((b, oh, ow, d * d), np.float32)
+    for n in range(b):
+        for i in range(oh):
+            for j in range(ow):
+                y1, x1 = i * s1 + md, j * s1 + md
+                for tc in range(d * d):
+                    s2o = (tc % d - r) * s2
+                    s2p = (tc // d - r) * s2
+                    acc = 0.0
+                    for hh in range(k):
+                        for ww in range(k):
+                            va = p1[n, y1 + hh, x1 + ww]
+                            vb = p2[n, y1 + s2p + hh, x1 + s2o + ww]
+                            acc += (va * vb).sum() if is_mult \
+                                else np.abs(va - vb).sum()
+                    out[n, i, j, tc] = acc / (k * k * c)
+    return out
+
+
+def test_correlation_matches_oracle():
+    rng = np.random.RandomState(3)
+    d1 = rng.randn(2, 8, 8, 3).astype(np.float32)
+    d2 = rng.randn(2, 8, 8, 3).astype(np.float32)
+    for (k, md, s1, s2, pad, mult) in [(1, 1, 1, 1, 1, True),
+                                       (3, 2, 2, 1, 3, True),
+                                       (1, 2, 1, 2, 2, False)]:
+        got = np.asarray(warp.correlation(
+            jnp.asarray(d1), jnp.asarray(d2), kernel_size=k,
+            max_displacement=md, stride1=s1, stride2=s2, pad_size=pad,
+            is_multiply=mult))
+        want = _correlation_oracle(d1, d2, k, md, s1, s2, pad, mult)
+        np.testing.assert_allclose(
+            got, want, rtol=1e-4, atol=1e-5,
+            err_msg=f"(k,md,s1,s2,pad,mult)={(k, md, s1, s2, pad, mult)}")
+
+
+def test_correlation_zero_displacement_channel_is_mean_square():
+    # k=1 self-correlation at displacement 0 is exactly mean_c(x^2)
+    rng = np.random.RandomState(4)
+    d1 = rng.randn(1, 9, 9, 4).astype(np.float32)
+    out = np.asarray(warp.correlation(jnp.asarray(d1), jnp.asarray(d1),
+                                      max_displacement=2, pad_size=2))
+    center = out.shape[-1] // 2
+    # pad == md, so the zero-displacement anchor at out (i, j) is exactly
+    # input pixel (i, j) and never touches the zero pad
+    np.testing.assert_allclose(out[0, :, :, center],
+                               (d1[0] ** 2).mean(axis=-1),
+                               rtol=1e-5, atol=1e-6)
