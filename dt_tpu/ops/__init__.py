@@ -17,4 +17,6 @@ from dt_tpu.ops import sparse as sparse
 from dt_tpu.ops import detection as detection
 from dt_tpu.ops import roi as roi
 from dt_tpu.ops import warp as warp
+from dt_tpu.ops import contrib as contrib
+from dt_tpu.ops import linalg as linalg
 from dt_tpu.ops.custom import custom_op as custom_op

@@ -1,0 +1,110 @@
+"""Remaining contrib ops: adaptive pooling, count sketch, Khatri-Rao,
+FFT packing, quadratic, index_copy.
+
+Reference: ``src/operator/contrib/`` — ``adaptive_avg_pooling.cc``
+(torch-style adaptive average pooling), ``count_sketch.cc`` (the
+compact-bilinear-pooling sketch: signed scatter-add through a hash),
+``krprod.cc`` (row-wise Kronecker / Khatri-Rao products), ``fft.cc`` /
+``ifft.cc`` (real input <-> interleaved re/im packing around cuFFT),
+``quadratic_op.cc`` (the tutorial op), ``index_copy.cc``.
+
+TPU-first: adaptive pooling is two interval-mask matmuls (no gathers),
+count sketch is one ``segment_sum``-style scatter-add, Khatri-Rao is an
+einsum — each a single fused XLA op rather than the reference's
+hand-written kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _adaptive_mask(in_size: int, out_size: int, dtype) -> Array:
+    """(out, in) averaging-weight mask: row i covers
+    [floor(i*in/out), ceil((i+1)*in/out)) with 1/len weights — the
+    adaptive-pool bin rule (``adaptive_avg_pooling-inl.h``)."""
+    i = jnp.arange(out_size)
+    lo = (i * in_size) // out_size
+    hi = -((-(i + 1) * in_size) // out_size)          # ceil
+    pos = jnp.arange(in_size)
+    m = (pos[None, :] >= lo[:, None]) & (pos[None, :] < hi[:, None])
+    return m.astype(dtype) / (hi - lo).astype(dtype)[:, None]
+
+
+def adaptive_avg_pool2d(x: Array,
+                        output_size: Union[int, Tuple[int, int]]) -> Array:
+    """Adaptive average pooling, NHWC -> (N, OH, OW, C) (reference
+    ``_contrib_AdaptiveAvgPooling2D``; matches torch semantics)."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else output_size)
+    n, h, w, c = x.shape
+    hm = _adaptive_mask(h, oh, x.dtype)               # (OH, H)
+    wm = _adaptive_mask(w, ow, x.dtype)               # (OW, W)
+    return jnp.einsum("ph,nhwc,qw->npqc", hm, x, wm)
+
+
+def count_sketch(x: Array, h: Array, s: Array, out_dim: int) -> Array:
+    """Count sketch of ``x`` (..., in_dim) -> (..., out_dim):
+    ``out[..., h[j]] += s[j] * x[..., j]`` (reference ``count_sketch.cc``,
+    the compact-bilinear-pooling building block; ``h`` int hash targets in
+    [0, out_dim), ``s`` signs in {-1, +1})."""
+    h = h.astype(jnp.int32)
+    signed = x * s.astype(x.dtype)
+    out = jnp.zeros(x.shape[:-1] + (out_dim,), x.dtype)
+    return out.at[..., h].add(signed)
+
+
+def row_wise_kronecker(matrices: Sequence[Array]) -> Array:
+    """Row-wise Kronecker (a.k.a. transposed Khatri-Rao) product of
+    (N, k_i) matrices -> (N, prod k_i) (reference ``krprod.h``
+    row_wise_kronecker; the tensor-factorization primitive)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        out = jnp.einsum("ni,nj->nij", out, m).reshape(out.shape[0], -1)
+    return out
+
+
+def khatri_rao(matrices: Sequence[Array]) -> Array:
+    """Column-wise Khatri-Rao product of (r_i, K) matrices ->
+    (prod r_i, K) (reference ``krprod.h`` khatri_rao)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+def fft(x: Array) -> Array:
+    """Real (N, D) -> interleaved re/im (N, 2*D), the reference's
+    ``_contrib_fft`` packing around cuFFT (``fft.cc``)."""
+    f = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+    return jnp.stack([f.real, f.imag], axis=-1).reshape(*x.shape[:-1],
+                                                        2 * x.shape[-1])
+
+
+def ifft(x: Array) -> Array:
+    """Interleaved re/im (N, 2*D) -> real (N, D); like the reference's
+    ``_contrib_ifft``, the output is the UNNORMALIZED inverse (scaled by
+    D, cuFFT convention) — divide by D for the true inverse."""
+    d = x.shape[-1] // 2
+    z = x.reshape(*x.shape[:-1], d, 2)
+    f = jax.lax.complex(z[..., 0], z[..., 1])
+    return jnp.fft.ifft(f, axis=-1).real * d
+
+
+def quadratic(x: Array, a: float = 0.0, b: float = 0.0,
+              c: float = 0.0) -> Array:
+    """``a*x^2 + b*x + c`` (reference ``quadratic_op.cc`` — the
+    custom-operator tutorial op, kept for API parity)."""
+    return a * x * x + b * x + c
+
+
+def index_copy(old: Array, index: Array, new_rows: Array) -> Array:
+    """Copy ``new_rows`` into ``old`` at ``index`` along axis 0,
+    functionally (reference ``index_copy.cc`` writes in place; the
+    TPU-native form returns the updated array)."""
+    return old.at[index.astype(jnp.int32)].set(new_rows)

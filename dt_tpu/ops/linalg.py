@@ -1,0 +1,106 @@
+"""Linear-algebra operator family.
+
+Reference: ``src/operator/tensor/la_op.cc`` (``_linalg_*``, backed by
+LAPACK via ``c_lapack_api.h`` / ``linalg_impl.h``): gemm, gemm2, potrf,
+potri, trmm, trsm, sumlogdiag, syrk, gelqf, syevd.  All batched over
+leading dims, lower-triangular convention — semantics below mirror the
+reference docs; the lowering is XLA's native batched linalg (MXU matmuls,
+blocked Cholesky/QR), not LAPACK calls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _t(x: Array) -> Array:
+    return jnp.swapaxes(x, -1, -2)
+
+
+def gemm(a: Array, b: Array, c: Array, alpha: float = 1.0,
+         beta: float = 1.0, transpose_a: bool = False,
+         transpose_b: bool = False) -> Array:
+    """``alpha * op(A) op(B) + beta * C`` (reference ``_linalg_gemm``)."""
+    a = _t(a) if transpose_a else a
+    b = _t(b) if transpose_b else b
+    return alpha * (a @ b) + beta * c
+
+
+def gemm2(a: Array, b: Array, alpha: float = 1.0,
+          transpose_a: bool = False, transpose_b: bool = False) -> Array:
+    """``alpha * op(A) op(B)`` (reference ``_linalg_gemm2``)."""
+    a = _t(a) if transpose_a else a
+    b = _t(b) if transpose_b else b
+    return alpha * (a @ b)
+
+
+def potrf(a: Array) -> Array:
+    """Lower Cholesky factor L with A = L L^T (reference
+    ``_linalg_potrf``)."""
+    return jnp.linalg.cholesky(a)
+
+
+def potri(a: Array) -> Array:
+    """Inverse of A = L L^T given its Cholesky factor L — i.e.
+    ``(L L^T)^-1`` (reference ``_linalg_potri``; note the reference takes
+    L, not A)."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return _t(linv) @ linv
+
+
+def trmm(a: Array, b: Array, alpha: float = 1.0, transpose: bool = False,
+         rightside: bool = False, lower: bool = True) -> Array:
+    """Triangular matrix multiply ``alpha * op(A) B`` (or ``B op(A)``
+    when ``rightside``) with A triangular (reference ``_linalg_trmm``)."""
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    tri = _t(tri) if transpose else tri
+    return alpha * (b @ tri if rightside else tri @ b)
+
+
+def trsm(a: Array, b: Array, alpha: float = 1.0, transpose: bool = False,
+         rightside: bool = False, lower: bool = True) -> Array:
+    """Solve ``op(A) X = alpha B`` (or ``X op(A) = alpha B``) with A
+    triangular (reference ``_linalg_trsm``)."""
+    if rightside:
+        # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
+        sol = jax.scipy.linalg.solve_triangular(
+            _t(a) if not transpose else a, _t(alpha * b),
+            lower=(not lower) if not transpose else lower)
+        return _t(sol)
+    return jax.scipy.linalg.solve_triangular(
+        a, alpha * b, trans=1 if transpose else 0, lower=lower)
+
+
+def sumlogdiag(a: Array) -> Array:
+    """``sum(log(diag(A)))`` over the last two axes (reference
+    ``_linalg_sumlogdiag``; the log-det building block)."""
+    return jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)).sum(-1)
+
+
+def syrk(a: Array, alpha: float = 1.0, transpose: bool = False) -> Array:
+    """``alpha * A A^T`` (or ``alpha * A^T A``) (reference
+    ``_linalg_syrk``)."""
+    a1 = _t(a) if transpose else a
+    return alpha * (a1 @ _t(a1))
+
+
+def gelqf(a: Array):
+    """LQ factorization A = L Q with Q orthonormal rows (reference
+    ``_linalg_gelqf``; m <= n).  Returns (L, Q)."""
+    q, r = jnp.linalg.qr(_t(a), mode="reduced")
+    # sign-fix: reference LAPACK LQ has non-negative diagonal on L
+    sign = jnp.sign(jnp.diagonal(r, axis1=-2, axis2=-1))
+    sign = jnp.where(sign == 0, 1.0, sign)
+    return _t(r) * sign[..., None, :], _t(q * sign[..., None, :])
+
+
+def syevd(a: Array):
+    """Symmetric eigendecomposition A = U^T diag(w) U (reference
+    ``_linalg_syevd``: rows of the returned U are the eigenvectors).
+    Returns (u, w)."""
+    w, v = jnp.linalg.eigh(a)
+    return _t(v), w

@@ -1,0 +1,122 @@
+"""Contrib ops (adaptive pool, count sketch, krprod, fft, misc) vs oracles.
+
+Reference: ``src/operator/contrib/`` (see dt_tpu/ops/contrib.py citations).
+"""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.ops import contrib
+
+
+def test_adaptive_avg_pool2d_matches_loop_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 7, 5, 3).astype(np.float32)
+    oh, ow = 3, 2
+    got = np.asarray(contrib.adaptive_avg_pool2d(jnp.asarray(x), (oh, ow)))
+    want = np.zeros((2, oh, ow, 3), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            h0, h1 = i * 7 // oh, math.ceil((i + 1) * 7 / oh)
+            w0, w1 = j * 5 // ow, math.ceil((j + 1) * 5 / ow)
+            want[:, i, j] = x[:, h0:h1, w0:w1].mean(axis=(1, 2))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_adaptive_avg_pool2d_identity_and_global():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(1, 4, 4, 2).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(contrib.adaptive_avg_pool2d(x, 4)), np.asarray(x),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(contrib.adaptive_avg_pool2d(x, 1))[:, 0, 0],
+        np.asarray(x).mean(axis=(1, 2)), rtol=1e-5)
+
+
+def test_count_sketch_scatter_add_with_collisions():
+    rng = np.random.RandomState(2)
+    in_dim, out_dim = 16, 5
+    x = rng.randn(3, in_dim).astype(np.float32)
+    h = rng.randint(0, out_dim, in_dim)
+    s = rng.choice([-1.0, 1.0], in_dim).astype(np.float32)
+    got = np.asarray(contrib.count_sketch(jnp.asarray(x), jnp.asarray(h),
+                                          jnp.asarray(s), out_dim))
+    want = np.zeros((3, out_dim), np.float32)
+    for j in range(in_dim):
+        want[:, h[j]] += s[j] * x[:, j]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_count_sketch_preserves_dot_in_expectation():
+    # the sketch is an (epsilon, delta) dot-product preserver; with a
+    # fixed seed just check one draw is in the right ballpark
+    rng = np.random.RandomState(3)
+    in_dim, out_dim = 256, 128
+    a = rng.randn(1, in_dim).astype(np.float32)
+    h = rng.randint(0, out_dim, in_dim)
+    s = rng.choice([-1.0, 1.0], in_dim).astype(np.float32)
+    sa = np.asarray(contrib.count_sketch(jnp.asarray(a), jnp.asarray(h),
+                                         jnp.asarray(s), out_dim))
+    dot = float((sa * sa).sum())
+    true = float((a * a).sum())
+    assert abs(dot - true) / true < 0.5
+
+
+def test_krprod_row_and_column():
+    rng = np.random.RandomState(4)
+    a = rng.randn(3, 2).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    got = np.asarray(contrib.row_wise_kronecker(
+        [jnp.asarray(a), jnp.asarray(b)]))
+    want = np.stack([np.kron(a[i], b[i]) for i in range(3)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    c = rng.randn(2, 5).astype(np.float32)
+    d = rng.randn(3, 5).astype(np.float32)
+    got = np.asarray(contrib.khatri_rao([jnp.asarray(c), jnp.asarray(d)]))
+    want = np.stack([np.kron(c[:, k], d[:, k]) for k in range(5)], axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_fft_ifft_roundtrip_and_packing():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 8).astype(np.float32)
+    f = np.asarray(contrib.fft(jnp.asarray(x)))
+    assert f.shape == (4, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, rtol=1e-4, atol=1e-5)
+    # unnormalized inverse (cuFFT convention): ifft(fft(x)) == D * x
+    back = np.asarray(contrib.ifft(jnp.asarray(f)))
+    np.testing.assert_allclose(back, 8 * x, rtol=1e-4, atol=1e-4)
+
+
+def test_quadratic_and_index_copy():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(
+        np.asarray(contrib.quadratic(x, a=2, b=-1, c=3)),
+        2 * np.asarray(x) ** 2 - np.asarray(x) + 3)
+
+    old = jnp.zeros((5, 3))
+    new = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = np.asarray(contrib.index_copy(old, jnp.asarray([4, 1]), new))
+    assert (out[4] == [0, 1, 2]).all() and (out[1] == [3, 4, 5]).all()
+    assert (out[[0, 2, 3]] == 0).all()
+
+
+def test_contrib_ops_jit_and_grad():
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(2, 9, 9, 4).astype(np.float32))
+
+    @jax.jit
+    def f(x):
+        return contrib.adaptive_avg_pool2d(x, 3).sum()
+
+    g = jax.grad(f)(x)
+    # average pooling conserves gradient mass: 3*3 bins x 4 ch x 2 batch
+    np.testing.assert_allclose(float(np.asarray(g).sum()), 2 * 9 * 4,
+                               rtol=1e-5)

@@ -1,0 +1,103 @@
+"""Linalg op family vs numpy oracles (reference la_op.cc semantics)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dt_tpu.ops import linalg
+
+
+def _spd(rng, b, n):
+    a = rng.randn(b, n, n).astype(np.float32)
+    return a @ a.transpose(0, 2, 1) + n * np.eye(n, dtype=np.float32)
+
+
+def test_gemm_and_gemm2():
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(2, 4, 5).astype(np.float32)
+    c = rng.randn(2, 3, 5).astype(np.float32)
+    got = np.asarray(linalg.gemm(jnp.asarray(a), jnp.asarray(b),
+                                 jnp.asarray(c), alpha=2.0, beta=-1.0))
+    np.testing.assert_allclose(got, 2 * (a @ b) - c, rtol=1e-5, atol=1e-5)
+
+    got = np.asarray(linalg.gemm2(jnp.asarray(a), jnp.asarray(c),
+                                  transpose_a=True, alpha=0.5))
+    np.testing.assert_allclose(got, 0.5 * a.transpose(0, 2, 1) @ c,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_potrf_potri():
+    rng = np.random.RandomState(1)
+    a = _spd(rng, 2, 4)
+    L = np.asarray(linalg.potrf(jnp.asarray(a)))
+    np.testing.assert_allclose(L @ L.transpose(0, 2, 1), a, rtol=1e-4,
+                               atol=1e-4)
+    assert np.allclose(np.triu(L, 1), 0)
+    inv = np.asarray(linalg.potri(jnp.asarray(L)))
+    np.testing.assert_allclose(inv @ a, np.broadcast_to(np.eye(4), a.shape),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_trmm_trsm_all_sides():
+    rng = np.random.RandomState(2)
+    a = np.tril(rng.randn(3, 3)).astype(np.float32) + 3 * np.eye(
+        3, dtype=np.float32)
+    b = rng.randn(3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(linalg.trmm(jnp.asarray(a), jnp.asarray(b), alpha=2.0)),
+        2 * a @ b, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(linalg.trmm(jnp.asarray(a), jnp.asarray(b),
+                               rightside=True, transpose=True)),
+        b @ a.T, rtol=1e-5, atol=1e-5)
+
+    # trsm inverts trmm on every (rightside, transpose) combination
+    for right in (False, True):
+        for tr in (False, True):
+            prod = np.asarray(linalg.trmm(jnp.asarray(a), jnp.asarray(b),
+                                          rightside=right, transpose=tr))
+            back = np.asarray(linalg.trsm(jnp.asarray(a),
+                                          jnp.asarray(prod),
+                                          rightside=right, transpose=tr))
+            np.testing.assert_allclose(
+                back, b, rtol=1e-4, atol=1e-4,
+                err_msg=f"rightside={right} transpose={tr}")
+
+
+def test_sumlogdiag_syrk():
+    rng = np.random.RandomState(3)
+    a = _spd(rng, 2, 3)
+    got = np.asarray(linalg.sumlogdiag(jnp.asarray(a)))
+    want = np.log(np.diagonal(a, axis1=1, axis2=2)).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    m = rng.randn(2, 3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(linalg.syrk(jnp.asarray(m), alpha=0.5)),
+        0.5 * m @ m.transpose(0, 2, 1), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(linalg.syrk(jnp.asarray(m), transpose=True)),
+        m.transpose(0, 2, 1) @ m, rtol=1e-5, atol=1e-5)
+
+
+def test_gelqf_reconstruction():
+    rng = np.random.RandomState(4)
+    a = rng.randn(2, 3, 5).astype(np.float32)   # m <= n
+    L, Q = (np.asarray(t) for t in linalg.gelqf(jnp.asarray(a)))
+    np.testing.assert_allclose(L @ Q, a, rtol=1e-4, atol=1e-4)
+    # Q orthonormal rows, L lower-tri with non-negative diagonal
+    np.testing.assert_allclose(Q @ Q.transpose(0, 2, 1),
+                               np.broadcast_to(np.eye(3), (2, 3, 3)),
+                               rtol=1e-4, atol=1e-4)
+    assert np.allclose(np.triu(L, 1), 0, atol=1e-5)
+    assert (np.diagonal(L, axis1=1, axis2=2) >= -1e-6).all()
+
+
+def test_syevd_reconstruction():
+    rng = np.random.RandomState(5)
+    a = _spd(rng, 2, 4)
+    U, w = (np.asarray(t) for t in linalg.syevd(jnp.asarray(a)))
+    # rows of U are eigenvectors: A = U^T diag(w) U
+    recon = U.transpose(0, 2, 1) @ (w[:, :, None] * U)
+    np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+    assert (np.diff(w, axis=-1) >= -1e-4).all()  # ascending
