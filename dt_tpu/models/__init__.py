@@ -32,6 +32,9 @@ from dt_tpu.models.lstm_lm import LSTMLanguageModel as LSTMLanguageModel
 from dt_tpu.models.transformer import TransformerLM as TransformerLM
 from dt_tpu.models.ssd import (SSD as SSD, ssd_loss as ssd_loss,
                                ssd_detect as ssd_detect)
+from dt_tpu.models.rcnn import (FasterRCNNMini as FasterRCNNMini,
+                                rcnn_loss as rcnn_loss,
+                                rcnn_detect as rcnn_detect)
 
 _REGISTRY: Dict[str, Callable[..., Any]] = {}
 
@@ -81,6 +84,7 @@ def _setup_registry():
     register("lstm_lm", lambda **kw: LSTMLanguageModel(**kw))
     register("transformer_lm", lambda **kw: TransformerLM(**kw))
     register("ssd", lambda **kw: SSD(**kw))
+    register("faster_rcnn", lambda **kw: FasterRCNNMini(**kw))
 
 
 _setup_registry()

@@ -1,0 +1,189 @@
+"""Two-stage detector (Faster-RCNN family).
+
+Reference: ``example/rcnn/`` — backbone -> RPN (objectness + deltas over
+anchors) -> proposal op -> ROI feature extraction -> classification head
+with per-class box refinement, backed by the contrib ops this framework
+re-implements (``src/operator/contrib/proposal.cc``,
+``src/operator/contrib/roi_align.cc`` / ``roi_pooling.cc``).
+
+TPU-first shape discipline: the proposal stage emits a FIXED number of
+ROIs per image (top-K + NMS with pad-by-best, ``dt_tpu.ops.roi.proposal``),
+so the second stage is a static (B*R, ...) batch — no dynamic shapes
+anywhere, the whole train step jits.  Proposal boxes are stop-gradiented
+(standard Faster-RCNN: the head does not backprop through box coords).
+"""
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.models.common import ConvBN
+from dt_tpu.ops import roi as roi_ops
+from dt_tpu.ops.detection import box_iou, encode_boxes, decode_boxes
+
+
+class FasterRCNNMini(linen.Module):
+    """Compact two-stage detector.
+
+    ``__call__(x, training)`` returns a dict:
+      rpn_scores (B, H, W, A), rpn_deltas (B, H, W, A, 4),
+      rois (B, R, 4) image-pixel corners (stop-gradient),
+      roi_scores (B, R), cls_scores (B, R, C+1), box_deltas (B, R, 4).
+    """
+    num_classes: int = 3
+    feature_stride: int = 8
+    anchor_scales: Sequence[float] = (2.0, 4.0)
+    anchor_ratios: Sequence[float] = (0.5, 1.0, 2.0)
+    num_rois: int = 32
+    pre_nms_top_n: int = 256
+    nms_threshold: float = 0.7
+    pooled_size: int = 7
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x, training: bool = True):
+        b, img_h, img_w, _ = x.shape
+        a = len(self.anchor_scales) * len(self.anchor_ratios)
+
+        # backbone to stride 8
+        for f in (32, 64, 128):
+            x = ConvBN(f, (3, 3), (2, 2), dtype=self.dtype)(x, training)
+        feat = x                                           # (B, H/8, W/8, C)
+
+        # RPN
+        rpn = linen.Conv(256, (3, 3), padding="SAME",
+                         dtype=self.dtype)(feat)
+        rpn = jax.nn.relu(rpn)
+        scores = linen.Conv(a, (1, 1), dtype=self.dtype)(rpn)
+        scores = jax.nn.sigmoid(scores.astype(jnp.float32))
+        h, w = scores.shape[1], scores.shape[2]
+        deltas = linen.Conv(a * 4, (1, 1), dtype=self.dtype)(rpn) \
+            .astype(jnp.float32).reshape(b, h, w, a, 4)
+
+        im_info = jnp.broadcast_to(
+            jnp.asarray([img_h, img_w, 1.0], jnp.float32), (b, 3))
+        rois, roi_scores = roi_ops.multi_proposal(
+            scores, deltas, im_info, stride=self.feature_stride,
+            scales=self.anchor_scales, ratios=self.anchor_ratios,
+            pre_nms_top_n=self.pre_nms_top_n,
+            post_nms_top_n=self.num_rois,
+            nms_threshold=self.nms_threshold)
+        rois = jax.lax.stop_gradient(rois)                 # (B, R, 4)
+
+        # ROI features: (B*R, 5) with batch indices, align on the feature map
+        r = self.num_rois
+        batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.float32), r)
+        flat = jnp.concatenate([batch_idx[:, None],
+                                rois.reshape(b * r, 4)], axis=1)
+        pooled = roi_ops.roi_align(
+            feat.astype(jnp.float32), flat,
+            (self.pooled_size, self.pooled_size),
+            spatial_scale=1.0 / self.feature_stride, sample_ratio=2)
+
+        # head
+        y = pooled.reshape(b * r, -1)
+        y = jax.nn.relu(linen.Dense(256, dtype=self.dtype)(y))
+        y = jax.nn.relu(linen.Dense(256, dtype=self.dtype)(y))
+        cls = linen.Dense(self.num_classes + 1)(y.astype(jnp.float32))
+        box = linen.Dense(4)(y.astype(jnp.float32))
+        return {
+            "rpn_scores": scores, "rpn_deltas": deltas,
+            "rois": rois, "roi_scores": roi_scores,
+            "cls_scores": cls.reshape(b, r, self.num_classes + 1),
+            "box_deltas": box.reshape(b, r, 4),
+        }
+
+    def anchors(self, img_hw: Tuple[int, int]) -> jnp.ndarray:
+        """All shifted anchors for an input size -> (H*W*A, 4), the RPN
+        target grid.  Ceil division matches the SAME-padded stride-2
+        backbone's feature sizes for inputs not divisible by the stride;
+        the enumeration itself is shared with the proposal stage
+        (:func:`dt_tpu.ops.roi.shifted_anchors`)."""
+        h = -(-img_hw[0] // self.feature_stride)
+        w = -(-img_hw[1] // self.feature_stride)
+        return roi_ops.shifted_anchors(h, w, self.feature_stride,
+                                       self.anchor_scales,
+                                       self.anchor_ratios)
+
+
+def _smooth_l1(x):
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+def rcnn_loss(out, anchors, gt_boxes, gt_labels,
+              rpn_pos_iou: float = 0.5, head_pos_iou: float = 0.5):
+    """Joint RPN + head loss for a batch (reference
+    ``example/rcnn/rcnn/core`` loss wiring, fixed-shape).
+
+    ``gt_boxes`` (B, M, 4) image-pixel corners zero-padded; ``gt_labels``
+    (B, M) int with -1 padding.  RPN: binary CE on matched/background
+    anchors + smooth-L1 on positives.  Head: softmax CE over C+1 with
+    proposals matched to gt by IoU + smooth-L1 on positive proposals
+    (targets encoded w.r.t. the proposal boxes, variances 1).
+    """
+    b, h, w, a = out["rpn_scores"].shape
+    n_anchor = anchors.shape[0]
+
+    def one(scores, deltas, rois, cls_scores, box_deltas, gtb, gtl):
+        valid = gtl >= 0
+        # ---- RPN targets (multibox-style matching on raw anchors)
+        iou = box_iou(anchors, gtb) * valid[None, :]
+        best = jnp.max(iou, axis=1)
+        arg = jnp.argmax(iou, axis=1)
+        pos = best > rpn_pos_iou
+        # force best anchor per valid gt
+        best_anchor = jnp.argmax(iou, axis=0)
+        idx = jnp.where(valid, best_anchor, n_anchor)
+        pos = pos | jnp.zeros(n_anchor, bool).at[idx].set(True, mode="drop")
+        neg = best < 0.3
+        s = scores.reshape(-1)
+        bce = -(pos * jnp.log(s + 1e-8)
+                + neg * (~pos) * jnp.log(1 - s + 1e-8))
+        n_pos = jnp.maximum(jnp.sum(pos), 1)
+        rpn_cls = jnp.sum(bce) / jnp.maximum(jnp.sum(pos | neg), 1)
+        # loc targets in the RPN's +1-convention encoding: the exact
+        # inverse of the proposal stage's decode (shared helper)
+        t = roi_ops.encode_rpn(anchors, gtb[arg])
+        rpn_loc = jnp.sum(_smooth_l1(deltas.reshape(-1, 4) - t)
+                          * pos[:, None]) / n_pos
+
+        # ---- head targets (proposals matched to gt)
+        piou = box_iou(rois, gtb) * valid[None, :]
+        pbest = jnp.max(piou, axis=1)
+        parg = jnp.argmax(piou, axis=1)
+        ppos = pbest > head_pos_iou
+        cls_t = jnp.where(ppos, gtl[parg] + 1, 0)
+        logp = jax.nn.log_softmax(cls_scores)
+        head_cls = -jnp.mean(
+            jnp.take_along_axis(logp, cls_t[:, None], axis=1)[:, 0])
+        # box refinement targets w.r.t. proposal boxes (variances 1)
+        t2 = encode_boxes(rois, gtb[parg], variances=(1, 1, 1, 1))
+        head_loc = jnp.sum(_smooth_l1(box_deltas - t2) * ppos[:, None]) \
+            / jnp.maximum(jnp.sum(ppos), 1)
+        return rpn_cls + rpn_loc + head_cls + head_loc
+
+    return jnp.mean(jax.vmap(one)(
+        out["rpn_scores"], out["rpn_deltas"], out["rois"],
+        out["cls_scores"], out["box_deltas"], gt_boxes, gt_labels))
+
+
+def rcnn_detect(out, score_threshold: float = 0.05,
+                iou_threshold: float = 0.5):
+    """Decode head predictions -> (labels (B, R), scores, boxes) with
+    label -1 for background/suppressed (same contract as ssd_detect)."""
+    from dt_tpu.ops.detection import nms
+
+    def one(rois, cls_scores, box_deltas):
+        probs = jax.nn.softmax(cls_scores, axis=-1)
+        scores = jnp.max(probs[:, 1:], axis=1)
+        labels = jnp.argmax(probs[:, 1:], axis=1)
+        boxes = decode_boxes(rois, box_deltas, variances=(1, 1, 1, 1))
+        keep = nms(boxes, scores, iou_threshold, score_threshold,
+                   labels=labels)
+        return jnp.where(keep, labels, -1), scores, boxes
+
+    return jax.vmap(one)(out["rois"], out["cls_scores"],
+                         out["box_deltas"])

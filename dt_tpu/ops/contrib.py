@@ -91,7 +91,7 @@ def ifft(x: Array) -> Array:
     ``_contrib_ifft``, the output is the UNNORMALIZED inverse (scaled by
     D, cuFFT convention) — divide by D for the true inverse."""
     d = x.shape[-1] // 2
-    z = x.reshape(*x.shape[:-1], d, 2)
+    z = x.astype(jnp.float32).reshape(*x.shape[:-1], d, 2)
     f = jax.lax.complex(z[..., 0], z[..., 1])
     return jnp.fft.ifft(f, axis=-1).real * d
 

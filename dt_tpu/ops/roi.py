@@ -270,6 +270,39 @@ def generate_anchors(stride: int = 16,
     return jnp.asarray(np.array(out, np.float32))
 
 
+def shifted_anchors(feat_h: int, feat_w: int, stride: int,
+                    scales: Sequence[float], ratios: Sequence[float]
+                    ) -> Array:
+    """All anchors for a (feat_h, feat_w) feature grid -> (H*W*A, 4):
+    base anchors shifted by ``stride`` per cell, row-major over (h, w, a)
+    — the enumeration ``proposal.cc`` builds its workspace with."""
+    base = generate_anchors(stride, scales, ratios)
+    sx = jnp.arange(feat_w, dtype=jnp.float32) * stride
+    sy = jnp.arange(feat_h, dtype=jnp.float32) * stride
+    shift = jnp.stack(
+        [jnp.tile(sx[None, :], (feat_h, 1)),
+         jnp.tile(sy[:, None], (1, feat_w)),
+         jnp.tile(sx[None, :], (feat_h, 1)),
+         jnp.tile(sy[:, None], (1, feat_w))], -1)
+    return (shift[:, :, None, :] + base[None, None]).reshape(-1, 4)
+
+
+def encode_rpn(anchors: Array, gt: Array) -> Array:
+    """Regression targets such that :func:`_decode_rpn` maps them back to
+    ``gt`` — the exact inverse of the +1-pixel-convention decode
+    (``proposal.cc`` BBoxTransformInv / ``example/rcnn`` bbox_transform)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * (aw - 1.0)
+    acy = anchors[:, 1] + 0.5 * (ah - 1.0)
+    gw = jnp.clip(gt[:, 2] - gt[:, 0] + 1.0, 1.0)
+    gh = jnp.clip(gt[:, 3] - gt[:, 1] + 1.0, 1.0)
+    gcx = gt[:, 0] + 0.5 * (gw - 1.0)
+    gcy = gt[:, 1] + 0.5 * (gh - 1.0)
+    return jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                      jnp.log(gw / aw), jnp.log(gh / ah)], -1)
+
+
 def _decode_rpn(anchors: Array, deltas: Array, im_h: Array,
                 im_w: Array) -> Array:
     """``BBoxTransformInv`` (proposal.cc): decode with the +1/-1 pixel
@@ -307,15 +340,10 @@ def proposal(scores: Array, bbox_deltas: Array, im_info: Array,
     with index-0 rows — same contract: consumers must handle duplicates).
     """
     h, w, a = scores.shape
-    base = generate_anchors(stride, scales, ratios)      # (A, 4)
-    assert a == base.shape[0], \
-        f"scores carry {a} anchors/cell, scales x ratios give {base.shape[0]}"
-    sx = jnp.arange(w, dtype=jnp.float32) * stride
-    sy = jnp.arange(h, dtype=jnp.float32) * stride
-    shift = jnp.stack(
-        [jnp.tile(sx[None, :], (h, 1)), jnp.tile(sy[:, None], (1, w)),
-         jnp.tile(sx[None, :], (h, 1)), jnp.tile(sy[:, None], (1, w))], -1)
-    anchors = (shift[:, :, None, :] + base[None, None]).reshape(-1, 4)
+    n_base = len(scales) * len(ratios)
+    assert a == n_base, \
+        f"scores carry {a} anchors/cell, scales x ratios give {n_base}"
+    anchors = shifted_anchors(h, w, stride, scales, ratios)
     deltas = bbox_deltas.reshape(-1, 4)
     scr = scores.reshape(-1)
 

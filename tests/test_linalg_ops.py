@@ -51,17 +51,21 @@ def test_trmm_trsm_all_sides():
                                rightside=True, transpose=True)),
         b @ a.T, rtol=1e-5, atol=1e-5)
 
-    # trsm inverts trmm on every (rightside, transpose) combination
-    for right in (False, True):
-        for tr in (False, True):
-            prod = np.asarray(linalg.trmm(jnp.asarray(a), jnp.asarray(b),
-                                          rightside=right, transpose=tr))
-            back = np.asarray(linalg.trsm(jnp.asarray(a),
-                                          jnp.asarray(prod),
-                                          rightside=right, transpose=tr))
-            np.testing.assert_allclose(
-                back, b, rtol=1e-4, atol=1e-4,
-                err_msg=f"rightside={right} transpose={tr}")
+    # trsm inverts trmm on every (rightside, transpose, lower) combination
+    au = np.triu(rng.randn(3, 3)).astype(np.float32) + 3 * np.eye(
+        3, dtype=np.float32)
+    for low, mat in ((True, a), (False, au)):
+        for right in (False, True):
+            for tr in (False, True):
+                prod = np.asarray(linalg.trmm(
+                    jnp.asarray(mat), jnp.asarray(b), rightside=right,
+                    transpose=tr, lower=low))
+                back = np.asarray(linalg.trsm(
+                    jnp.asarray(mat), jnp.asarray(prod), rightside=right,
+                    transpose=tr, lower=low))
+                np.testing.assert_allclose(
+                    back, b, rtol=1e-4, atol=1e-4,
+                    err_msg=f"rightside={right} transpose={tr} lower={low}")
 
 
 def test_sumlogdiag_syrk():

@@ -1,0 +1,122 @@
+"""Two-stage detector: shapes, jittable joint train step, loss decreases.
+
+Reference: ``example/rcnn`` (Faster-RCNN training over proposal +
+roi_align contrib ops).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from dt_tpu import models
+from dt_tpu.models.rcnn import rcnn_loss, rcnn_detect
+
+
+def _batch(rng, b=2, size=64, m=2, num_classes=2):
+    imgs = rng.rand(b, size, size, 3).astype(np.float32) * 0.2
+    boxes = np.zeros((b, m, 4), np.float32)
+    labels = np.full((b, m), -1, np.int64)
+    for i in range(b):
+        for j in range(rng.randint(1, m + 1)):
+            cx, cy = rng.uniform(0.3, 0.7, 2) * size
+            w, h = rng.uniform(0.25, 0.5, 2) * size
+            x1, y1 = max(cx - w / 2, 0), max(cy - h / 2, 0)
+            x2, y2 = min(cx + w / 2, size - 1), min(cy + h / 2, size - 1)
+            cls = rng.randint(0, num_classes)
+            imgs[i, int(y1):int(y2) + 1, int(x1):int(x2) + 1, cls] += 0.8
+            boxes[i, j] = [x1, y1, x2, y2]
+            labels[i, j] = cls
+    return imgs, boxes, labels
+
+
+def test_rcnn_forward_shapes_and_fixed_rois():
+    model = models.create("faster_rcnn", num_classes=2, num_rois=16)
+    x = jnp.zeros((2, 64, 64, 3))
+    vars_ = model.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    out = model.apply(vars_, x, training=False)
+    assert out["rois"].shape == (2, 16, 4)
+    assert out["cls_scores"].shape == (2, 16, 3)
+    assert out["box_deltas"].shape == (2, 16, 4)
+    a = len(model.anchor_scales) * len(model.anchor_ratios)
+    assert out["rpn_scores"].shape == (2, 8, 8, a)
+    # rois clipped to the image
+    r = np.asarray(out["rois"])
+    assert (r >= 0).all() and (r <= 63).all()
+    # anchors helper matches the proposal grid size
+    assert model.anchors((64, 64)).shape == (8 * 8 * a, 4)
+
+
+def test_rcnn_anchor_grid_matches_rpn_for_nondivisible_size():
+    # SAME-padded stride-2 backbone gives ceil-sized feature maps; the
+    # anchor grid must agree for inputs not divisible by the stride
+    model = models.create("faster_rcnn", num_classes=2, num_rois=8)
+    x = jnp.zeros((1, 68, 68, 3))
+    vars_ = model.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    out = model.apply(vars_, x, training=False)
+    h, w, a = out["rpn_scores"].shape[1:]
+    assert model.anchors((68, 68)).shape == (h * w * a, 4)
+    # and the joint loss runs on that grid
+    gtb = jnp.asarray(np.array([[[10, 10, 40, 40]]], np.float32))
+    gtl = jnp.asarray(np.array([[1]], np.int64))
+    loss = rcnn_loss(out, model.anchors((68, 68)), gtb, gtl)
+    assert np.isfinite(float(loss))
+
+
+def test_encode_rpn_is_decode_inverse():
+    from dt_tpu.ops import roi as roi_ops
+    rng = np.random.RandomState(7)
+    anchors = jnp.asarray(
+        roi_ops.shifted_anchors(3, 3, 16, (2.0,), (0.5, 1.0)))
+    lo = rng.uniform(0, 30, (anchors.shape[0], 2)).astype(np.float32)
+    wh = rng.uniform(2, 20, (anchors.shape[0], 2)).astype(np.float32)
+    gt = jnp.asarray(np.concatenate([lo, lo + wh], axis=1))  # x1,y1,x2,y2
+    t = roi_ops.encode_rpn(anchors, gt)
+    back = roi_ops._decode_rpn(anchors, t, jnp.float32(1e9),
+                               jnp.float32(1e9))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(gt),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_rcnn_joint_train_step_learns():
+    rng = np.random.RandomState(0)
+    model = models.create("faster_rcnn", num_classes=2, num_rois=16)
+    imgs, boxes, labels = _batch(rng)
+    x = jnp.asarray(imgs)
+    vars_ = model.init({"params": jax.random.PRNGKey(1)}, x, training=False)
+    params, bstats = vars_["params"], vars_["batch_stats"]
+    anchors = model.anchors((64, 64))
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, bstats, opt, x, gtb, gtl):
+        def loss_of(p):
+            out, mut = model.apply(
+                {"params": p, "batch_stats": bstats}, x, training=True,
+                mutable=["batch_stats"])
+            return rcnn_loss(out, anchors, gtb, gtl), mut["batch_stats"]
+        (loss, bs), g = jax.value_and_grad(loss_of, has_aux=True)(params)
+        up, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, up), bs, opt, loss
+
+    gtb, gtl = jnp.asarray(boxes), jnp.asarray(labels)
+    losses = []
+    for _ in range(15):
+        params, bstats, opt, loss = step(params, bstats, opt, x, gtb, gtl)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_rcnn_detect_contract():
+    rng = np.random.RandomState(2)
+    model = models.create("faster_rcnn", num_classes=2, num_rois=16)
+    imgs, _, _ = _batch(rng)
+    x = jnp.asarray(imgs)
+    vars_ = model.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    out = model.apply(vars_, x, training=False)
+    labels, scores, boxes = rcnn_detect(out)
+    assert labels.shape == (2, 16) and boxes.shape == (2, 16, 4)
+    lab = np.asarray(labels)
+    assert ((lab >= -1) & (lab < 2)).all()
