@@ -157,11 +157,31 @@ class WorkerClient:
         is an array, or a ``{"packed", "n", "threshold"}`` dict for
         2-bit-compressed gradients (scheduler dequantizes before merging).
 
+        Arrays larger than ``DT_AR_CHUNK_BYTES`` (default 4 MiB) are split
+        into per-chunk rounds on subkeys ``key#c<i>`` — the reference
+        splits big tensors across server key ranges for the same reason
+        (``kvstore_dist.h:547-589`` EncodeDefaultKey): bounded message
+        size and scheduler peak memory of O(workers x chunk), not
+        O(workers x full gradient).
+
         Each call carries a per-host sequence number so an at-least-once
         retry of a lost RESPONSE is served the cached result instead of
         being mistaken for the next round's contribution."""
         if not isinstance(value, dict):
             value = np.asarray(value)
+            chunk_bytes = int(os.environ.get("DT_AR_CHUNK_BYTES",
+                                             str(4 << 20)))
+            per = max(1, chunk_bytes // max(value.itemsize, 1))
+            # split on element count, not bytes: a single-element array is
+            # never split again, so pathological chunk sizes below the
+            # itemsize terminate instead of recursing on "#c0" forever
+            if value.size > per:
+                flat = value.ravel()
+                parts = [
+                    self.allreduce(f"{key}#c{i}",
+                                   flat[start:start + per])
+                    for i, start in enumerate(range(0, flat.size, per))]
+                return np.concatenate(parts).reshape(value.shape)
         seq = self._ar_seq.get(key, 0)
         self._ar_seq[key] = seq + 1
         out = self._req({"cmd": "allreduce", "host": self.host, "key": key,

@@ -134,10 +134,17 @@ def rcnn_loss(out, anchors, gt_boxes, gt_labels,
         best = jnp.max(iou, axis=1)
         arg = jnp.argmax(iou, axis=1)
         pos = best > rpn_pos_iou
-        # force best anchor per valid gt
+        # force best anchor per valid gt, and assign THAT gt as its loc
+        # target (multibox_target's gt_of_forced correction,
+        # dt_tpu/ops/detection.py): without it a forced anchor regresses
+        # toward its argmax gt, which for zero-IoU rows is padding row 0
         best_anchor = jnp.argmax(iou, axis=0)
         idx = jnp.where(valid, best_anchor, n_anchor)
-        pos = pos | jnp.zeros(n_anchor, bool).at[idx].set(True, mode="drop")
+        force = jnp.zeros(n_anchor, bool).at[idx].set(True, mode="drop")
+        gt_of_forced = jnp.zeros(n_anchor, jnp.int32).at[idx].set(
+            jnp.arange(gtb.shape[0]), mode="drop")
+        arg = jnp.where(force, gt_of_forced, arg)
+        pos = pos | force
         neg = best < 0.3
         s = scores.reshape(-1)
         bce = -(pos * jnp.log(s + 1e-8)

@@ -208,6 +208,40 @@ def test_allreduce_exact_values(sched):
     np.testing.assert_allclose(outs2["w0"], 15.0)
 
 
+def test_allreduce_chunked_large_array(sched, monkeypatch):
+    """Arrays above DT_AR_CHUNK_BYTES split into per-chunk rounds
+    (bounded message size / scheduler memory, the EncodeDefaultKey
+    big-tensor split analog, kvstore_dist.h:547-589) and reassemble to
+    the exact mean — including under message-drop fuzz."""
+    monkeypatch.setenv("DT_AR_CHUNK_BYTES", "4096")  # 1024 f32 per chunk
+    monkeypatch.setenv("DT_DROP_MSG", "15")
+    s, _ = sched
+    cs = [WorkerClient("127.0.0.1", s.port, host=h, is_new=False)
+          for h in ("w0", "w1")]
+    n = 5000  # -> 5 chunks (4 full + 1 tail)
+    rng = np.random.RandomState(0)
+    vals = {c.host: rng.randn(n).astype(np.float32).reshape(50, 100)
+            for c in cs}
+    outs = {}
+
+    def push(c):
+        outs[c.host] = c.allreduce("big", vals[c.host])
+
+    ts = [threading.Thread(target=push, args=(c,)) for c in cs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    want = (vals["w0"] + vals["w1"]) / 2
+    np.testing.assert_allclose(outs["w0"], want, rtol=1e-6)
+    np.testing.assert_allclose(outs["w1"], want, rtol=1e-6)
+    assert outs["w0"].shape == (50, 100)
+    # the scheduler reduced per-chunk subkeys, never one giant key
+    assert "big" not in s._reduce
+    assert {k for k in s._reduce if k.startswith("big#c")} == \
+        {f"big#c{i}" for i in range(5)}
+
+
 def _closed_unanswered(sk):
     """True if the peer closed without sending a byte (clean FIN or RST —
     the RST happens when the peer closes with our data still unread)."""

@@ -1,0 +1,71 @@
+"""Vocabulary + TokenEmbedding (reference python/mxnet/contrib/text)."""
+
+import numpy as np
+import pytest
+
+from dt_tpu.text import Vocabulary, TokenEmbedding
+
+
+def test_vocabulary_ordering_and_lookup():
+    counter = {"b": 3, "a": 3, "c": 1, "d": 5}
+    v = Vocabulary(counter, reserved_tokens=["<pad>"])
+    # unk, reserved, then (-freq, token) order: d(5), a(3), b(3), c(1)
+    assert v.idx_to_token == ["<unk>", "<pad>", "d", "a", "b", "c"]
+    assert v.to_indices("d") == 2
+    assert v.to_indices(["a", "zzz"]) == [3, 0]  # unknown -> 0
+    assert v.to_tokens([0, 5]) == ["<unk>", "c"]
+    assert len(v) == 6
+
+
+def test_vocabulary_limits():
+    counter = {"a": 5, "b": 4, "c": 3, "d": 1}
+    assert len(Vocabulary(counter, most_freq_count=2)) == 3  # unk + 2
+    assert len(Vocabulary(counter, min_freq=3)) == 4         # unk + a,b,c
+    with pytest.raises(ValueError):
+        Vocabulary(counter, reserved_tokens=["<unk>"])
+    with pytest.raises(ValueError):
+        Vocabulary(counter, reserved_tokens=["x", "x"])
+
+
+def test_vocabulary_count_tokens():
+    c = Vocabulary.count_tokens("the cat sat on the mat".split())
+    assert c["the"] == 2 and c["cat"] == 1
+
+
+def test_token_embedding_from_file(tmp_path):
+    p = tmp_path / "vecs.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = TokenEmbedding.from_file(str(p))
+    assert emb.dim == 3
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("world"), [4, 5, 6])
+    got = emb.get_vecs_by_tokens(["hello", "missing"])
+    np.testing.assert_allclose(got, [[1, 2, 3], [0, 0, 0]])
+
+
+def test_token_embedding_fasttext_header_and_vocab_table(tmp_path):
+    p = tmp_path / "vecs.vec"
+    p.write_text("2 2\nfoo 1.0 -1.0\nbar 0.5 0.25\n")
+    vocab = Vocabulary({"foo": 2, "bar": 1, "baz": 1})
+    emb = TokenEmbedding.from_file(str(p), vocabulary=vocab)
+    table = emb.idx_to_vec
+    assert table.shape == (len(vocab), 2)
+    np.testing.assert_allclose(table[vocab.to_indices("foo")], [1, -1])
+    np.testing.assert_allclose(table[vocab.to_indices("baz")], [0, 0])
+    np.testing.assert_allclose(table[0], [0, 0])  # unk
+
+
+def test_token_embedding_one_dim_file_first_line_not_header(tmp_path):
+    # "a 1.0" has two fields but is NOT a fastText header (fields must
+    # both be ints) — the first vector must not be silently dropped
+    p = tmp_path / "one_d.txt"
+    p.write_text("a 1.0\nb 2.0\n")
+    emb = TokenEmbedding.from_file(str(p))
+    assert emb.dim == 1
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("a"), [1.0])
+
+
+def test_token_embedding_dim_mismatch(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("a 1.0 2.0\nb 1.0\n")
+    with pytest.raises(ValueError):
+        TokenEmbedding.from_file(str(p))
