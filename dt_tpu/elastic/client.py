@@ -176,11 +176,20 @@ class WorkerClient:
             # never split again, so pathological chunk sizes below the
             # itemsize terminate instead of recursing on "#c0" forever
             if value.size > per:
+                from concurrent.futures import ThreadPoolExecutor
                 flat = value.ravel()
-                parts = [
-                    self.allreduce(f"{key}#c{i}",
-                                   flat[start:start + per])
-                    for i, start in enumerate(range(0, flat.size, per))]
+                window = max(1, int(os.environ.get("DT_AR_WINDOW", "4")))
+                # a small in-flight window pipelines the per-chunk rounds
+                # (hides RTT + straggler skew) while keeping scheduler
+                # memory at O(workers x chunk x window); connections are
+                # per-request, so concurrent _req calls are safe
+                with ThreadPoolExecutor(max_workers=window) as pool:
+                    futs = [
+                        pool.submit(self.allreduce, f"{key}#c{i}",
+                                    flat[start:start + per])
+                        for i, start in enumerate(
+                            range(0, flat.size, per))]
+                    parts = [f.result() for f in futs]
                 return np.concatenate(parts).reshape(value.shape)
         seq = self._ar_seq.get(key, 0)
         self._ar_seq[key] = seq + 1

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 
 from dt_tpu.models.common import ConvBN
 from dt_tpu.ops import roi as roi_ops
-from dt_tpu.ops.detection import box_iou, encode_boxes, decode_boxes
+from dt_tpu.ops.detection import (box_iou, encode_boxes, decode_boxes,
+                                  force_match)
 
 
 class FasterRCNNMini(linen.Module):
@@ -134,15 +135,11 @@ def rcnn_loss(out, anchors, gt_boxes, gt_labels,
         best = jnp.max(iou, axis=1)
         arg = jnp.argmax(iou, axis=1)
         pos = best > rpn_pos_iou
-        # force best anchor per valid gt, and assign THAT gt as its loc
-        # target (multibox_target's gt_of_forced correction,
-        # dt_tpu/ops/detection.py): without it a forced anchor regresses
-        # toward its argmax gt, which for zero-IoU rows is padding row 0
-        best_anchor = jnp.argmax(iou, axis=0)
-        idx = jnp.where(valid, best_anchor, n_anchor)
-        force = jnp.zeros(n_anchor, bool).at[idx].set(True, mode="drop")
-        gt_of_forced = jnp.zeros(n_anchor, jnp.int32).at[idx].set(
-            jnp.arange(gtb.shape[0]), mode="drop")
+        # force best anchor per valid gt, assigning THAT gt as its loc
+        # target (shared multibox semantics): without the correction a
+        # forced anchor regresses toward its argmax gt, which for
+        # zero-IoU rows is padding row 0
+        force, gt_of_forced = force_match(iou, valid)
         arg = jnp.where(force, gt_of_forced, arg)
         pos = pos | force
         neg = best < 0.3

@@ -22,9 +22,14 @@ import jax.numpy as jnp
 from dt_tpu.ops import nn as ops
 
 
+def _use_pallas_attn() -> bool:
+    import os
+    return os.environ.get("DT_PALLAS_ATTN", "") == "1"
+
+
 class MultiHeadAttention(linen.Module):
     num_heads: int
-    seq_parallel: Optional[str] = None  # None|'ring'|'ulysses'
+    seq_parallel: Optional[str] = None  # None|'ring'|'ulysses'|'flash'
     mesh: Any = None
     axis_name: str = "data"
     dtype: Any = jnp.float32
@@ -47,6 +52,11 @@ class MultiHeadAttention(linen.Module):
             from dt_tpu.parallel.ulysses import ulysses_attention
             out = ulysses_attention(q, k, v, self.mesh,
                                     axis_name=self.axis_name, causal=True)
+        elif self.seq_parallel == "flash" or (
+                self.seq_parallel is None and _use_pallas_attn()
+                and s % 128 == 0):
+            from dt_tpu.ops.pallas.attention import flash_attention
+            out = flash_attention(q, k, v, causal=True)
         else:
             from dt_tpu.parallel.ring_attention import full_attention
             out = full_attention(q, k, v, causal=True)

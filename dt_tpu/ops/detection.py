@@ -98,6 +98,22 @@ def decode_boxes(anchors: Array, deltas: Array,
                      axis=-1)
 
 
+def force_match(iou: Array, valid: Array):
+    """Best-anchor-per-gt forcing (reference multibox_target semantics):
+    for each VALID gt column of the (N, M) IoU matrix, its argmax anchor
+    is forced positive, with that gt as its assignment.  Padding gts
+    scatter to an out-of-range sentinel and are dropped (they must not
+    clobber anchor 0's assignment).  Returns (force (N,) bool,
+    gt_of_forced (N,) int32)."""
+    n, m = iou.shape
+    best_anchor = jnp.argmax(iou, axis=0)              # (M,)
+    idx = jnp.where(valid, best_anchor, n)
+    force = jnp.zeros(n, bool).at[idx].set(True, mode="drop")
+    gt_of_forced = jnp.zeros(n, jnp.int32) \
+        .at[idx].set(jnp.arange(m), mode="drop")
+    return force, gt_of_forced
+
+
 def multibox_target(anchors: Array, gt_boxes: Array, gt_labels: Array,
                     iou_threshold: float = 0.5,
                     variances=(0.1, 0.1, 0.2, 0.2)):
@@ -113,15 +129,7 @@ def multibox_target(anchors: Array, gt_boxes: Array, gt_labels: Array,
     best_gt = jnp.argmax(iou, axis=1)
     best_iou = jnp.max(iou, axis=1)
     matched = best_iou > iou_threshold
-    # force-match: for each VALID gt, its best anchor; padding gts scatter
-    # to an out-of-range sentinel and are dropped (they must not clobber
-    # anchor 0's assignment)
-    n = anchors.shape[0]
-    best_anchor = jnp.argmax(iou, axis=0)  # (M,)
-    idx = jnp.where(valid, best_anchor, n)
-    force = jnp.zeros(n, bool).at[idx].set(True, mode="drop")
-    gt_of_forced = jnp.zeros(n, jnp.int32) \
-        .at[idx].set(jnp.arange(gt_boxes.shape[0]), mode="drop")
+    force, gt_of_forced = force_match(iou, valid)
     assigned_gt = jnp.where(force, gt_of_forced, best_gt)
     matched = matched | force
     cls_target = jnp.where(matched, gt_labels[assigned_gt] + 1, 0)

@@ -122,12 +122,24 @@ class TokenEmbedding:
                         pass  # a real (token, 1-d vector) line
                 if len(parts) < 2:
                     continue
-                vec = np.asarray([float(v) for v in parts[1:]], np.float32)
+                try:
+                    vec = np.asarray([float(v) for v in parts[1:]],
+                                     np.float32)
+                except ValueError:
+                    # token itself contains spaces (real GloVe files have
+                    # lines like ". . . 0.1 ...") — warn and skip, like
+                    # the reference loader, instead of aborting the file
+                    import warnings
+                    warnings.warn(f"{path}:{lineno + 1}: unparsable "
+                                  "embedding line skipped")
+                    continue
                 if dim is None:
                     dim = len(vec)
                 elif len(vec) != dim:
-                    raise ValueError(
-                        f"{path}:{lineno + 1}: dim {len(vec)} != {dim}")
+                    import warnings
+                    warnings.warn(f"{path}:{lineno + 1}: dim {len(vec)} "
+                                  f"!= {dim}; line skipped")
+                    continue
                 table[parts[0]] = vec
         if dim is None:
             raise ValueError(f"{path}: no vectors found")

@@ -1,0 +1,81 @@
+"""Pallas flash attention vs the full_attention oracle (fwd + bwd).
+
+Interpret mode on the CPU mesh (exact values); TPU numerics are verified
+by drives per CLAUDE.md.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dt_tpu.ops.pallas.attention import flash_attention
+from dt_tpu.parallel.ring_attention import full_attention
+
+
+def _qkv(rng, b=2, s=256, h=2, d=64):
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d).astype(np.float32) * 0.5)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_oracle(causal):
+    rng = np.random.RandomState(0)
+    q, k, v = _qkv(rng)
+    got = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_multiblock_kv_accumulation():
+    # several kv blocks per q block exercises the online-softmax carry
+    rng = np.random.RandomState(1)
+    q, k, v = _qkv(rng, b=1, s=512, h=1, d=64)
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_oracle(causal):
+    rng = np.random.RandomState(2)
+    q, k, v = _qkv(rng, b=1, s=256, h=2, d=32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=128,
+                            block_k=128)
+        return (o * o).sum()
+
+    def loss_ref(q, k, v):
+        o = full_attention(q, k, v, causal=causal)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4,
+            err_msg=f"d{name} causal={causal}")
+
+
+def test_flash_under_jit_bf16():
+    rng = np.random.RandomState(3)
+    q, k, v = _qkv(rng, b=1, s=128, h=1, d=64)
+    q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
+
+    @jax.jit
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    v1, g = jax.value_and_grad(f)(q, k, v)
+    assert np.isfinite(float(v1))
+    assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+def test_flash_rejects_nonmultiple_seq():
+    q = jnp.zeros((1, 100, 1, 64))
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q)

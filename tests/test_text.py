@@ -64,8 +64,13 @@ def test_token_embedding_one_dim_file_first_line_not_header(tmp_path):
     np.testing.assert_allclose(emb.get_vecs_by_tokens("a"), [1.0])
 
 
-def test_token_embedding_dim_mismatch(tmp_path):
+def test_token_embedding_malformed_lines_skipped(tmp_path):
+    # dim mismatches and unparsable tokens-with-spaces (real GloVe files
+    # contain them) warn and skip instead of aborting the whole file
     p = tmp_path / "bad.txt"
-    p.write_text("a 1.0 2.0\nb 1.0\n")
-    with pytest.raises(ValueError):
-        TokenEmbedding.from_file(str(p))
+    p.write_text("a 1.0 2.0\nb 1.0\n. . . 3.0 4.0\nc 5.0 6.0\n")
+    with pytest.warns(UserWarning):
+        emb = TokenEmbedding.from_file(str(p))
+    assert emb.dim == 2
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("c"), [5.0, 6.0])
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("b"), [0.0, 0.0])

@@ -75,6 +75,23 @@ def test_transformer_lm_with_ring_attention_on_mesh():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_transformer_lm_with_flash_attention():
+    """seq_parallel='flash' (the Pallas fused path, interpret mode here)
+    must equal the full-attention model with the same params."""
+    model = models.TransformerLM(vocab_size=40, embed_dim=32, num_layers=1,
+                                 num_heads=4, max_len=128,
+                                 seq_parallel="flash")
+    toks = jnp.asarray(np.random.RandomState(2).randint(0, 40, (2, 128)))
+    v = model.init({"params": jax.random.PRNGKey(0)}, toks, training=False)
+    out = model.apply(v, toks, training=False)
+    model_full = models.TransformerLM(vocab_size=40, embed_dim=32,
+                                      num_layers=1, num_heads=4,
+                                      max_len=128)
+    out_full = model_full.apply(v, toks, training=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_full),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_transformer_lm_trains():
     from dt_tpu import optim
     from dt_tpu.ops import losses
