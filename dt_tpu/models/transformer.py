@@ -53,10 +53,18 @@ class MultiHeadAttention(linen.Module):
             out = ulysses_attention(q, k, v, self.mesh,
                                     axis_name=self.axis_name, causal=True)
         elif self.seq_parallel == "flash" or (
-                self.seq_parallel is None and _use_pallas_attn()
-                and s % 128 == 0):
+                self.seq_parallel is None and _use_pallas_attn()):
             from dt_tpu.ops.pallas.attention import flash_attention
-            out = flash_attention(q, k, v, causal=True)
+            pad = (-s) % 128
+            if pad:
+                # pad queries AND keys at the end to the block size; the
+                # causal mask keeps padded keys (positions > any real
+                # query) out of real rows, and padded rows are sliced off
+                padded = [jnp.pad(t, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                          for t in (q, k, v)]
+                out = flash_attention(*padded, causal=True)[:, :s]
+            else:
+                out = flash_attention(q, k, v, causal=True)
         else:
             from dt_tpu.parallel.ring_attention import full_attention
             out = full_attention(q, k, v, causal=True)
@@ -72,6 +80,8 @@ class DecoderBlock(linen.Module):
     mesh: Any = None
     axis_name: str = "data"
     dropout: float = 0.0
+    moe_experts: int = 0      # >0 replaces the FFN with an MoE block
+    moe_axis: str = "model"   # mesh axis experts shard over (EP)
     dtype: Any = jnp.float32
 
     @linen.compact
@@ -85,9 +95,17 @@ class DecoderBlock(linen.Module):
                             rng=self.make_rng("dropout"))
         x = x + h
         h = linen.LayerNorm(dtype=self.dtype)(x)
-        h = linen.Dense(self.mlp_ratio * d, dtype=self.dtype, name="mlp_in")(h)
-        h = jax.nn.gelu(h)
-        h = linen.Dense(d, dtype=self.dtype, name="mlp_out")(h)
+        if self.moe_experts:
+            from dt_tpu.parallel.moe import MoEMLP
+            h = MoEMLP(num_experts=self.moe_experts,
+                       hidden_ratio=self.mlp_ratio, mesh=self.mesh,
+                       axis=self.moe_axis, dtype=self.dtype,
+                       name="moe")(h)
+        else:
+            h = linen.Dense(self.mlp_ratio * d, dtype=self.dtype,
+                            name="mlp_in")(h)
+            h = jax.nn.gelu(h)
+            h = linen.Dense(d, dtype=self.dtype, name="mlp_out")(h)
         if training and self.dropout > 0:
             h = ops.dropout(h, self.dropout, training=True,
                             rng=self.make_rng("dropout"))
@@ -104,6 +122,8 @@ class TransformerLM(linen.Module):
     mesh: Any = None
     axis_name: str = "data"
     dropout: float = 0.0
+    moe_experts: int = 0
+    moe_axis: str = "model"
     dtype: Any = jnp.float32
 
     @linen.compact
@@ -118,6 +138,7 @@ class TransformerLM(linen.Module):
         for i in range(self.num_layers):
             x = DecoderBlock(self.num_heads, 4, self.seq_parallel, self.mesh,
                              self.axis_name, self.dropout,
+                             self.moe_experts, self.moe_axis,
                              self.dtype, name=f"block{i}")(x, training)
         x = linen.LayerNorm(dtype=self.dtype)(x)
         return linen.Dense(self.vocab_size, use_bias=False,

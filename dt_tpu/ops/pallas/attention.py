@@ -129,7 +129,12 @@ def _flash_fwd_pallas(q3, k3, v3, *, scale, causal, block_q, block_k,
 def _flash_bwd_blockwise(q3, k3, v3, o3, lse, do3, *, scale, causal,
                          block_k):
     """Standard flash backward from the saved logsumexp, scanned over KV
-    blocks: never materializes the (S, S) score matrix."""
+    blocks: never materializes the (S, S) score matrix.
+
+    Unlike the forward kernel, the causal triangle is NOT pruned here —
+    each KV block attends the full Q range with masking (pruning would
+    need q-blocking with dynamic trip counts; the memory win is what
+    this pass is for)."""
     bh, s, d = q3.shape
     sk = k3.shape[1]
     n_k = -(-sk // block_k)

@@ -1,0 +1,114 @@
+"""Mixture-of-experts layer with expert parallelism over the mesh.
+
+The reference caps out at data parallelism + manual model parallelism
+(SURVEY §2.3 parallelism inventory); this framework treats distributed
+execution as first-class, so the sharding family is completed with
+expert parallelism: experts shard over a mesh axis, and the
+dispatch/combine einsums carry GSPMD-inserted all_to_all-style
+collectives over ICI.
+
+Switch-Transformer-style routing (Fedus et al. 2021, public recipe):
+top-1 gating, fixed expert capacity ``C = ceil(T/E * capacity_factor)``,
+overflow tokens dropped (their output is 0 and the residual path carries
+them), auxiliary load-balancing loss ``E * sum_e f_e * P_e``.  Everything
+is fixed-shape one-hot einsum dispatch — no sorting, no dynamic shapes,
+MXU-friendly.
+
+Usage: plain module on one device; for EP give ``mesh`` + ``axis`` and
+the expert dimension of the weights and the dispatched activations is
+sharding-constrained to that axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as linen
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def switch_route(logits: Array, capacity: int):
+    """Top-1 capacity routing.
+
+    ``logits``: (T, E).  Returns (dispatch (T, E, C) bool-ish float,
+    combine (T, E, C) float, aux_loss scalar).  Token t goes to its
+    argmax expert e at slot ``position_in_expert`` if that is < C;
+    ``combine`` carries the gate probability, ``dispatch`` is the 0/1
+    routing mask (identical support)."""
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)                     # (T,)
+    expert = jnp.argmax(probs, axis=-1)                # (T,)
+    onehot = jax.nn.one_hot(expert, e, dtype=logits.dtype)  # (T, E)
+    # position of each token within its expert's queue (arrival order)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # (T, E), -1 if not
+    pos_of_token = jnp.sum(pos * onehot, axis=-1)      # (T,)
+    keep = pos_of_token < capacity
+    slot = jax.nn.one_hot(pos_of_token.astype(jnp.int32), capacity,
+                          dtype=logits.dtype)
+    dispatch = onehot[:, :, None] * slot[:, None, :] \
+        * keep[:, None, None]                          # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    # load-balancing auxiliary (Switch eq. 4): E * sum_e f_e * P_e
+    f = jnp.mean(onehot, axis=0)                       # fraction routed
+    p = jnp.mean(probs, axis=0)                        # mean router prob
+    aux = e * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+class MoEMLP(linen.Module):
+    """Expert-parallel MLP block (drop-in for a dense FFN).
+
+    ``x`` (B, S, D) -> (B, S, D); sows the load-balancing loss under
+    ``("aux_loss", "moe")``.  With ``mesh``/``axis`` set, expert weights
+    and dispatched activations are constrained to shard over that axis.
+    """
+    num_experts: int = 4
+    hidden_ratio: int = 4
+    capacity_factor: float = 1.25
+    mesh: Any = None
+    axis: str = "model"
+    dtype: Any = jnp.float32
+
+    @linen.compact
+    def __call__(self, x: Array) -> Array:
+        b, s, d = x.shape
+        e = self.num_experts
+        h = d * self.hidden_ratio
+        tokens = x.reshape(b * s, d)
+        t = tokens.shape[0]
+        capacity = max(1, int(-(-t // e) * self.capacity_factor))
+
+        logits = linen.Dense(e, use_bias=False, dtype=jnp.float32,
+                             name="router")(tokens.astype(jnp.float32))
+        dispatch, combine, aux = switch_route(logits, capacity)
+        self.sow("aux_loss", "moe", aux)
+
+        wi = self.param("wi", linen.initializers.lecun_normal(),
+                        (e, d, h), jnp.float32).astype(self.dtype)
+        wo = self.param("wo", linen.initializers.lecun_normal(),
+                        (e, h, d), jnp.float32).astype(self.dtype)
+
+        def ep(arr, spec):
+            if self.mesh is None:
+                return arr
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.lax.with_sharding_constraint(
+                arr, NamedSharding(self.mesh, P(*spec)))
+
+        wi = ep(wi, (self.axis, None, None))
+        wo = ep(wo, (self.axis, None, None))
+        # dispatch: (T, E, C) x (T, D) -> (E, C, D); under EP the E axis
+        # is sharded, so GSPMD turns this into the all_to_all scatter
+        xin = jnp.einsum("tec,td->ecd", dispatch.astype(self.dtype),
+                         tokens.astype(self.dtype))
+        xin = ep(xin, (self.axis, None, None))
+        hmid = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin, wi))
+        hmid = ep(hmid, (self.axis, None, None))
+        xout = jnp.einsum("ech,ehd->ecd", hmid, wo)
+        xout = ep(xout, (self.axis, None, None))
+        out = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), xout)
+        return out.reshape(b, s, d)

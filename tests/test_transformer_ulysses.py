@@ -92,6 +92,23 @@ def test_transformer_lm_with_flash_attention():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_transformer_lm_flash_nonmultiple_seq_pads():
+    """seq lengths that aren't block multiples pad-and-slice instead of
+    crashing, and still match full attention."""
+    model = models.TransformerLM(vocab_size=40, embed_dim=32, num_layers=1,
+                                 num_heads=4, max_len=100,
+                                 seq_parallel="flash")
+    toks = jnp.asarray(np.random.RandomState(3).randint(0, 40, (1, 100)))
+    v = model.init({"params": jax.random.PRNGKey(0)}, toks, training=False)
+    out = model.apply(v, toks, training=False)
+    model_full = models.TransformerLM(vocab_size=40, embed_dim=32,
+                                      num_layers=1, num_heads=4,
+                                      max_len=100)
+    out_full = model_full.apply(v, toks, training=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_full),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_transformer_lm_trains():
     from dt_tpu import optim
     from dt_tpu.ops import losses
