@@ -101,25 +101,38 @@ def guarded_main():
         return 0
 
     # measurement, with one retry on fast failure (a retry after a timeout
-    # would run against the tunnel our own kill just wedged — skip those)
+    # would run against the tunnel our own kill just wedged — skip those).
+    # The child runs tiers smallest-first and persists each completed
+    # tier's JSON to DT_BENCH_RESULT_FILE, so even a budget kill mid-152
+    # leaves real evidence to report instead of a zero.
+    result_file = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_result.json")
+    try:
+        os.unlink(result_file)
+    except OSError:
+        pass  # absent, or stale-but-undeletable (atomic overwrite wins)
+    os.environ["DT_BENCH_RESULT_FILE"] = result_file
     for attempt in (1, 2):
         remaining = deadline - time.monotonic()
         if remaining <= 30:
-            _emit_failure(f"budget exhausted before measurement; {last_err}")
-            return 0
+            break
         rc, out = _run_child("--run", remaining)
-        line = next((ln for ln in out.strip().splitlines()
-                     if ln.startswith("{")), None)
-        if rc == 0 and line:
-            print(line)
-            return 0
+        if rc == 0:
+            break
         last_err = (f"measurement attempt {attempt}: "
                     + ("timed out" if rc is None
                        else f"rc={rc}: {out.strip()[-300:]}"))
         print(f"# {last_err}", file=sys.stderr)
         if rc is None:
             break
-    _emit_failure(last_err)
+    try:
+        with open(result_file) as f:
+            line = f.read().strip().splitlines()[-1]
+        print(line)
+        return 0
+    except (OSError, IndexError):
+        pass
+    _emit_failure(f"no tier completed; last: {last_err}")
     return 0
 
 
@@ -142,6 +155,42 @@ def main():
     from dt_tpu.config import maybe_force_cpu, enable_compilation_cache
     maybe_force_cpu()  # DT_FORCE_CPU=1 only; default backend otherwise
     enable_compilation_cache()
+
+    # overridables exist so the measurement path can be smoke-tested on
+    # CPU; the driver runs the default TIERS: a fast ResNet-18 point
+    # first (real evidence within minutes), then the BASELINE row
+    # (ResNet-152, batch 32).  Each completed tier atomically overwrites
+    # DT_BENCH_RESULT_FILE, so a budget kill mid-152 still reports the
+    # completed tier instead of a zero.
+    batch = int(os.environ.get("DT_BENCH_BATCH", "32"))
+    size = int(os.environ.get("DT_BENCH_IMAGE", "224"))
+    tiers = ([os.environ["DT_BENCH_MODEL"]]
+             if os.environ.get("DT_BENCH_MODEL")
+             else ["resnet18", "resnet152"])
+    line = None
+    for net in tiers:
+        result = measure_tier(net, batch, size)
+        line = json.dumps(result)
+        path = os.environ.get("DT_BENCH_RESULT_FILE")
+        if path:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(line + "\n")
+            os.replace(tmp, path)
+        print(f"# tier {net} done: {line}", file=sys.stderr, flush=True)
+    print(line)
+
+
+# per-img fwd GFLOP at 224x224 (train step ~ 3x fwd); baselines from the
+# reference's published single-GPU table where a row exists
+_TIER_INFO = {
+    "resnet152": (11.56e9, BASELINE_IMGS_PER_SEC),
+    "resnet50": (4.1e9, None),
+    "resnet18": (1.8e9, None),
+}
+
+
+def measure_tier(net, batch, size):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -149,11 +198,6 @@ def main():
     from dt_tpu.ops import losses
     from dt_tpu.training.train_state import TrainState
 
-    # overridables exist so the measurement path can be smoke-tested on CPU;
-    # the driver runs the defaults (ResNet-152, batch 32 — the BASELINE row)
-    batch = int(os.environ.get("DT_BENCH_BATCH", "32"))
-    net = os.environ.get("DT_BENCH_MODEL", "resnet152")
-    size = int(os.environ.get("DT_BENCH_IMAGE", "224"))
     def phase(msg):
         print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
               flush=True)
@@ -207,20 +251,22 @@ def main():
 
     imgs_per_sec = batch * iters / dt
     step_ms = dt / iters * 1e3
-    # MFU estimate: ResNet-152 fwd ≈ 11.56 GFLOP/img @224 (2x for bwd+fwd
-    # ≈ 3x fwd total); chip peak read from the device if exposed.
-    flops_per_img = 3 * 11.56e9
-    print(json.dumps({
-        "metric": "resnet152_train_imgs_per_sec_per_chip",
+    fwd_flops, baseline = _TIER_INFO.get(net, (0.0, None))
+    flops_per_img = 3 * fwd_flops
+    return {
+        "metric": f"{net}_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
-        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),
+        # vs_baseline compares like-for-like only: the reference's table
+        # has a ResNet-152/b32 row (20.08); other tiers report 0.0
+        "vs_baseline": round(imgs_per_sec / baseline, 2) if baseline
+        else 0.0,
         "step_ms": round(step_ms, 2),
         "compile_s": round(t_compile, 1),
         "model_tflops_per_sec": round(imgs_per_sec * flops_per_img / 1e12,
                                       2),
         "backend": jax.default_backend(),
-    }))
+    }
 
 
 if __name__ == "__main__":

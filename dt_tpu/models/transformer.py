@@ -54,8 +54,9 @@ class MultiHeadAttention(linen.Module):
                                     axis_name=self.axis_name, causal=True)
         elif self.seq_parallel == "flash" or (
                 self.seq_parallel is None and _use_pallas_attn()):
-            from dt_tpu.ops.pallas.attention import flash_attention
-            pad = (-s) % 128
+            from dt_tpu.ops.pallas.attention import (flash_attention,
+                                                     DEFAULT_BLOCK)
+            pad = (-s) % DEFAULT_BLOCK
             if pad:
                 # pad queries AND keys at the end to the block size; the
                 # causal mask keeps padded keys (positions > any real

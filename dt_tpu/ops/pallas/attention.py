@@ -34,6 +34,7 @@ from jax.experimental.pallas import tpu as pltpu
 from dt_tpu.ops.pallas.kernels import _default_interpret
 
 NEG_INF = -1e30
+DEFAULT_BLOCK = 128  # callers that pad (TransformerLM) key off this
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -205,7 +206,8 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = DEFAULT_BLOCK,
+                    block_k: int = DEFAULT_BLOCK,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused attention, (B, S, H, D) layout (``full_attention`` oracle).
 

@@ -69,6 +69,7 @@ class MoEMLP(linen.Module):
     num_experts: int = 4
     hidden_ratio: int = 4
     capacity_factor: float = 1.25
+    aux_weight: float = 0.01   # Switch paper's alpha; sown PRE-weighted
     mesh: Any = None
     axis: str = "model"
     dtype: Any = jnp.float32
@@ -85,7 +86,9 @@ class MoEMLP(linen.Module):
         logits = linen.Dense(e, use_bias=False, dtype=jnp.float32,
                              name="router")(tokens.astype(jnp.float32))
         dispatch, combine, aux = switch_route(logits, capacity)
-        self.sow("aux_loss", "moe", aux)
+        # pre-weighted so generic training loops (Module.fit) can add the
+        # whole ``aux_loss`` collection to the objective unscaled
+        self.sow("aux_loss", "moe", self.aux_weight * aux)
 
         wi = self.param("wi", linen.initializers.lecun_normal(),
                         (e, d, h), jnp.float32).astype(self.dtype)

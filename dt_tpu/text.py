@@ -111,7 +111,7 @@ class TokenEmbedding:
         skipped, like the reference's fastText handling)."""
         table: Dict[Hashable, np.ndarray] = {}
         dim = None
-        skipped = 0
+        skipped_dim = 0
         with open(path, encoding=encoding) as f:
             for lineno, line in enumerate(f):
                 parts = line.rstrip().split(" ")
@@ -133,7 +133,6 @@ class TokenEmbedding:
                     import warnings
                     warnings.warn(f"{path}:{lineno + 1}: unparsable "
                                   "embedding line skipped")
-                    skipped += 1
                     continue
                 if dim is None:
                     dim = len(vec)
@@ -141,16 +140,18 @@ class TokenEmbedding:
                     import warnings
                     warnings.warn(f"{path}:{lineno + 1}: dim {len(vec)} "
                                   f"!= {dim}; line skipped")
-                    skipped += 1
+                    skipped_dim += 1
                     continue
                 table[parts[0]] = vec
-        if skipped > len(table):
-            # a truncated/garbled first line can lock `dim` to the wrong
-            # value and shed every real vector; majority-skip means the
-            # file, not the odd line, is the problem — fail loudly
+        if skipped_dim > len(table):
+            # a truncated/garbled FIRST line locks `dim` to the wrong
+            # value and sheds every real vector as "dim mismatch"; when
+            # those outnumber the keeps the file (not the odd line) is
+            # the problem — fail loudly.  Unparsable-token skips (GloVe
+            # multi-space tokens) are normal and don't count.
             raise ValueError(
-                f"{path}: skipped {skipped} lines but kept only "
-                f"{len(table)} — wrong dim lock or corrupt file?")
+                f"{path}: {skipped_dim} dim-mismatch lines vs "
+                f"{len(table)} kept — wrong dim lock or corrupt file?")
         if dim is None:
             raise ValueError(f"{path}: no vectors found")
         return cls(table, dim, vocabulary, init_unknown_vec)

@@ -182,20 +182,26 @@ class Module:
         replicated = mesh_lib.replicate_sharding(mesh)
 
         def forward_loss(params, batch_stats, data, labels, dropout_rng):
-            """Shared by the mesh train step and the host-sync grad step."""
+            """Shared by the mesh train step and the host-sync grad step.
+
+            Layers may sow pre-weighted regularizers into the
+            ``aux_loss`` collection (e.g. the MoE load-balancing term,
+            ``parallel/moe.py``); they are added to the objective here —
+            without the collection in ``mutable`` flax drops sows
+            silently."""
             variables = {"params": params}
+            mutable = ["aux_loss"]
             if batch_stats:
                 variables["batch_stats"] = batch_stats
-                out, mutated = model.apply(
-                    variables, data, training=True,
-                    rngs={"dropout": dropout_rng}, mutable=["batch_stats"])
-                new_stats = mutated["batch_stats"]
-            else:
-                out = model.apply(variables, data, training=True,
-                                  rngs={"dropout": dropout_rng})
-                new_stats = batch_stats
+                mutable.append("batch_stats")
+            out, mutated = model.apply(
+                variables, data, training=True,
+                rngs={"dropout": dropout_rng}, mutable=mutable)
+            new_stats = mutated.get("batch_stats", batch_stats)
+            aux = sum(jax.tree_util.tree_leaves(
+                mutated.get("aux_loss", {})), 0.0)
             logits = out[0] if isinstance(out, tuple) else out
-            return loss_fn(logits, labels), (logits, new_stats)
+            return loss_fn(logits, labels) + aux, (logits, new_stats)
 
         if self.remat:
             forward_loss = jax.checkpoint(forward_loss,

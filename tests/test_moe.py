@@ -87,6 +87,37 @@ def test_moe_expert_parallel_matches_unsharded():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_moe_aux_loss_flows_through_module_fit():
+    """Module.fit must fold sown aux losses into the objective — flax
+    silently drops sows when the collection isn't mutable, which would
+    train MoE routers with zero balancing pressure."""
+    from dt_tpu import models, data
+    from dt_tpu.training import Module
+
+    model = models.TransformerLM(vocab_size=16, embed_dim=16, num_layers=1,
+                                 num_heads=2, max_len=8, moe_experts=2)
+    rng = np.random.RandomState(3)
+    toks = rng.randint(1, 16, (8, 8)).astype(np.int32)
+
+    from dt_tpu.ops import losses as L
+
+    def seq_ce(logits, labels):
+        return L.softmax_cross_entropy(logits.reshape(-1, 16),
+                                       labels.reshape(-1))
+
+    mod = Module(model, loss_fn=seq_ce, optimizer="adam",
+                 optimizer_params={"learning_rate": 1e-2}, seed=0)
+    mod.init_params(jnp.asarray(toks))
+    before = np.array(
+        mod.state.params["block0"]["moe"]["router"]["kernel"])
+    train = data.NDArrayIter(toks, toks, batch_size=8)
+    mod.fit(train, num_epoch=1)
+    after = np.asarray(
+        mod.state.params["block0"]["moe"]["router"]["kernel"])
+    assert not np.allclose(before, after), \
+        "router got no gradient — aux collection dropped?"
+
+
 def test_moe_trains_with_aux_loss():
     import optax
     rng = np.random.RandomState(2)
@@ -102,7 +133,8 @@ def test_moe_trains_with_aux_loss():
     def step(params, opt):
         def loss_of(p):
             out, st = layer.apply({"params": p}, x, mutable=["aux_loss"])
-            return ((out - y) ** 2).mean() + 0.01 * st["aux_loss"]["moe"][0]
+            # sown value is pre-weighted (aux_weight)
+            return ((out - y) ** 2).mean() + st["aux_loss"]["moe"][0]
         l, g = jax.value_and_grad(loss_of)(params)
         up, opt2 = tx.update(g, opt, params)
         return optax.apply_updates(params, up), opt2, l
