@@ -189,6 +189,20 @@ _TIER_INFO = {
     "resnet18": (1.8e9, None),
 }
 
+# published peak bf16 TFLOP/s per chip, keyed by device_kind substring —
+# used for the MFU estimate (VERDICT round-1 item 2)
+_PEAK_TFLOPS = (("v6e", 918.0), ("v6", 918.0), ("v5p", 459.0),
+                ("v5e", 197.0), ("v5lite", 197.0), ("v4", 275.0),
+                ("v3", 123.0), ("v2", 45.0))
+
+
+def _peak_tflops(device_kind: str):
+    kind = device_kind.lower().replace(" ", "")
+    for key, peak in _PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
 
 def measure_tier(net, batch, size):
     import jax
@@ -253,6 +267,9 @@ def measure_tier(net, batch, size):
     step_ms = dt / iters * 1e3
     fwd_flops, baseline = _TIER_INFO.get(net, (0.0, None))
     flops_per_img = 3 * fwd_flops
+    model_tflops = imgs_per_sec * flops_per_img / 1e12
+    kind = jax.devices()[0].device_kind
+    peak = _peak_tflops(kind)
     return {
         "metric": f"{net}_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),
@@ -263,8 +280,12 @@ def measure_tier(net, batch, size):
         else 0.0,
         "step_ms": round(step_ms, 2),
         "compile_s": round(t_compile, 1),
-        "model_tflops_per_sec": round(imgs_per_sec * flops_per_img / 1e12,
-                                      2),
+        "model_tflops_per_sec": round(model_tflops, 2),
+        "device_kind": kind,
+        # MFU from the model's algorithmic FLOPs (conv FLOPs only, so the
+        # true utilization is slightly higher) vs the chip's published
+        # bf16 peak; null when the device kind isn't in the table
+        "mfu": round(model_tflops / peak, 3) if peak else None,
         "backend": jax.default_backend(),
     }
 

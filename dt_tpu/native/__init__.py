@@ -6,6 +6,8 @@ engine/storage layers; the pieces that remain host-side hot paths are
 implemented here in C++ with ctypes bindings (no pybind11 in the image):
 
 - ``recordio.cc`` — RecordIO index scan + batched payload reads.
+- ``imdecode.cc`` — libjpeg JPEG decode (the reference's turbo-jpeg loop,
+  ``src/io/iter_image_recordio_2.cc:75``), GIL-free so decode threads scale.
 
 ``lib()`` compiles on first use (g++ -O2 -shared) and caches the .so next to
 the sources; every native entry point has a pure-Python fallback, so the
@@ -15,6 +17,8 @@ framework works without a toolchain.
 from dt_tpu.native.binding import (
     available as available,
     BadRecordFile as BadRecordFile,
+    img_lib as img_lib,
+    jpeg_decode as jpeg_decode,
     native_index as native_index,
     native_read_batch as native_read_batch,
 )

@@ -7,7 +7,7 @@ import logging
 import os
 import subprocess
 import threading
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -26,22 +26,24 @@ _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
 
-def _build() -> Optional[str]:
-    """Compile the shared library if sources are newer than the cached .so."""
+def _compile_and_load(so: str, srcs: List[str],
+                      ldflags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
+    """Compile ``srcs`` into ``so`` (if stale) and dlopen it; None on any
+    toolchain/load failure (callers fall back to Python paths)."""
     try:
-        if os.path.exists(_SO) and all(
-                os.path.getmtime(_SO) >= os.path.getmtime(s) for s in _SRC):
-            return _SO
-        # unique temp output: concurrent processes may race to build; each
-        # writes its own file and os.replace is atomic
-        tmp = os.path.join(_HERE, f"libdtnative.{os.getpid()}.so.tmp")
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               "-o", tmp] + _SRC
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, _SO)
-        return _SO
+        if not (os.path.exists(so) and all(
+                os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs)):
+            # unique temp output: concurrent processes may race to build;
+            # each writes its own file and os.replace is atomic
+            tmp = f"{so}.{os.getpid()}.tmp"
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   "-o", tmp] + srcs + list(ldflags)
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, so)
+        return ctypes.CDLL(so)
     except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
-        logger.warning("native build unavailable (%s); using Python paths", e)
+        logger.warning("native build of %s unavailable (%s); using Python "
+                       "paths", os.path.basename(so), e)
         return None
 
 
@@ -50,14 +52,8 @@ def lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        so = _build()
-        if so is None:
-            _build_failed = True
-            return None
-        try:
-            L = ctypes.CDLL(so)
-        except OSError as e:  # stale/corrupt .so: disable, don't break reads
-            logger.warning("cannot load %s (%s); using Python paths", so, e)
+        L = _compile_and_load(_SO, _SRC)
+        if L is None:
             _build_failed = True
             return None
         L.dtrec_index.restype = ctypes.c_longlong
@@ -76,6 +72,78 @@ def lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# JPEG decode (libjpeg) — built as its OWN .so so a host without libjpeg
+# headers keeps the recordio native path (reference ships turbo-jpeg as a
+# hard dep of iter_image_recordio_2.cc; here it degrades to PIL)
+# ---------------------------------------------------------------------------
+
+_IMG_SO = os.path.join(_HERE, "libdtimg.so")
+_IMG_SRC = [os.path.join(_HERE, "imdecode.cc")]
+_img_lock = threading.Lock()
+_img_lib: Optional[ctypes.CDLL] = None
+_img_failed = False
+
+
+def img_lib() -> Optional[ctypes.CDLL]:
+    global _img_lib, _img_failed
+    with _img_lock:
+        if _img_lib is not None or _img_failed:
+            return _img_lib
+        L = _compile_and_load(_IMG_SO, _IMG_SRC, ["-ljpeg"])
+        if L is None:
+            _img_failed = True
+            return None
+        L.dtimg_info.restype = ctypes.c_int
+        L.dtimg_info.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_ulong,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        L.dtimg_decode.restype = ctypes.c_int
+        L.dtimg_decode.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_ulong,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_ulong,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        _img_lib = L
+        return _img_lib
+
+
+_tls = threading.local()
+
+
+def jpeg_decode(payload: bytes) -> Optional[np.ndarray]:
+    """Decode a JPEG to an (H, W, 3) uint8 RGB array via the native
+    library; None when the native path is unavailable or the buffer is
+    not a decodable JPEG (caller falls back to PIL).
+
+    Hot path is ONE native call per image: decode into a growable
+    thread-local scratch buffer; on -2 (too small) the reported dims size
+    the retry, and the buffer persists for subsequent images."""
+    L = img_lib()
+    if L is None:
+        return None
+    src = (ctypes.c_ubyte * len(payload)).from_buffer_copy(payload)
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    buf = getattr(_tls, "decode_buf", None)
+    if buf is None:
+        buf = _tls.decode_buf = np.empty(1 << 21, np.uint8)  # 2 MB start
+
+    def call():
+        return L.dtimg_decode(
+            src, len(payload),
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            buf.nbytes, ctypes.byref(w), ctypes.byref(h))
+
+    rc = call()
+    if rc == -2:
+        buf = _tls.decode_buf = np.empty(w.value * h.value * 3, np.uint8)
+        rc = call()
+    if rc != 0:
+        return None
+    n = w.value * h.value * 3
+    return buf[:n].reshape(h.value, w.value, 3).copy()
 
 
 def native_index(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:

@@ -89,7 +89,12 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finish():
         l = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(l))[:, 0]
+        # lse is per-row but Mosaic requires the last two block dims to
+        # tile (8, 128) on real TPU (a (1, block_q) block does not), so
+        # the output carries a 128-lane axis with the value broadcast;
+        # the wrapper slices lane 0 (round-2 TPU-drive finding)
+        lse_ref[0] = jnp.broadcast_to(m_ref[:] + jnp.log(l),
+                                      (lse_ref.shape[1], 128))
 
 
 def _flash_fwd_pallas(q3, k3, v3, *, scale, causal, block_q, block_k,
@@ -102,7 +107,7 @@ def _flash_fwd_pallas(q3, k3, v3, *, scale, causal, block_q, block_k,
     kern = functools.partial(
         _attn_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, n_k=n_k)
-    return pl.pallas_call(
+    out, lse_lanes = pl.pallas_call(
         kern,
         grid=(bh, n_q, n_k),
         in_specs=[
@@ -112,11 +117,11 @@ def _flash_fwd_pallas(q3, k3, v3, *, scale, causal, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((1, block_q, 128), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -125,6 +130,7 @@ def _flash_fwd_pallas(q3, k3, v3, *, scale, causal, block_q, block_k,
         ],
         interpret=interpret,
     )(q3, k3, v3)
+    return out, lse_lanes[:, :, 0]
 
 
 def _flash_bwd_blockwise(q3, k3, v3, o3, lse, do3, *, scale, causal,

@@ -109,9 +109,14 @@ def _quant2_kernel(x_ref, packed_ref, resid_ref, *, threshold: float):
     decoded = jnp.where(codes == 1, threshold,
                         jnp.where(codes == 2, -threshold, 0.0))
     resid_ref[:] = x - decoded.astype(x.dtype)
-    shifts = jax.lax.broadcasted_iota(jnp.uint32, codes.shape, 1) * 2
-    packed_ref[:] = jnp.sum(codes << shifts, axis=1, dtype=jnp.uint32,
-                            keepdims=True)
+    # pack via an int32 sum: Mosaic has no unsigned reductions on real TPU
+    # (interpret mode accepted uint32 — round-2 drive finding).  The 2-bit
+    # fields are disjoint, so wrapping int32 addition is carry-free and
+    # bit-identical to the uint32 sum; bitcast restores the wire dtype.
+    shifts = jax.lax.broadcasted_iota(jnp.int32, codes.shape, 1) * 2
+    packed_i32 = jnp.sum(codes.astype(jnp.int32) << shifts, axis=1,
+                         dtype=jnp.int32, keepdims=True)
+    packed_ref[:] = jax.lax.bitcast_convert_type(packed_i32, jnp.uint32)
 
 
 def quantize_2bit(grad: jax.Array, residual: jax.Array,

@@ -35,7 +35,10 @@ def make_dataset(n=256, seed=1234):
     accuracy delta between runs is trajectory damage, not sample noise —
     that is what lets the elastic-vs-static gate be tight."""
     rng = np.random.RandomState(seed)  # same on every worker
-    margin = 0.55 / np.sqrt(8 * 8 * 3)  # 0.55 sigma of the mean (~58% kept)
+    # 0.7 sigma of the mean (~48% kept): wide enough that trained runs
+    # reliably reach the 100% ceiling, which is what lets the elastic-vs-
+    # static gate sit at the BASELINE 0.2% without ceiling-miss noise
+    margin = 0.7 / np.sqrt(8 * 8 * 3)
     xs = []
     while sum(len(a) for a in xs) < n:
         cand = rng.normal(0, 1, (2 * n, 8, 8, 3)).astype(np.float32)
@@ -46,7 +49,9 @@ def make_dataset(n=256, seed=1234):
     return x, y
 
 
-def make_val_dataset(n=512):
+def make_val_dataset(n=2048):
+    # 2048 samples -> one-sample accuracy quantum of ~0.049%, small enough
+    # to resolve the BASELINE 0.2% convergence gate
     return make_dataset(n, seed=777)  # held-out: disjoint draw
 
 
@@ -125,7 +130,7 @@ def main():
     acc_curve = []
 
     def record_val(epoch, state, metric):
-        acc = dict(mod.score(data.NDArrayIter(vx, vy, batch_size=32),
+        acc = dict(mod.score(data.NDArrayIter(vx, vy, batch_size=256),
                              "acc"))
         acc_curve.append((epoch, float(acc["accuracy"])))
 
@@ -136,7 +141,7 @@ def main():
     flat, _ = jax.flatten_util.ravel_pytree(
         (mod.state.params, mod.state.batch_stats))  # BN stats must sync too
     acc = dict(mod.score(data.NDArrayIter(x, y, batch_size=32), "acc"))
-    val_acc = dict(mod.score(data.NDArrayIter(vx, vy, batch_size=32),
+    val_acc = dict(mod.score(data.NDArrayIter(vx, vy, batch_size=256),
                              "acc"))
     result = {
         "host": args.host,

@@ -169,19 +169,20 @@ def test_elastic_accuracy_matches_static(tmp_path):
     assert static["final_acc"] > 0.8, static  # learnable at all
 
     # both runs reach the margin task's ceiling region
-    assert static["final_val_acc"] >= 0.97, static["final_val_acc"]
+    assert static["final_val_acc"] >= 0.99, static["final_val_acc"]
 
-    # final held-out accuracy within 1% (8x tighter than the round-1 gate;
-    # one val-sample quantum is 1/512 ~ 0.2% — the BASELINE granularity)
+    # final held-out accuracy within 0.2% — the BASELINE north-star gate
+    # (reference convergence bar, example/image-classification/README.md:
+    # 325-329), resolvable here because the val quantum is 1/2048 ~ 0.05%
     assert abs(elastic["final_val_acc"] - static["final_val_acc"]) \
-        <= 0.01 + 1e-9, (static["final_val_acc"], elastic["final_val_acc"])
+        <= 0.002 + 1e-9, (static["final_val_acc"], elastic["final_val_acc"])
 
     # post-change validation curve tracks the static run: after the
     # remove (epoch 7) both runs are 2-worker again; each tail epoch's
-    # val acc must stay within 1.5% and the tail mean within 1%
+    # val acc must stay within 0.5% and the tail mean within 0.2%
     sc = dict(static["acc_curve"])
     ec = dict(elastic["acc_curve"])
     tail = range(num_epoch - 3, num_epoch)
     deltas = [abs(ec[e] - sc[e]) for e in tail]
-    assert max(deltas) <= 0.015 + 1e-9, (deltas, sc, ec)
-    assert sum(deltas) / len(deltas) <= 0.01 + 1e-9, (deltas, sc, ec)
+    assert max(deltas) <= 0.005 + 1e-9, (deltas, sc, ec)
+    assert sum(deltas) / len(deltas) <= 0.002 + 1e-9, (deltas, sc, ec)

@@ -74,3 +74,36 @@ def test_native_bad_file(tmp_path, built):
 def test_native_missing_file(built):
     with pytest.raises(IOError, match="cannot open"):
         native.native_index("/nonexistent/x.rec")
+
+
+def test_native_jpeg_decode_matches_pil():
+    """libjpeg decode parity with PIL on a synthetic JPEG: same dims; RGB
+    values may differ by IDCT rounding, so gate the mean abs delta."""
+    import io
+
+    from PIL import Image
+
+    if native.img_lib() is None:
+        pytest.skip("libjpeg toolchain unavailable")
+    rng = np.random.RandomState(0)
+    # smooth gradient compresses well and decodes near-identically
+    base = np.linspace(0, 255, 64, dtype=np.float32)
+    arr = (base[:, None, None] * np.ones((64, 48, 3), np.float32) / 1.0) \
+        .astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    payload = buf.getvalue()
+
+    nat = native.jpeg_decode(payload)
+    ref = np.asarray(Image.open(io.BytesIO(payload)).convert("RGB"),
+                     np.uint8)
+    assert nat is not None
+    assert nat.shape == ref.shape == (64, 48, 3)
+    assert np.mean(np.abs(nat.astype(np.int32) - ref.astype(np.int32))) \
+        < 1.5
+
+
+def test_native_jpeg_decode_rejects_garbage():
+    if native.img_lib() is None:
+        pytest.skip("libjpeg toolchain unavailable")
+    assert native.jpeg_decode(b"\x00" * 64) is None

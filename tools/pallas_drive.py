@@ -59,7 +59,15 @@ def main():
 
     backend = jax.default_backend()
     rng = np.random.RandomState(0)
-    records = []
+
+    def emit(rec):
+        # print per-kernel, flushed: a crash in a later kernel must not
+        # lose earlier evidence (round-2 lesson: the uint32-reduction crash
+        # in quantize_2bit ate the LSTM/BN records)
+        rec["backend"] = backend
+        rec["speedup"] = round(rec["oracle_ms"] / rec["pallas_ms"], 3) \
+            if rec["pallas_ms"] else None
+        print(json.dumps(rec), flush=True)
 
     # ---- LSTM: full sequence fwd+bwd, oracle cell vs fused cell ---------
     T, B, I, H = (8, 8, 32, 32) if args.small else (64, 64, 512, 512)
@@ -79,7 +87,7 @@ def main():
         return jax.jit(jax.value_and_grad(loss))  # jit ONCE; _timeit warms
 
     oracle_lstm, pallas_lstm = make_step(False), make_step(True)
-    records.append({
+    emit({
         "kernel": "lstm_seq_fwd_bwd",
         "shape": f"T{T}xB{B}xI{I}xH{H} {dt.__name__}",
         "parity_max_abs_err": _err(oracle_lstm(w), pallas_lstm(w)),
@@ -99,7 +107,7 @@ def main():
         x, gamma, beta, mean, var, training=False)[0])
     pallas_bn = jax.jit(lambda x: kernels.fused_bn_inference(
         x, gamma, beta, mean, var))
-    records.append({
+    emit({
         "kernel": "fused_bn_inference",
         "shape": f"{N}x{HW}x{HW}x{C} {dt.__name__}",
         "parity_max_abs_err": _err(oracle_bn(xb), pallas_bn(xb)),
@@ -114,7 +122,7 @@ def main():
 
     oracle_q = jax.jit(lambda g, r: compression.quantize_2bit(g, r, 0.5))
     pallas_q = jax.jit(lambda g, r: kernels.quantize_2bit(g, r, 0.5))
-    records.append({
+    emit({
         "kernel": "quantize_2bit",
         "shape": f"{n} f32",
         "parity_max_abs_err": _err(oracle_q(g, r), pallas_q(g, r)),
@@ -122,11 +130,29 @@ def main():
         "pallas_ms": round(_timeit(pallas_q, g, r, iters=args.iters), 3),
     })
 
-    for rec in records:
-        rec["backend"] = backend
-        rec["speedup"] = round(rec["oracle_ms"] / rec["pallas_ms"], 3) \
-            if rec["pallas_ms"] else None
-        print(json.dumps(rec))
+    # ---- flash attention fwd+bwd vs full-attention oracle ---------------
+    from dt_tpu.ops.pallas import attention as attn
+    from dt_tpu.parallel.ring_attention import full_attention
+    B, S, H, D = (1, 256, 2, 64) if args.small else (4, 2048, 8, 128)
+    qkv = [jnp.asarray(rng.randn(B, S, H, D) * 0.3, dt) for _ in range(3)]
+
+    def attn_loss(f):
+        def loss(q, k, v):
+            return jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+        return jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+
+    oracle_fa = attn_loss(lambda q, k, v: full_attention(
+        q, k, v, causal=True))
+    pallas_fa = attn_loss(lambda q, k, v: attn.flash_attention(
+        q, k, v, causal=True))
+    emit({
+        "kernel": "flash_attention_fwd_bwd",
+        "shape": f"B{B}xS{S}xH{H}xD{D} {dt.__name__}",
+        "parity_max_abs_err": _err(oracle_fa(*qkv), pallas_fa(*qkv)),
+        "oracle_ms": round(_timeit(oracle_fa, *qkv, iters=args.iters), 3),
+        "pallas_ms": round(_timeit(pallas_fa, *qkv, iters=args.iters), 3),
+    })
+
 
 
 if __name__ == "__main__":
