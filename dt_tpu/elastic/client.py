@@ -237,6 +237,35 @@ class WorkerClient:
         vals[:n] = out["vals"][:n]
         return RowSparse(jnp.asarray(ids), jnp.asarray(vals), rs.num_rows)
 
+    # -- dist_async data plane --------------------------------------------
+
+    def set_optimizer(self, spec: Dict) -> None:
+        """Install the scheduler-side updater for ``dist_async`` pushes
+        (the reference's optimizer-to-servers hand-off,
+        ``python/mxnet/kvstore.py:451-498``).  ``spec`` is
+        ``{"name": "sgd"|"adagrad"|"adam", **scalar hyperparams}``."""
+        self._req({"cmd": "set_optimizer", "spec": spec})
+
+    def async_init(self, key: str, value) -> np.ndarray:
+        """Init-or-get the master weights: the first writer seeds them,
+        everyone receives the live server copy (joiners adopt trained
+        state, ``module.py:552-571``)."""
+        return np.asarray(self._req({"cmd": "async_init", "key": key,
+                                     "value": np.asarray(value)})["value"])
+
+    def async_push(self, key: str, grad) -> np.ndarray:
+        """Push a gradient, get back the post-update master weights —
+        one round trip, applied immediately, no cross-worker barrier
+        (``kvstore_dist_server.h:347`` ``!sync_mode_``).  Retries are
+        dedup'd by (host, key, seq) so a momentum update is never applied
+        twice."""
+        seq = self._ar_seq.get(("async", key), 0)
+        self._ar_seq[("async", key)] = seq + 1
+        out = self._req({"cmd": "async_push", "host": self.host,
+                         "key": key, "seq": seq,
+                         "value": np.asarray(grad)})["value"]
+        return np.asarray(out)
+
     def close(self):
         self._stop.set()
 

@@ -102,6 +102,13 @@ class Scheduler:
         self._profile_cmds: List[dict] = []
         self._profile_seq = 0
         self._profile_posted: Dict[tuple, int] = {}  # retry dedup
+        # dist_async parameter-server state: master weights + updater
+        # (kvstore_dist_server.h:347 !sync_mode_ — each push is applied
+        # immediately, no aggregation barrier)
+        self._async_lock = threading.Lock()
+        self._async_store: Dict[str, np.ndarray] = {}
+        self._async_updater = None
+        self._async_served: Dict[tuple, tuple] = {}  # (host,key)->(seq,val)
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -230,6 +237,13 @@ class Scheduler:
         if cmd == "allreduce":
             return self._allreduce(msg["host"], msg["key"], msg["value"],
                                    int(msg.get("seq", -1)))
+        if cmd == "set_optimizer":
+            return self._async_set_optimizer(msg["spec"])
+        if cmd == "async_init":
+            return self._async_init(msg["key"], msg["value"])
+        if cmd == "async_push":
+            return self._async_push(msg["host"], msg["key"], msg["value"],
+                                    int(msg.get("seq", -1)))
         if cmd == "membership":
             with self._lock:
                 return {"workers": list(self._workers)}
@@ -253,11 +267,16 @@ class Scheduler:
                 self._workers.append(host)
             self._registered.add(host)
             self._heartbeats[host] = time.time()
-            # a (re)registering worker starts a fresh profiler-post
-            # sequence — purge its stale retry-dedup entries so its first
-            # post after a restart isn't swallowed by an old (host, 1) key
+            # a (re)registering worker starts a fresh profiler-post AND
+            # async-push sequence — purge its stale retry-dedup entries so
+            # its first request after a restart isn't swallowed by an old
+            # (host, seq) key (a swallowed async_push would silently drop
+            # a gradient and hand back pre-crash weights)
             for key in [k for k in self._profile_posted if k[0] == host]:
                 del self._profile_posted[key]
+            with self._async_lock:
+                for key in [k for k in self._async_served if k[0] == host]:
+                    del self._async_served[key]
             self._cv.notify_all()
             # profile_seq: joiners sync PAST the buffered command history
             # (don't replay a long-finished profiling session on new hosts)
@@ -550,6 +569,72 @@ class Scheduler:
         np.add.at(summed, inv, all_vals)
         return {"ids": uniq.astype(np.int32),
                 "vals": summed / len(stacked), "num_rows": num_rows}
+
+    # ------------------------------------------------------------------
+    # dist_async parameter-server plane
+    # ------------------------------------------------------------------
+
+    def _async_set_optimizer(self, spec: dict) -> dict:
+        """Install the server-side updater from a hyperparameter SPEC —
+        the reference pickled the whole optimizer object to the servers
+        (``python/mxnet/kvstore.py:451-498``); a spec carries the same
+        information without shipping code.  Idempotent for an identical
+        spec (every worker sends it); a DIFFERENT spec mid-run resets the
+        updater and its slots."""
+        from dt_tpu.elastic import server_optim
+        with self._async_lock:
+            if self._async_updater is not None and \
+                    self._async_updater.spec_input == spec:
+                return {}
+            try:
+                upd = server_optim.create(**dict(spec))
+            except (TypeError, ValueError) as e:
+                return {"error": f"set_optimizer: {e}"}
+            self._async_updater = upd
+            self._async_served.clear()
+        return {}
+
+    def _async_init(self, key: str, value) -> dict:
+        """Init-or-get: the first writer seeds the master weights, later
+        inits return the live copy unchanged (the reference's once-per-key
+        ``kv.init`` + new-worker pull-from-servers,
+        ``kvstore_local.h:95-110`` / ``module.py:552-571``) — so every
+        worker inits unconditionally and joiners adopt trained state."""
+        with self._async_lock:
+            if key not in self._async_store:
+                self._async_store[key] = np.asarray(value)
+            return {"value": self._async_store[key]}
+
+    def _async_push(self, host: str, key: str, value, seq: int = -1) -> dict:
+        """Apply one worker's gradient to the master weights IMMEDIATELY
+        and return them — the ``dist_async`` contract
+        (``kvstore_dist_server.h:347`` ``!sync_mode_``: no aggregation
+        wait, push order = application order).  (host, key, seq) dedup
+        makes at-least-once retries safe: re-applying a momentum update
+        twice would corrupt the trajectory, so a replay is served the
+        cached result instead."""
+        with self._async_lock:
+            served = self._async_served.get((host, key))
+            if seq >= 0 and served is not None and served[0] == seq:
+                return {"value": served[1]}
+            if self._async_updater is None:
+                return {"error": "async_push before set_optimizer"}
+            stored = self._async_store.get(key)
+            if stored is None:
+                return {"error": f"async_push: key {key!r} not initialized"}
+            new = self._async_updater(key, np.asarray(value), stored)
+            self._async_store[key] = new
+            self._async_served[(host, key)] = (seq, new)
+            if len(self._async_served) > 4 * max(len(self._workers), 1):
+                # bound the cache by dropping DEPARTED hosts' entries only —
+                # evicting a live worker's entry would re-open the
+                # double-apply window this dedup exists to close (live
+                # entries are bounded: one per (host, key))
+                live = set(self._workers)
+                for k in [k for k in self._async_served
+                          if k[0] not in live]:
+                    del self._async_served[k]
+            return {"value": new}
 
 
 def _read_hosts(path: str) -> List[str]:

@@ -1,0 +1,90 @@
+"""Server-side (scheduler-process) optimizers for the ``dist_async`` store.
+
+Reference: in ``dist_async`` mode the parameter server applies each
+worker's gradient to the master weights THE MOMENT it arrives — no
+cross-worker aggregation barrier (``src/kvstore/kvstore_dist_server.h:347``
+``!sync_mode_`` branch, updater run via ``exec_.Exec``); the optimizer
+itself was pickled over from rank 0 (``python/mxnet/kvstore.py:451-498``).
+
+Here the "server" is the elastic scheduler process, so the updater must
+run without touching any jax backend (the scheduler may live on a host
+whose accelerator is owned by workers): plain numpy, with per-key slots
+for momentum/moment state.  The supported set mirrors the reference's
+server-updatable core (``src/operator/optimizer_op.cc``): sgd (+momentum,
++weight_decay), adagrad, adam.  Workers select it with
+``kv.set_optimizer(...)``, which ships a SPEC (name + scalar hyperparams)
+— not pickled code — over the authenticated control plane.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+class NpUpdater:
+    """Applies one gradient to one key's master weights, in place of the
+    reference server's ``exec_.Exec(updater_(key, recved, &stored))``."""
+
+    def __init__(self, name: str, learning_rate: float = 0.01,
+                 momentum: float = 0.0, weight_decay: float = 0.0,
+                 epsilon: float = 1e-8, beta1: float = 0.9,
+                 beta2: float = 0.999):
+        name = name.lower()
+        if name not in ("sgd", "adagrad", "adam"):
+            raise ValueError(
+                f"dist_async server optimizer {name!r} unsupported; "
+                "supported: sgd, adagrad, adam (reference server-side set, "
+                "optimizer_op.cc)")
+        self.name = name
+        self.lr = float(learning_rate)
+        self.momentum = float(momentum)
+        self.wd = float(weight_decay)
+        self.eps = float(epsilon)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self._slots: Dict[str, dict] = {}
+        # the installed-spec identity the scheduler compares for idempotent
+        # re-sends; create() overwrites it with the caller's exact spec
+        self.spec_input = {"name": name, "learning_rate": self.lr,
+                           "momentum": self.momentum,
+                           "weight_decay": self.wd}
+
+    def __call__(self, key: str, grad: np.ndarray,
+                 stored: np.ndarray) -> np.ndarray:
+        g = np.asarray(grad, np.float32)
+        w = np.asarray(stored, np.float32)
+        slot = self._slots.setdefault(key, {})
+        if self.name == "sgd":
+            g = g + self.wd * w
+            if self.momentum:
+                m = slot.get("m")
+                m = self.momentum * m + g if m is not None else g
+                slot["m"] = m
+                g = m
+            new = w - self.lr * g
+        elif self.name == "adagrad":
+            h = slot.get("h", np.zeros_like(w)) + g * g
+            slot["h"] = h
+            new = w - self.lr * (g / np.sqrt(h + self.eps) + self.wd * w)
+        else:  # adam
+            t = slot.get("t", 0) + 1
+            m = self.beta1 * slot.get("m", np.zeros_like(w)) \
+                + (1 - self.beta1) * g
+            v = self.beta2 * slot.get("v", np.zeros_like(w)) \
+                + (1 - self.beta2) * g * g
+            slot.update(t=t, m=m, v=v)
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+            new = w - self.lr * (mhat / (np.sqrt(vhat) + self.eps)
+                                 + self.wd * w)
+        return new.astype(stored.dtype)
+
+
+def create(name: str, **params) -> NpUpdater:
+    # drop worker-side-only knobs a shared spec may carry
+    params.pop("lr_scheduler", None)
+    upd = NpUpdater(name, **params)
+    upd.spec_input = {"name": name, **params}
+    return upd

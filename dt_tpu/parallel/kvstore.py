@@ -19,8 +19,10 @@ train step (psum over the mesh), so this facade keeps:
 
 Types (``KVStore::Create``, ``src/kvstore/kvstore.cc:40-77``): ``local`` /
 ``device`` -> single-process store; ``tpu_sync`` (aliases ``dist_sync``,
-``dist_device_sync``) -> mesh-backed store.  ``dist_async`` has no SPMD
-analog (SURVEY.md §5.8) and raises with that explanation.
+``dist_device_sync``) -> mesh-backed store; ``dist_async`` -> scheduler-
+hosted parameter server applying pushes immediately (no SPMD analog
+exists for async — SURVEY.md §5.8 — so it runs on the control plane,
+see :class:`DistAsyncKVStore`).
 """
 
 from __future__ import annotations
@@ -191,6 +193,38 @@ class TPUSyncKVStore(KVStore):
         return jax.process_count()
 
 
+class DistAsyncKVStore(TPUSyncKVStore):
+    """Asynchronous parameter-server store (``dist_async``).
+
+    The reference's async mode applies each worker's gradient to the
+    server's master weights the moment it arrives — no aggregation
+    barrier (``kvstore_dist_server.h:347`` ``!sync_mode_``).  SPMD mesh
+    collectives are inherently synchronous, so this mode runs on the
+    CONTROL plane instead: the scheduler holds master weights + the
+    updater (``dt_tpu.elastic.server_optim``), and each worker's step is
+    ``push(grad) -> updated weights`` with no waiting on peers.  Workers
+    therefore run at their own pace with bounded staleness — the actual
+    dist_async trade-off, not an emulation.  ``Module.fit`` switches to
+    this data path when ``kv.type == "dist_async"``.
+    """
+
+    @property
+    def type(self) -> str:
+        return "dist_async"
+
+    def set_optimizer(self, optimizer, **params):
+        """Ship the optimizer SPEC to the scheduler (the reference pickles
+        the optimizer object to the servers, ``kvstore.py:451-498``).
+        ``optimizer`` is a name string; scalar hyperparams in ``params``."""
+        if not isinstance(optimizer, str):
+            raise TypeError("dist_async set_optimizer takes a name string "
+                            "+ hyperparams (specs ship over the wire, "
+                            "code does not)")
+        self._optimizer = {"name": optimizer, **params}
+        if self._controller is not None:
+            self._controller.set_optimizer(self._optimizer)
+
+
 def create(name: str = "local", mesh=None) -> KVStore:
     """Reference ``mx.kv.create`` type-string dispatch
     (``src/kvstore/kvstore.cc:40-77``)."""
@@ -200,7 +234,5 @@ def create(name: str = "local", mesh=None) -> KVStore:
     if key in ("tpu_sync", "dist_sync", "dist_device_sync", "dist"):
         return TPUSyncKVStore(mesh)
     if key in ("dist_async",):
-        raise ValueError(
-            "dist_async has no synchronous-SPMD analog on TPU (SURVEY.md "
-            "§5.8); use tpu_sync")
+        return DistAsyncKVStore(mesh)
     raise ValueError(f"unknown kvstore type {name!r}")

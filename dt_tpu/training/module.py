@@ -99,8 +99,13 @@ class Module:
                  remat: bool = False, shard_opt_state: bool = False):
         self.model = model
         self.loss_fn = loss_fn
+        self._optimizer_spec = None
         if isinstance(optimizer, str):
             from dt_tpu import optim
+            # keep the (name, scalar hyperparams) spec: dist_async ships it
+            # to the scheduler-side updater (set_optimizer hand-off)
+            self._optimizer_spec = {"name": optimizer,
+                                    **(optimizer_params or {})}
             optimizer = optim.create(optimizer, **(optimizer_params or {}))
         self.tx = optimizer
         self.kv = kvstore_lib.create(kvstore) if isinstance(kvstore, str) \
@@ -303,6 +308,17 @@ class Module:
 
         return jax.tree_util.tree_map(spec, self.state.opt_state)
 
+    def _ensure_unravel(self):
+        """(Re)build the flatten/unflatten closures for the flat-vector
+        data planes (host-sync allreduce, dist_async push).  Reset to None
+        on elastic mesh rebuilds; both data paths call this per batch."""
+        if self._unravel is None:
+            _, self._unravel = jax.flatten_util.ravel_pytree(
+                self.state.params)
+            if self.state.batch_stats:
+                _, self._unravel_stats = jax.flatten_util.ravel_pytree(
+                    self.state.batch_stats)
+
     def _place(self, arr):
         if jax.process_count() > 1:
             # multi-host: this process holds only ITS batch shard; assemble
@@ -360,6 +376,26 @@ class Module:
         rng = jax.random.PRNGKey(self.seed + 17)
         num_workers = self.kv.num_workers
 
+        # --- dist_async: master weights live on the scheduler ---
+        is_async = self.kv.type == "dist_async" and \
+            self.kv._controller is not None
+        if is_async:
+            if self._optimizer_spec is None:
+                raise ValueError(
+                    "dist_async needs the optimizer as (name, hyperparams) "
+                    "— pass optimizer='sgd' style, not an optax object "
+                    "(the spec ships to the scheduler's updater)")
+            spec = dict(self._optimizer_spec)
+            self.kv.set_optimizer(spec.pop("name"), **spec)
+            self._ensure_unravel()
+            flat_p, _ = jax.flatten_util.ravel_pytree(self.state.params)
+            # init-or-get: the first worker seeds the master weights, every
+            # other worker (and any joiner) adopts the live server copy
+            cur = self.kv._controller.async_init(
+                "params", np.asarray(jax.device_get(flat_p)))
+            self.state = self.state.replace(
+                params=self._unravel(jnp.asarray(cur)))
+
         for epoch in range(begin_epoch, num_epoch):
             # --- membership-change barrier (base_module.py:540-543) ---
             if elastic_enabled or self.kv._controller is not None:
@@ -407,18 +443,29 @@ class Module:
                     break
                 data = self._place(batch.data)
                 labels = self._place(batch.label)
-                if self.sync_mode == "host" and self.kv.num_workers > 1:
+                if is_async:
+                    # dist_async step: local grad -> push -> adopt the
+                    # post-update master weights.  No peer barrier; the
+                    # optimizer (and its momentum) runs on the scheduler
+                    # (kvstore_dist_server.h:347 !sync_mode_).  BN stats
+                    # stay worker-local between epoch-end snapshot
+                    # averages, as in the reference's aux-key flow.
+                    self._ensure_unravel()  # None after elastic rebuilds
+                    flat_g, flat_s, loss, logits = self._grad_step(
+                        self.state, data, labels, rng)
+                    new_p = self.kv._controller.async_push(
+                        "params", np.asarray(jax.device_get(flat_g)))
+                    self.state = self.state.replace(
+                        params=self._unravel(jnp.asarray(new_p)),
+                        batch_stats=self._unravel_stats(flat_s)
+                        if self._unravel_stats else self.state.batch_stats,
+                        step=self.state.step + 1)
+                elif self.sync_mode == "host" and self.kv.num_workers > 1:
                     if self.kv._controller is None:
                         raise RuntimeError(
                             "sync_mode='host' needs an elastic controller "
                             "(kv.set_controller) to carry the allreduce")
-                    if self._unravel is None:
-                        _, self._unravel = jax.flatten_util.ravel_pytree(
-                            self.state.params)
-                        if self.state.batch_stats:
-                            _, self._unravel_stats = \
-                                jax.flatten_util.ravel_pytree(
-                                    self.state.batch_stats)
+                    self._ensure_unravel()
                     flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
                     g_np = np.asarray(jax.device_get(flat_g))

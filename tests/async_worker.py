@@ -1,0 +1,76 @@
+"""Worker process for the dist_async integration test.
+
+Trains an MLP on the shared margin task through ``kvstore='dist_async'``:
+every step pushes the local gradient to the scheduler's master weights and
+adopts the post-update copy — no peer barrier inside the epoch (the
+reference's ``dist_async`` contract, ``kvstore_dist_server.h:347``).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dt_tpu import data, models  # noqa: E402
+from dt_tpu.elastic import WorkerClient  # noqa: E402
+from dt_tpu.parallel import kvstore as kvstore_lib  # noqa: E402
+from dt_tpu.training import Module  # noqa: E402
+
+
+def make_dataset(n=256, seed=1234):
+    rng = np.random.RandomState(seed)  # same on every worker
+    margin = 0.7 / np.sqrt(8 * 8 * 3)
+    xs = []
+    while sum(len(a) for a in xs) < n:
+        cand = rng.normal(0, 1, (2 * n, 8, 8, 3)).astype(np.float32)
+        m = cand.mean(axis=(1, 2, 3))
+        xs.append(cand[np.abs(m) > margin])
+    x = np.concatenate(xs)[:n]
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scheduler-port", type=int, required=True)
+    ap.add_argument("--host", required=True)
+    ap.add_argument("--num-epoch", type=int, default=8)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    x, y = make_dataset()
+    ctrl = WorkerClient("127.0.0.1", args.scheduler_port, host=args.host)
+    kv = kvstore_lib.create("dist_async")
+    kv.set_controller(ctrl)
+
+    # each worker trains on ITS shard, asynchronously
+    n, r = kv.num_workers, kv.rank
+    xs, ys = x[r::n], y[r::n]
+
+    mod = Module(models.create("mlp", num_classes=2, hidden=(16,)),
+                 optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+                 kvstore=kv, seed=5)
+    mod.fit(data.NDArrayIter(xs, ys, batch_size=16, shuffle=True, seed=r),
+            num_epoch=args.num_epoch)
+
+    acc = dict(mod.score(data.NDArrayIter(x, y, batch_size=64), "acc"))
+    flat, _ = jax.flatten_util.ravel_pytree(mod.state.params)
+    with open(args.out, "w") as f:
+        json.dump({"host": args.host, "final_acc": acc["accuracy"],
+                   "param_sum": float(np.asarray(flat).sum()),
+                   "steps": int(mod.state.step)}, f)
+    ctrl.close()
+
+
+if __name__ == "__main__":
+    main()

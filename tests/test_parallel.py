@@ -131,8 +131,7 @@ def test_kvstore_types():
     assert parallel.create("device").type == "local"
     assert parallel.create("dist_sync").type == "tpu_sync"
     assert parallel.create("tpu_sync").num_workers == 1  # no controller
-    with pytest.raises(ValueError, match="dist_async"):
-        parallel.create("dist_async")
+    assert parallel.create("dist_async").type == "dist_async"
     with pytest.raises(ValueError, match="unknown"):
         parallel.create("quantum")
 
