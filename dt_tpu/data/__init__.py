@@ -36,5 +36,6 @@ from dt_tpu.data.recordio import (
     RecordIOWriter as RecordIOWriter,
     pack_label as pack_label,
     unpack_label as unpack_label,
+    ImageDetRecordIter as ImageDetRecordIter,
     ImageRecordIter as ImageRecordIter,
 )

@@ -363,3 +363,50 @@ class ImageRecordIter:
                 yield self.next()
             except StopIteration:
                 return
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection-record iterator: images with a VARIABLE number of box
+    labels per record.
+
+    Reference: ``ImageDetRecordIter`` (``src/io/iter_image_det_recordio.cc``)
+    — its label is ``[header..., obj0..., obj1..., ...]`` with per-batch
+    padding to the widest record.  TPU-first difference: the label tensor
+    has a FIXED capacity ``(max_objs, obj_width)`` chosen up front (batch
+    shape changing with the fullest image in each batch would recompile
+    the jit step per batch); records are padded with ``pad_value`` rows
+    (-1 class id, the multibox-target ignore convention,
+    ``dt_tpu/ops/detection.py``) and over-full records raise rather than
+    silently dropping boxes.
+
+    Record labels may be written flat (``k * obj_width`` floats via
+    ``pack_label``) or as ``(k, obj_width)`` arrays; ``obj_width`` is
+    typically 5: ``[class_id, xmin, ymin, xmax, ymax]``.
+    """
+
+    def __init__(self, path_imgrec: str, data_shape: Sequence[int],
+                 batch_size: int, max_objs: int = 16, obj_width: int = 5,
+                 pad_value: float = -1.0, **kwargs):
+        self.max_objs = int(max_objs)
+        self.obj_width = int(obj_width)
+        self.pad_value = float(pad_value)
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+
+    def _decode_one(self, i: int):
+        lab, _, payload = unpack_label(self._records[i])
+        img = self._decode(payload)
+        flat = np.asarray(lab, np.float32).ravel()
+        if flat.size % self.obj_width:
+            raise ValueError(
+                f"record {i}: label size {flat.size} is not a multiple of "
+                f"obj_width={self.obj_width}")
+        k = flat.size // self.obj_width
+        if k > self.max_objs:
+            raise ValueError(
+                f"record {i}: {k} objects exceed max_objs={self.max_objs}; "
+                "raise max_objs (fixed label capacity keeps the jit step "
+                "shape-stable)")
+        out = np.full((self.max_objs, self.obj_width), self.pad_value,
+                      np.float32)
+        out[:k] = flat.reshape(k, self.obj_width)
+        return img, out

@@ -584,7 +584,8 @@ class Scheduler:
         from dt_tpu.elastic import server_optim
         with self._async_lock:
             if self._async_updater is not None and \
-                    self._async_updater.spec_input == spec:
+                    self._async_updater.spec_input == \
+                    server_optim.spec_identity(spec):
                 return {}
             try:
                 upd = server_optim.create(**dict(spec))
@@ -616,6 +617,12 @@ class Scheduler:
         with self._async_lock:
             served = self._async_served.get((host, key))
             if seq >= 0 and served is not None and served[0] == seq:
+                return {"value": served[1]}
+            if seq >= 0 and served is not None and seq < served[0]:
+                # STALE duplicate (a delayed handler thread losing the race
+                # to its own retry): the client has already moved past this
+                # seq — applying it again would double-count the gradient.
+                # Serve the freshest weights; nobody consumes this reply.
                 return {"value": served[1]}
             if self._async_updater is None:
                 return {"error": "async_push before set_optimizer"}

@@ -82,9 +82,19 @@ class NpUpdater:
         return new.astype(stored.dtype)
 
 
+def spec_identity(spec: dict) -> dict:
+    """The comparable identity of a spec: its scalar hyperparams.  Used
+    for the idempotent re-send check — every worker sends the spec at fit
+    start, and only a GENUINELY different one may reset the updater (a
+    reset wipes momentum slots and the retry-dedup cache)."""
+    return {k: v for k, v in spec.items()
+            if isinstance(v, (int, float, str, bool))}
+
+
 def create(name: str, **params) -> NpUpdater:
+    identity = spec_identity({"name": name, **params})
     # drop worker-side-only knobs a shared spec may carry
     params.pop("lr_scheduler", None)
     upd = NpUpdater(name, **params)
-    upd.spec_input = {"name": name, **params}
+    upd.spec_input = identity
     return upd

@@ -377,8 +377,12 @@ class Module:
         num_workers = self.kv.num_workers
 
         # --- dist_async: master weights live on the scheduler ---
-        is_async = self.kv.type == "dist_async" and \
-            self.kv._controller is not None
+        is_async = self.kv.type == "dist_async"
+        if is_async and self.kv._controller is None:
+            raise RuntimeError(
+                "dist_async needs an elastic controller — "
+                "kv.set_controller(WorkerClient(...)) (or auto_client()); "
+                "without one this would silently train single-worker")
         if is_async:
             if self._optimizer_spec is None:
                 raise ValueError(

@@ -391,3 +391,41 @@ def test_device_prefetch_iter_sharded(tmp_path):
     b = it.next()
     assert len(b.data.sharding.device_set) == 8
     assert len(b.label.sharding.device_set) == 8
+
+
+def test_image_det_record_iter_pads_variable_boxes(tmp_path):
+    """ImageDetRecordIter (reference ``src/io/iter_image_det_recordio.cc``):
+    variable per-record box counts batch into a FIXED (max_objs, 5) label
+    tensor padded with -1 rows (static shapes for the jit step)."""
+    import io as _io
+
+    from PIL import Image
+
+    rec = str(tmp_path / "det.rec")
+    boxes = [
+        np.array([[1, .1, .1, .5, .5]], np.float32),
+        np.array([[2, .2, .2, .6, .6], [3, .3, .3, .7, .7]], np.float32),
+        # object-free image: one explicit ignore row (class -1), the
+        # multibox ignore convention — IRHeader can't express 0 floats
+        np.array([[-1, 0, 0, 0, 0]], np.float32),
+    ]
+    with data.RecordIOWriter(rec) as w:
+        for i, b in enumerate(boxes):
+            arr = np.full((8, 8, 3), i * 40, np.uint8)
+            buf = _io.BytesIO()
+            Image.fromarray(arr).save(buf, format="PNG")
+            w.write(data.pack_label(buf.getvalue(), b.ravel(), rec_id=i))
+
+    it = data.ImageDetRecordIter(rec, (8, 8, 3), batch_size=3, max_objs=4)
+    batch = it.next()
+    assert batch.data.shape == (3, 8, 8, 3)
+    assert batch.label.shape == (3, 4, 5)
+    np.testing.assert_allclose(batch.label[0, 0], boxes[0][0])
+    np.testing.assert_allclose(batch.label[1, :2], boxes[1])
+    assert (batch.label[0, 1:] == -1).all()
+    np.testing.assert_allclose(batch.label[2, 0], boxes[2][0])
+    assert (batch.label[2, 1:] == -1).all()
+
+    with pytest.raises(ValueError, match="max_objs"):
+        data.ImageDetRecordIter(rec, (8, 8, 3), batch_size=3,
+                                max_objs=1).next()
