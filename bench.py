@@ -181,16 +181,19 @@ def main():
     print(line)
 
 
-# per-img fwd GFLOP at 224x224 (train step ~ 3x fwd); baselines from the
-# reference's published single-GPU table where a row exists
+# per-img fwd GFLOP (train step ~ 3x fwd) + the image size that figure
+# (and the baseline) is calibrated at; baselines from the reference's
+# published single-GPU table where a row exists.  When the run's
+# DT_BENCH_IMAGE differs from the calibrated size, flops/MFU/vs_baseline
+# are suppressed rather than silently mis-scaled.
 _TIER_INFO = {
-    "resnet152": (11.56e9, BASELINE_IMGS_PER_SEC),
-    "resnet50": (4.1e9, None),
-    "resnet18": (1.8e9, None),
+    "resnet152": (11.56e9, BASELINE_IMGS_PER_SEC, 224),
+    "resnet50": (4.1e9, None, 224),
+    "resnet18": (1.8e9, None, 224),
     # other reference 1-GPU table rows (BASELINE.md): inception-v3 b32 at
     # 299px, alexnet b512 (run via DT_BENCH_MODEL/_IMAGE/_BATCH)
-    "inception_v3": (5.73e9, 30.4),
-    "alexnet": (0.72e9, 457.07),
+    "inception_v3": (5.73e9, 30.4, 299),
+    "alexnet": (0.72e9, 457.07, 224),
 }
 
 # published peak bf16 TFLOP/s per chip, keyed by device_kind substring —
@@ -293,7 +296,9 @@ def measure_tier(net, batch, size):
 
     imgs_per_sec = batch / dt_step
     step_ms = dt_step * 1e3
-    fwd_flops, baseline = _TIER_INFO.get(net, (0.0, None))
+    fwd_flops, baseline, calib_size = _TIER_INFO.get(net, (0.0, None, None))
+    if calib_size is not None and size != calib_size:
+        fwd_flops, baseline = 0.0, None  # config != calibration: no claims
     flops_per_img = 3 * fwd_flops
     model_tflops = imgs_per_sec * flops_per_img / 1e12
     kind = jax.devices()[0].device_kind

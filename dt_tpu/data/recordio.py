@@ -387,6 +387,15 @@ class ImageDetRecordIter(ImageRecordIter):
     def __init__(self, path_imgrec: str, data_shape: Sequence[int],
                  batch_size: int, max_objs: int = 16, obj_width: int = 5,
                  pad_value: float = -1.0, **kwargs):
+        if kwargs.get("augmenter") is not None:
+            # the classification augmenters transform only the image; a
+            # flip/crop here would silently desynchronize the box labels
+            # (the reference's det iterator has its own box-aware augment
+            # chain, image_det_aug_default.cc — not implemented yet)
+            raise ValueError(
+                "ImageDetRecordIter does not take the classification "
+                "augmenter (it would corrupt box labels); augment "
+                "image+boxes together downstream instead")
         self.max_objs = int(max_objs)
         self.obj_width = int(obj_width)
         self.pad_value = float(pad_value)

@@ -118,31 +118,36 @@ def entropy_calibrate(samples: np.ndarray, num_bins: int = 2048,
         return 1e-8
     hist, edges = np.histogram(samples, bins=num_bins, range=(0, amax))
     hist = hist.astype(np.float64)
+    p_full = hist / hist.sum()
     best_kl, best_t = np.inf, amax
-    # sweep thresholds from num_quantized_bins..num_bins
+    # Sweep candidate thresholds.  KL is measured against the FULL
+    # distribution, with the candidate reconstructing clipped mass as
+    # saturation into its edge bin — comparing against the clipped
+    # distribution instead (as a naive reading of the algorithm does)
+    # makes the first candidate lossless (factor 1 -> q == p -> KL 0) and
+    # the sweep degenerates to always returning the smallest threshold.
     for i in range(num_quantized_bins, num_bins + 1,
                    max((num_bins - num_quantized_bins) // 64, 1)):
         t = edges[i]
         p = hist[:i].copy()
-        p[-1] += hist[i:].sum()  # clip outliers into the last bin
+        outlier = hist[i:].sum()
         if p.sum() == 0:
             continue
-        # quantize p into num_quantized_bins then expand back
+        # quantize the in-range histogram into num_quantized_bins, expand
         factor = i / num_quantized_bins
-        q = np.zeros(i)
+        q = np.zeros(num_bins)
         for j in range(num_quantized_bins):
             lo = int(np.floor(j * factor))
-            hi = int(np.ceil((j + 1) * factor))
-            hi = min(hi, i)
+            hi = min(int(np.ceil((j + 1) * factor)), i)
             chunk = p[lo:hi]
             nz = (chunk > 0).sum()
             if nz:
                 q[lo:hi][chunk > 0] = chunk[chunk > 0].sum() / nz
-        pn = p / p.sum()
-        qn = q / q.sum() if q.sum() else q + 1.0 / i
-        mask = pn > 0
-        kl = float(np.sum(pn[mask] * np.log(pn[mask] /
-                                            np.maximum(qn[mask], 1e-12))))
+        q[i - 1] += outlier  # int8 saturates everything beyond t
+        qn = q / q.sum()
+        mask = p_full > 0
+        kl = float(np.sum(p_full[mask] * np.log(
+            p_full[mask] / np.maximum(qn[mask], 1e-12))))
         if kl < best_kl:
             best_kl, best_t = kl, t
     return float(best_t)

@@ -1,0 +1,137 @@
+"""INT8 post-training quantization — the reference's quantization flow.
+
+Reference: ``example/quantization/imagenet_gen_qsym.py`` +
+``python/mxnet/contrib/quantization.py`` ``quantize_model``: train fp32,
+collect activation ranges on calibration batches (``calib_mode='naive'``
+min/max or ``'entropy'`` KL-optimal thresholds), quantize weights
+offline, then serve the int8 graph (int32 MXU accumulation) and compare
+top-1 against fp32.
+
+    python examples/quantize_model.py --calib-mode entropy
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_task(n, seed):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    x = rng.normal(0, 1, (n, 64)).astype("float32")
+    # 4-way task: quadrant of (mean of first half, mean of second half)
+    a = x[:, :32].mean(1) > 0
+    b = x[:, 32:].mean(1) > 0
+    y = (2 * a + b).astype("int32")
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--calib-mode", choices=["naive", "entropy"],
+                    default="naive")
+    ap.add_argument("--calib-batches", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from dt_tpu.ops import quantization as Q
+    from dt_tpu.ops import losses
+
+    # ---- train fp32 ------------------------------------------------------
+    x, y = make_task(4096, args.seed)
+    vx, vy = make_task(1024, args.seed + 1)
+    rng = jax.random.PRNGKey(args.seed)
+    k1, k2, k3 = jax.random.split(rng, 3)
+    params = {
+        "w1": jax.random.normal(k1, (64, 128)) * 0.1, "b1": jnp.zeros(128),
+        "w2": jax.random.normal(k2, (128, 128)) * 0.1, "b2": jnp.zeros(128),
+        "w3": jax.random.normal(k3, (128, 4)) * 0.1, "b3": jnp.zeros(4),
+    }
+
+    def forward(p, xb, taps=False):
+        h1 = jax.nn.relu(xb @ p["w1"] + p["b1"])
+        h2 = jax.nn.relu(h1 @ p["w2"] + p["b2"])
+        out = h2 @ p["w3"] + p["b3"]
+        return (out, {"in": xb, "h1": h1, "h2": h2}) if taps else out
+
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, opt, xb, yb):
+        loss, g = jax.value_and_grad(
+            lambda p: losses.softmax_cross_entropy(forward(p, xb), yb))(p)
+        up, opt = tx.update(g, opt, p)
+        return optax.apply_updates(p, up), opt, loss
+
+    for epoch in range(args.epochs):
+        for i in range(0, len(x), 256):
+            params, opt, loss = step(params, opt, jnp.asarray(x[i:i + 256]),
+                                     jnp.asarray(y[i:i + 256]))
+
+    def acc(fwd):
+        pred = np.argmax(np.asarray(fwd(jnp.asarray(vx))), -1)
+        return float((pred == vy).mean())
+
+    fp32_acc = acc(lambda xb: forward(params, xb))
+    print(f"fp32 top-1: {fp32_acc:.4f}")
+
+    # ---- calibrate activation ranges ------------------------------------
+    # (reference: collect_layer_outputs over calib_data, then naive minmax
+    # or entropy thresholds per tensor)
+    collector = Q.MinMaxCollector()
+    taps_all = {"in": [], "h1": [], "h2": []}
+    for i in range(args.calib_batches):
+        xb = x[i * 256:(i + 1) * 256]
+        _, taps = forward(params, jnp.asarray(xb), taps=True)
+        for name, v in taps.items():
+            collector.collect(name, v)
+            taps_all[name].append(np.asarray(v))
+    if args.calib_mode == "entropy":
+        ranges = {}
+        for name, chunks in taps_all.items():
+            t = Q.entropy_calibrate(np.concatenate(chunks))
+            ranges[name] = (-t, t)
+    else:
+        ranges = collector.ranges
+    print(f"calibration ({args.calib_mode}):",
+          {k: (round(a, 2), round(b, 2)) for k, (a, b) in ranges.items()})
+
+    # ---- quantize weights offline, serve int8 ---------------------------
+    qw = {}
+    for name in ("w1", "w2", "w3"):
+        w = params[name]
+        amax = float(jnp.abs(w).max())
+        qw[name] = Q.quantize(w, -amax, amax)
+
+    def int8_forward(xb):
+        # each dense runs int8 x int8 -> int32 on the MXU; activations are
+        # re-quantized against the calibrated ranges between layers
+        xq, xs = Q.quantize(xb, *ranges["in"])
+        h1 = jax.nn.relu(Q.quantized_dense(xq, qw["w1"][0], xs,
+                                           qw["w1"][1]) + params["b1"])
+        h1q, h1s = Q.quantize(h1, *ranges["h1"])
+        h2 = jax.nn.relu(Q.quantized_dense(h1q, qw["w2"][0], h1s,
+                                           qw["w2"][1]) + params["b2"])
+        h2q, h2s = Q.quantize(h2, *ranges["h2"])
+        return Q.quantized_dense(h2q, qw["w3"][0], h2s, qw["w3"][1]) \
+            + params["b3"]
+
+    int8_acc = acc(jax.jit(int8_forward))
+    print(f"int8 top-1: {int8_acc:.4f}  (delta {fp32_acc - int8_acc:+.4f})")
+    if fp32_acc - int8_acc > 0.02:
+        raise SystemExit("int8 accuracy dropped more than 2% — calibration "
+                         "regression")
+
+
+if __name__ == "__main__":
+    main()

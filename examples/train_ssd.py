@@ -53,6 +53,11 @@ def main():
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--log-every", type=int, default=20)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rec", default=None,
+                    help="packed detection .rec (labels = k x 5 rows of "
+                         "[class, x1, y1, x2, y2]; see "
+                         "data.ImageDetRecordIter) — replaces the "
+                         "synthetic task")
     args = ap.parse_args()
 
     from dt_tpu.config import maybe_force_cpu
@@ -65,9 +70,32 @@ def main():
     from dt_tpu.models.ssd import ssd_loss, ssd_detect
 
     rng = np.random.RandomState(args.seed)
+
+    if args.rec:
+        from dt_tpu import data as data_lib
+        det_iter = data_lib.ImageDetRecordIter(
+            args.rec, (args.image_size, args.image_size, 3),
+            args.batch_size, max_objs=args.max_boxes, shuffle=True,
+            seed=args.seed)
+        det_stream = iter(det_iter)
+
+        def next_batch(_rng):
+            nonlocal det_stream
+            try:
+                b = next(det_stream)
+            except StopIteration:
+                det_stream = iter(det_iter)
+                b = next(det_stream)
+            # label rows are [class, x1, y1, x2, y2]; pad rows carry -1
+            return (b.data / 255.0, b.label[:, :, 1:5],
+                    b.label[:, :, 0].astype("int64"))
+    else:
+        def next_batch(rng):
+            return synthetic_batch(rng, args.batch_size, args.image_size,
+                                   args.num_classes, args.max_boxes)
+
     model = models.create("ssd", num_classes=args.num_classes)
-    x0, _, _ = synthetic_batch(rng, args.batch_size, args.image_size,
-                               args.num_classes, args.max_boxes)
+    x0, _, _ = next_batch(rng)
     variables = model.init({"params": jax.random.PRNGKey(args.seed)},
                            jnp.asarray(x0), training=False)
     params, bstats = variables["params"], variables["batch_stats"]
@@ -87,9 +115,7 @@ def main():
 
     t0 = time.time()
     for it in range(1, args.steps + 1):
-        imgs, boxes, labels = synthetic_batch(
-            rng, args.batch_size, args.image_size, args.num_classes,
-            args.max_boxes)
+        imgs, boxes, labels = next_batch(rng)
         params, bstats, opt, loss = step(
             params, bstats, opt, jnp.asarray(imgs), jnp.asarray(boxes),
             jnp.asarray(labels))
@@ -99,9 +125,7 @@ def main():
                   f"{rate:7.1f} img/s")
 
     # eval: detection on a fresh batch
-    imgs, boxes, labels = synthetic_batch(
-        rng, args.batch_size, args.image_size, args.num_classes,
-        args.max_boxes)
+    imgs, boxes, labels = next_batch(rng)
     cls, box, anchors = model.apply(
         {"params": params, "batch_stats": bstats}, jnp.asarray(imgs),
         training=False)

@@ -57,3 +57,42 @@ def test_train_elastic_under_launcher(tmp_path):
         capture_output=True, text=True, timeout=300, env=env,
         cwd=REPO)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_quantize_model_naive():
+    r = _run("quantize_model.py", "--calib-mode", "naive", "--epochs", "8")
+    assert "int8 top-1" in r.stdout
+
+
+def test_quantize_model_entropy():
+    # the KL sweep must not pick a degenerate tiny threshold (the
+    # round-2 bug: comparing against the clipped distribution made the
+    # first candidate lossless); the example exits nonzero if int8
+    # accuracy drops >2%
+    r = _run("quantize_model.py", "--calib-mode", "entropy",
+             "--epochs", "8")
+    assert "int8 top-1" in r.stdout
+
+
+def test_train_ssd_from_det_rec(tmp_path):
+    import io as _io
+
+    import numpy as np
+    from PIL import Image
+
+    sys.path.insert(0, REPO)
+    from dt_tpu import data
+
+    rec = str(tmp_path / "det.rec")
+    rng = np.random.RandomState(0)
+    with data.RecordIOWriter(rec) as w:
+        for i in range(16):
+            img = (rng.rand(64, 64, 3) * 60).astype(np.uint8)
+            rows = np.asarray([[rng.randint(0, 3), .2, .2, .7, .7]],
+                              np.float32)
+            buf = _io.BytesIO()
+            Image.fromarray(img).save(buf, format="JPEG", quality=90)
+            w.write(data.pack_label(buf.getvalue(), rows.ravel(),
+                                    rec_id=i))
+    _run("train_ssd.py", "--rec", rec, "--steps", "2", "--batch-size", "4",
+         "--image-size", "64", "--max-boxes", "2", "--log-every", "1")

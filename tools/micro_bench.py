@@ -1,0 +1,153 @@
+"""Op-level micro-benchmarks — the reference's ``benchmark/python/``
+harnesses (``sparse/``, ``quantization/``, ``control_flow/``; BASELINE.md
+"Benchmark harnesses") rebuilt for the jit world.  One JSON line per
+config: {bench, config, ms, and a bench-specific ratio}.
+
+Groups:
+- sparse: dense dot vs csr dot vs row-sparse embedding grad at matched
+  shapes/densities (ref ``benchmark/python/sparse/dot.py``)
+- quantization: f32 dense vs int8 dense w/ int32 accumulation
+  (ref ``benchmark/python/quantization/benchmark_op.py``)
+- control_flow: Python-unrolled RNN vs ``lax.scan`` fused RNN — compile
+  AND step time (ref ``benchmark/python/control_flow/rnn_cases.py``)
+
+Runs on whatever backend is default (TPU under axon; DT_FORCE_CPU=1 for
+CPU).  All timings block on full outputs.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timeit(fn, *args, iters=10):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    from dt_tpu.config import maybe_force_cpu, enable_compilation_cache
+    maybe_force_cpu()
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    backend = jax.default_backend()
+    rng = np.random.RandomState(0)
+
+    def emit(rec):
+        rec["backend"] = backend
+        print(json.dumps(rec), flush=True)
+
+    # ---- sparse ---------------------------------------------------------
+    from dt_tpu.ops import sparse as sp
+    m, k, n = (256, 512, 128) if args.small else (2048, 4096, 1024)
+    density = 0.01
+    dense_lhs = (rng.rand(m, k) < density) * rng.randn(m, k)
+    lhs = jnp.asarray(dense_lhs, jnp.float32)
+    rhsm = jnp.asarray(rng.randn(k, n), jnp.float32)
+    csr = sp.csr_from_dense(lhs, nse=int(m * k * density * 2))
+
+    t_dense = _timeit(jax.jit(lambda a, b: a @ b), lhs, rhsm,
+                      iters=args.iters)
+    t_csr = _timeit(jax.jit(sp.csr_dot_dense), csr, rhsm, iters=args.iters)
+    emit({"bench": "sparse_dot", "config": f"{m}x{k}x{n} d={density}",
+          "dense_ms": round(t_dense, 3), "csr_ms": round(t_csr, 3),
+          "speedup": round(t_dense / t_csr, 2) if t_csr else None})
+
+    vocab, dim, batch = (1000, 64, 256) if args.small else (100000, 512,
+                                                            8192)
+    table = jnp.asarray(rng.randn(vocab, dim) * 0.1, jnp.float32)
+    ids = jnp.asarray(rng.randint(0, vocab, batch), jnp.int32)
+
+    def dense_emb_grad(tab, ids):
+        def loss(t):
+            return jnp.sum(t[ids] ** 2)
+        return jax.grad(loss)(tab)  # materializes (vocab, dim)
+
+    rsp_vg = sp.embedding_value_and_grad(lambda rows: jnp.sum(rows ** 2))
+
+    def rsp_emb_grad(tab, ids):
+        _, (rs, _) = rsp_vg(tab, ids)
+        return rs.indices, rs.values  # touched rows only, never dense
+
+    t_dg = _timeit(jax.jit(dense_emb_grad), table, ids, iters=args.iters)
+    t_rg = _timeit(jax.jit(rsp_emb_grad), table, ids, iters=args.iters)
+    emit({"bench": "sparse_embedding_grad",
+          "config": f"vocab={vocab} dim={dim} batch={batch}",
+          "dense_ms": round(t_dg, 3), "row_sparse_ms": round(t_rg, 3),
+          "speedup": round(t_dg / t_rg, 2) if t_rg else None})
+
+    # ---- quantization ---------------------------------------------------
+    from dt_tpu.ops import quantization as q
+    b, i, o = (64, 256, 256) if args.small else (512, 2048, 2048)
+    xf = jnp.asarray(rng.randn(b, i), jnp.float32)
+    wf = jnp.asarray(rng.randn(i, o) * 0.05, jnp.float32)
+    xq, x_scale = q.quantize(xf, float(xf.min()), float(xf.max()))
+    wq, w_scale = q.quantize(wf, float(wf.min()), float(wf.max()))
+
+    t_f32 = _timeit(jax.jit(lambda a, w: a @ w), xf, wf, iters=args.iters)
+    qd = jax.jit(lambda a, w: q.quantized_dense(a, w, x_scale, w_scale))
+    t_int8 = _timeit(qd, xq, wq, iters=args.iters)
+    emit({"bench": "quantized_dense", "config": f"{b}x{i}x{o}",
+          "f32_ms": round(t_f32, 3), "int8_ms": round(t_int8, 3),
+          "speedup": round(t_f32 / t_int8, 2) if t_int8 else None})
+
+    # ---- control flow ---------------------------------------------------
+    from dt_tpu.ops import rnn as rnn_lib
+    T, B, H = (16, 16, 64) if args.small else (128, 64, 512)
+    w = rnn_lib.LSTMWeights(
+        jnp.asarray(rng.randn(H, 4 * H) * 0.05, jnp.float32),
+        jnp.asarray(rng.randn(H, 4 * H) * 0.05, jnp.float32),
+        jnp.zeros(4 * H, jnp.float32))
+    x = jnp.asarray(rng.randn(T, B, H), jnp.float32)
+    h0 = jnp.zeros((1, B, H), jnp.float32)
+    c0 = jnp.zeros((1, B, H), jnp.float32)
+
+    def scan_lstm(x):
+        outs, _, _ = rnn_lib.lstm(x, h0, c0, [w])
+        return outs
+
+    def unrolled_lstm(x):
+        # the eager per-step dispatch pattern (reference's
+        # control_flow benchmark compares foreach vs unrolled)
+        h = h0[0]
+        c = c0[0]
+        outs = []
+        for t in range(T):
+            gates = x[t] @ w.wx + h @ w.wh + w.b
+            ii, f, g, o2 = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(ii) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o2) * jnp.tanh(c)
+            outs.append(h)
+        return jnp.stack(outs)
+
+    for tag, fn in (("scan", scan_lstm), ("unrolled", unrolled_lstm)):
+        jfn = jax.jit(fn)
+        t_c0 = time.perf_counter()
+        jax.block_until_ready(jfn(x))
+        compile_s = time.perf_counter() - t_c0
+        ms = _timeit(jfn, x, iters=args.iters)
+        emit({"bench": "control_flow_lstm", "config": f"T{T}xB{B}xH{H}",
+              "variant": tag, "compile_s": round(compile_s, 2),
+              "ms": round(ms, 3)})
+
+
+if __name__ == "__main__":
+    main()
