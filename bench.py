@@ -186,14 +186,16 @@ def main():
 # published single-GPU table where a row exists.  When the run's
 # DT_BENCH_IMAGE differs from the calibrated size, flops/MFU/vs_baseline
 # are suppressed rather than silently mis-scaled.
+# {net: (fwd GFLOP/img, baseline img/s or None, calib size, calib batch
+# or None=any)}; the reference's baseline rows are batch-specific
 _TIER_INFO = {
-    "resnet152": (11.56e9, BASELINE_IMGS_PER_SEC, 224),
-    "resnet50": (4.1e9, None, 224),
-    "resnet18": (1.8e9, None, 224),
+    "resnet152": (11.56e9, BASELINE_IMGS_PER_SEC, 224, 32),
+    "resnet50": (4.1e9, None, 224, None),
+    "resnet18": (1.8e9, None, 224, None),
     # other reference 1-GPU table rows (BASELINE.md): inception-v3 b32 at
     # 299px, alexnet b512 (run via DT_BENCH_MODEL/_IMAGE/_BATCH)
-    "inception_v3": (5.73e9, 30.4, 299),
-    "alexnet": (0.72e9, 457.07, 224),
+    "inception_v3": (5.73e9, 30.4, 299, 32),
+    "alexnet": (0.72e9, 457.07, 224, 512),
 }
 
 # published peak bf16 TFLOP/s per chip, keyed by device_kind substring —
@@ -296,9 +298,12 @@ def measure_tier(net, batch, size):
 
     imgs_per_sec = batch / dt_step
     step_ms = dt_step * 1e3
-    fwd_flops, baseline, calib_size = _TIER_INFO.get(net, (0.0, None, None))
+    fwd_flops, baseline, calib_size, calib_batch = _TIER_INFO.get(
+        net, (0.0, None, None, None))
     if calib_size is not None and size != calib_size:
         fwd_flops, baseline = 0.0, None  # config != calibration: no claims
+    if calib_batch is not None and batch != calib_batch:
+        baseline = None  # the reference row is batch-specific
     flops_per_img = 3 * fwd_flops
     model_tflops = imgs_per_sec * flops_per_img / 1e12
     kind = jax.devices()[0].device_kind
@@ -315,12 +320,15 @@ def measure_tier(net, batch, size):
         "step_ms_queued": round(queued * 1e3, 2),
         "step_ms_synced": round(synced * 1e3, 2),
         "compile_s": round(t_compile, 1),
-        "model_tflops_per_sec": round(model_tflops, 2),
+        "model_tflops_per_sec": round(model_tflops, 2) if fwd_flops
+        else None,
         "device_kind": kind,
         # MFU from the model's algorithmic FLOPs (conv FLOPs only, so the
         # true utilization is slightly higher) vs the chip's published
-        # bf16 peak; null when the device kind isn't in the table
-        "mfu": round(model_tflops / peak, 3) if peak else None,
+        # bf16 peak; null when not computable (unknown chip, or the run's
+        # size differs from the FLOP calibration)
+        "mfu": round(model_tflops / peak, 3) if peak and fwd_flops
+        else None,
         "backend": jax.default_backend(),
     }
 

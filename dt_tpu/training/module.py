@@ -96,7 +96,8 @@ class Module:
                  optimizer_params: Optional[dict] = None,
                  kvstore: Union[str, kvstore_lib.KVStore] = "local",
                  mesh=None, mesh_manager=None, seed: int = 0,
-                 remat: bool = False, shard_opt_state: bool = False):
+                 remat: bool = False, shard_opt_state: bool = False,
+                 shard_params: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self._optimizer_spec = None
@@ -134,6 +135,13 @@ class Module:
         # reduce-scatter/all-gather pair around the sharded update.  Opt-state
         # HBM drops by ~N x on the mesh path ("mesh" sync mode only).
         self.shard_opt_state = shard_opt_state
+        # FSDP (ZeRO-3): ALSO keep the parameters themselves sharded over
+        # 'data' at rest; XLA all-gathers each weight just-in-time inside
+        # the step and reduce-scatters its gradient.  Param HBM drops by
+        # ~N x for ~2x the collective bytes — the standard trade once a
+        # model outgrows a chip.  The reference has no analog (its workers
+        # always held full replicas; only the SERVER side was split).
+        self.shard_params = shard_params
         self.state: Optional[TrainState] = None
         self._train_step = None
         self._eval_step = None
@@ -244,17 +252,29 @@ class Module:
         # are donated (observed XLA CPU bug, jax 0.9.0).
         donate = (0,) if jax.default_backend() != "cpu" else ()
         state_sharding = replicated
-        if self.shard_opt_state and mesh.shape.get("data", 1) > 1 \
-                and self.state is not None:
-            opt_sh = self._zero1_shardings(mesh, replicated)
-            # commit the live opt state to the sharded layout up front so
-            # the step compiles once (not once replicated + once sharded)
-            self.state = self.state.replace(opt_state=jax.tree_util.tree_map(
-                jax.device_put, self.state.opt_state, opt_sh))
+        dp = mesh.shape.get("data", 1) > 1 and self.state is not None
+        if dp and (self.shard_opt_state or self.shard_params):
             # build the sharding pytree FROM the live state so the static
             # treedef metadata (apply_fn/tx) matches the step's output
             state_sharding = jax.tree_util.tree_map(
-                lambda _: replicated, self.state).replace(opt_state=opt_sh)
+                lambda _: replicated, self.state)
+            if self.shard_opt_state:
+                opt_sh = self._dp_shardings(self.state.opt_state, mesh,
+                                            replicated)
+                state_sharding = state_sharding.replace(opt_state=opt_sh)
+            if self.shard_params:
+                par_sh = self._dp_shardings(self.state.params, mesh,
+                                            replicated)
+                state_sharding = state_sharding.replace(params=par_sh)
+            # commit the live state to the sharded layout up front so the
+            # step compiles once (not once replicated + once sharded)
+            self.state = self.state.replace(
+                opt_state=jax.tree_util.tree_map(
+                    jax.device_put, self.state.opt_state,
+                    state_sharding.opt_state),
+                params=jax.tree_util.tree_map(
+                    jax.device_put, self.state.params,
+                    state_sharding.params))
         self._train_step = jax.jit(train_step, donate_argnums=donate,
                                    out_shardings=(state_sharding, replicated,
                                                   mesh_lib.data_sharding(mesh)))
@@ -286,12 +306,14 @@ class Module:
         self._grad_step = jax.jit(grad_step)
         self._apply_step = jax.jit(apply_step)
 
-    def _zero1_shardings(self, mesh, replicated):
-        """Per-leaf shardings for ZeRO-1: each leaf is sharded over 'data'
-        along its LARGEST axis divisible by the data-axis size (a conv
-        momentum of shape (3,3,Cin,Cout) shards over Cout, a dense one over
-        its rows); scalars (e.g. Adam's step count) and leaves with no
-        divisible axis stay replicated."""
+    @staticmethod
+    def _dp_shardings(tree, mesh, replicated):
+        """Per-leaf shardings distributing a state tree over 'data': each
+        leaf is sharded along its LARGEST axis divisible by the data-axis
+        size (a conv kernel/momentum of shape (3,3,Cin,Cout) shards over
+        Cout, a dense one over its rows); scalars (e.g. Adam's step count)
+        and leaves with no divisible axis stay replicated.  Used for both
+        ZeRO-1 (opt state) and FSDP (params)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         n = mesh.shape["data"]
 
@@ -306,7 +328,7 @@ class Module:
             parts[ax] = "data"
             return NamedSharding(mesh, P(*parts))
 
-        return jax.tree_util.tree_map(spec, self.state.opt_state)
+        return jax.tree_util.tree_map(spec, tree)
 
     def _ensure_unravel(self):
         """(Re)build the flatten/unflatten closures for the flat-vector
