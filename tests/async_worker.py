@@ -45,6 +45,9 @@ def main():
     ap.add_argument("--host", required=True)
     ap.add_argument("--num-epoch", type=int, default=8)
     ap.add_argument("--out", required=True)
+    ap.add_argument("--elastic", action="store_true",
+                    help="use the ElasticDataIterator re-shard contract "
+                         "(membership may change at epoch boundaries)")
     args = ap.parse_args()
 
     x, y = make_dataset()
@@ -52,16 +55,29 @@ def main():
     kv = kvstore_lib.create("dist_async")
     kv.set_controller(ctrl)
 
-    # each worker trains on ITS shard, asynchronously
-    n, r = kv.num_workers, kv.rank
-    xs, ys = x[r::n], y[r::n]
-
     mod = Module(models.create("mlp", num_classes=2, hidden=(16,)),
                  optimizer="sgd",
                  optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
                  kvstore=kv, seed=5)
-    mod.fit(data.NDArrayIter(xs, ys, batch_size=16, shuffle=True, seed=r),
-            num_epoch=args.num_epoch)
+    if args.elastic:
+        def factory(num_parts, part_index, batch_size):
+            it = data.NDArrayIter(x, y, batch_size=batch_size,
+                                  shuffle=True, seed=99,
+                                  num_parts=num_parts,
+                                  part_index=part_index)
+            return it, None
+
+        eit = data.ElasticDataIterator(factory, 32,
+                                       fixed_per_worker_batch=True)
+        train, _ = eit.get_data_iterator(kv)
+        mod.fit(train, num_epoch=args.num_epoch,
+                elastic_data_iterator=eit)
+    else:
+        # each worker trains on ITS shard, asynchronously
+        n, r = kv.num_workers, kv.rank
+        mod.fit(data.NDArrayIter(x[r::n], y[r::n], batch_size=16,
+                                 shuffle=True, seed=r),
+                num_epoch=args.num_epoch)
 
     acc = dict(mod.score(data.NDArrayIter(x, y, batch_size=64), "acc"))
     flat, _ = jax.flatten_util.ravel_pytree(mod.state.params)

@@ -114,3 +114,64 @@ def test_dist_async_training_converges(tmp_path):
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
+
+
+def test_dist_async_elastic_add_remove(tmp_path):
+    """Membership changes while training through the async PS: a worker
+    joins at epoch 2 (adopting the live master weights via async_init's
+    init-or-get) and is removed at epoch 5 (WorkerRemoved -> clean exit).
+    The async plane composes with the fork's epoch-boundary elasticity —
+    a combination the reference supported in principle
+    (``!sync_mode_`` + MEMBERSHIP_CHANGE_BARRIER) but never tested."""
+    hw = str(tmp_path / "hosts")
+    with open(hw, "w") as f:
+        f.write("w0\nw1\n")
+    outs = {h: str(tmp_path / f"{h}.json") for h in ("w0", "w1", "w2")}
+    procs = {}
+
+    def spawn(host, extra_env=None):
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        procs[host] = subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "async_worker.py"),
+             "--scheduler-port", str(sched.port), "--host", host,
+             "--out", outs[host], "--elastic", "--num-epoch", "8"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+
+    def launch_new(host, epoch):
+        spawn(host, {"NEW_WORKER": "1", "EPOCH_BEGIN": str(epoch)})
+
+    def operator(epoch):
+        if epoch == 2:
+            with open(hw, "w") as f:
+                f.write("w0\nw1\nw2\n")
+        elif epoch == 5:
+            with open(hw, "w") as f:
+                f.write("w0\nw1\n")
+
+    sched = Scheduler(host_worker_file=hw, launch_callback=launch_new,
+                      pre_change_hook=operator)
+    try:
+        for h in ("w0", "w1"):
+            spawn(h)
+        for h in ("w0", "w1"):
+            rc = procs[h].wait(timeout=300)
+            assert rc == 0, f"{h}:\n{procs[h].stdout.read().decode()[-2000:]}"
+        assert "w2" in procs, "operator never launched the joiner"
+        assert procs["w2"].wait(timeout=60) == 0, \
+            procs["w2"].stdout.read().decode()[-2000:]
+        results = {h: json.load(open(outs[h]))
+                   for h in ("w0", "w1", "w2")}
+        for h, r in results.items():
+            assert r["final_acc"] > 0.9, (h, r)
+        # the joiner really trained between its join and removal (adopting
+        # live master weights, not exiting trivially)
+        assert results["w2"]["steps"] > 0, results["w2"]
+        # audit log recorded the cycle
+        log = open(hw + "_log").read()
+        assert "ADDED w2" in log and "REMOVED w2" in log, log
+    finally:
+        sched.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
