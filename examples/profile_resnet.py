@@ -1,0 +1,70 @@
+"""Profiling a training step — the reference's profiler example family.
+
+Reference: ``example/profiler/profiler_imageiter.py`` / ``profiler_ndarray.py``
+(``mx.profiler.set_config`` -> ``set_state('run')`` -> work ->
+``set_state('stop')`` -> ``dump()``).  Here the same surface drives
+``jax.profiler``: the dump is a Perfetto/TensorBoard trace directory with
+compiled-kernel timelines and HBM usage — open with
+``tensorboard --logdir <outdir>`` or ui.perfetto.dev.
+
+    python examples/profile_resnet.py --network resnet50 --steps 10
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet50")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--outdir", default="/tmp/dt_profile")
+    args = ap.parse_args()
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu import data, models
+    from dt_tpu.training import Module
+    from dt_tpu.utils import profiler
+
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (args.batch_size * 2, args.image_size,
+                            args.image_size, 3)).astype(np.float32)
+    y = rng.randint(0, 1000, len(x)).astype(np.int32)
+    mod = Module(models.create(args.network, num_classes=1000,
+                               dtype=jnp.bfloat16),
+                 optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+    # warm up OUTSIDE the profiled window so the trace shows steady-state
+    # steps, not the one-off compile
+    mod.fit(data.NDArrayIter(x, y, batch_size=args.batch_size), num_epoch=1)
+
+    profiler.set_config(filename=args.outdir)
+    profiler.set_state("run")
+    t0 = time.time()
+    with profiler.annotate("train_epoch"):
+        for _ in range(max(args.steps // 2, 1)):
+            mod.fit(data.NDArrayIter(x, y, batch_size=args.batch_size),
+                    num_epoch=1)
+    profiler.set_state("stop")
+    out = profiler.dump()
+    dt = time.time() - t0
+    n_steps = max(args.steps // 2, 1) * 2
+    print(f"profiled {n_steps} steps in {dt:.2f}s "
+          f"({n_steps * args.batch_size / dt:.1f} img/s)")
+    print(f"trace: {out}  (tensorboard --logdir {out}, or ui.perfetto.dev)")
+    assert os.path.isdir(out) and os.listdir(out), "no trace written"
+
+
+if __name__ == "__main__":
+    main()

@@ -96,3 +96,12 @@ def test_train_ssd_from_det_rec(tmp_path):
                                     rec_id=i))
     _run("train_ssd.py", "--rec", rec, "--steps", "2", "--batch-size", "4",
          "--image-size", "64", "--max-boxes", "2", "--log-every", "1")
+
+
+def test_profile_resnet_example(tmp_path):
+    out = str(tmp_path / "trace")
+    r = _run("profile_resnet.py", "--network", "resnet20_cifar",
+             "--image-size", "32", "--batch-size", "8", "--steps", "4",
+             "--outdir", out)
+    assert "trace:" in r.stdout
+    assert os.path.isdir(out) and os.listdir(out)
