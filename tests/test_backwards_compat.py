@@ -1,0 +1,52 @@
+"""Checkpoint format backwards compatibility.
+
+Reference: ``tests/nightly/model_backwards_compatibility_check/`` — models
+saved by OLD versions must keep loading and predicting identically.  The
+committed fixture (``tests/fixtures/golden_v1*``) was written by the
+round-2 ``save_checkpoint``; every future change to the TrainState
+serialization must keep loading it bit-exactly (or ship a migration and a
+new fixture generation documented in the commit).
+
+Regenerate (only when intentionally breaking the format):
+see the generation recipe in this file's git history / fixture meta.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from dt_tpu import data, models
+from dt_tpu.training import Module, checkpoint
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def test_golden_checkpoint_loads_and_predicts_identically():
+    meta = json.load(open(os.path.join(FIX, "golden_v1-meta.json")))
+    assert meta["format"] == "dt_tpu TrainState msgpack v1"
+
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (32, 8, 8, 3)).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+
+    # fresh Module of the recorded config; template init then restore
+    mod = Module(models.create(meta["model"], num_classes=4,
+                               hidden=tuple(meta["hidden"])),
+                 optimizer=meta["optimizer"],
+                 optimizer_params={"learning_rate": 1e-3},
+                 seed=meta["seed"])
+    mod.init_params(x[:16])
+    mod.state = checkpoint.load_checkpoint(
+        os.path.join(FIX, "golden_v1"), 2, mod.state)
+    assert int(mod.state.step) == 4  # 2 epochs x 2 batches
+
+    golden = np.load(os.path.join(FIX, "golden_v1_pred.npy"))
+    np.testing.assert_allclose(np.asarray(mod.predict(x[:8])), golden,
+                               rtol=1e-6, atol=1e-6)
+
+    # resume training from the restored state (optimizer slots intact —
+    # the capability the reference LOST on checkpoint, kvstore.py:551)
+    mod.fit(data.NDArrayIter(x, y, batch_size=16), num_epoch=3,
+            begin_epoch=2)
+    assert int(mod.state.step) == 6
