@@ -29,7 +29,10 @@ import time
 
 TOTAL_BUDGET_S = int(os.environ.get("DT_BENCH_TIMEOUT_S", "1500"))
 PREFLIGHT_TIMEOUT_S = int(os.environ.get("DT_BENCH_PREFLIGHT_TIMEOUT_S", "90"))
-_BACKOFFS_S = (15, 30, 60, 120, 120, 180, 180)
+# measurement needs this much tail budget; preflight retries consume the
+# rest (a wedged axon tunnel can take a long time to clear — retry for as
+# long as the budget allows rather than a fixed count)
+MEASURE_RESERVE_S = int(os.environ.get("DT_BENCH_MEASURE_RESERVE_S", "600"))
 BASELINE_IMGS_PER_SEC = 20.08  # reference ResNet-152 1-GPU img/s, batch 32
 
 
@@ -80,22 +83,32 @@ def guarded_main():
     deadline = time.monotonic() + TOTAL_BUDGET_S
     last_err = "preflight never attempted"
     ok = False
-    for i, backoff in enumerate(_BACKOFFS_S):
+    attempt = 0
+    backoff = 15
+    # retry while there's still enough budget for a probe + a useful
+    # measurement window; a late success is worth far more than an early
+    # give-up (round 1 recorded a zero for exactly this)
+    while True:
         remaining = deadline - time.monotonic()
-        if remaining <= PREFLIGHT_TIMEOUT_S:
+        if remaining <= PREFLIGHT_TIMEOUT_S + 30:
             last_err += " (budget exhausted during preflight retries)"
             break
+        attempt += 1
         rc, out = _run_child("--preflight",
                              min(PREFLIGHT_TIMEOUT_S, remaining))
         if rc == 0:
             ok = True
             break
-        last_err = (f"preflight attempt {i + 1}: "
+        last_err = (f"preflight attempt {attempt}: "
                     + ("timed out (wedged TPU tunnel?)" if rc is None
                        else f"rc={rc}: {out.strip()[-300:]}"))
-        if i + 1 < len(_BACKOFFS_S):
-            print(f"# {last_err}; backing off {backoff}s", file=sys.stderr)
-            time.sleep(min(backoff, max(0, deadline - time.monotonic())))
+        # don't sleep past the point where a success could still measure
+        spare = deadline - time.monotonic() - PREFLIGHT_TIMEOUT_S \
+            - MEASURE_RESERVE_S
+        wait = min(backoff, max(spare, 10))
+        print(f"# {last_err}; backing off {wait:.0f}s", file=sys.stderr)
+        time.sleep(max(0, min(wait, deadline - time.monotonic() - 30)))
+        backoff = min(backoff * 2, 180)
     if not ok:
         _emit_failure(f"preflight exhausted retries; last: {last_err}")
         return 0

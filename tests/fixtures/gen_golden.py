@@ -1,0 +1,47 @@
+"""Regenerate the golden checkpoint fixture — ONLY when intentionally
+breaking the TrainState serialization format (bump the version in the
+meta + filename, keep the old fixture loading via a migration, and update
+tests/test_backwards_compat.py to cover both).
+
+    python tests/fixtures/gen_golden.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from dt_tpu import data, models  # noqa: E402
+from dt_tpu.training import Module, checkpoint  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (32, 8, 8, 3)).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+    mod = Module(models.create("mlp", num_classes=4, hidden=(8,)),
+                 optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+                 seed=42)
+    mod.fit(data.NDArrayIter(x, y, batch_size=16), num_epoch=2)
+    path = checkpoint.save_checkpoint(
+        os.path.join(HERE, "golden_v1"), 2, mod.state,
+        meta={"model": "mlp", "hidden": [8], "num_classes": 4,
+              "optimizer": "adam", "seed": 42,
+              "format": "dt_tpu TrainState msgpack v1"})
+    np.save(os.path.join(HERE, "golden_v1_pred.npy"),
+            np.asarray(mod.predict(x[:8])))
+    print(path, os.path.getsize(path), "bytes")
+
+
+if __name__ == "__main__":
+    main()

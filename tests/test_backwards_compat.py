@@ -8,7 +8,7 @@ serialization must keep loading it bit-exactly (or ship a migration and a
 new fixture generation documented in the commit).
 
 Regenerate (only when intentionally breaking the format):
-see the generation recipe in this file's git history / fixture meta.
+``python tests/fixtures/gen_golden.py`` — and version the meta/filename.
 """
 
 import json
