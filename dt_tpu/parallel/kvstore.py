@@ -224,6 +224,34 @@ class DistAsyncKVStore(TPUSyncKVStore):
         if self._controller is not None:
             self._controller.set_optimizer(self._optimizer)
 
+    # -- the flat-vector async plane (shared by Module.fit and Trainer) ----
+
+    def _require_controller(self):
+        if self._controller is None:
+            raise RuntimeError(
+                "dist_async needs an elastic controller — "
+                "kv.set_controller(WorkerClient(...)) (or auto_client()); "
+                "without one this would silently train single-worker")
+        return self._controller
+
+    def attach_flat(self, key: str, optimizer_spec: dict,
+                    flat_params: np.ndarray) -> np.ndarray:
+        """One-call session setup: ship the optimizer spec, then
+        init-or-get the master weights under ``key`` (the first worker
+        seeds them; joiners/restarts adopt the live copy).  Returns the
+        authoritative flat weights.  Safe to re-call (both legs are
+        idempotent), so a failed attach is retried by just calling again."""
+        ctrl = self._require_controller()
+        spec = dict(optimizer_spec)
+        self.set_optimizer(spec.pop("name"), **spec)
+        return ctrl.async_init(key, np.asarray(flat_params))
+
+    def push_flat(self, key: str, flat_grad: np.ndarray) -> np.ndarray:
+        """Push one flat gradient, get back the post-update master
+        weights (``kvstore_dist_server.h:347`` ``!sync_mode_``)."""
+        return self._require_controller().async_push(
+            key, np.asarray(flat_grad))
+
 
 def create(name: str = "local", mesh=None) -> KVStore:
     """Reference ``mx.kv.create`` type-string dispatch

@@ -400,25 +400,19 @@ class Module:
 
         # --- dist_async: master weights live on the scheduler ---
         is_async = self.kv.type == "dist_async"
-        if is_async and self.kv._controller is None:
-            raise RuntimeError(
-                "dist_async needs an elastic controller — "
-                "kv.set_controller(WorkerClient(...)) (or auto_client()); "
-                "without one this would silently train single-worker")
         if is_async:
             if self._optimizer_spec is None:
                 raise ValueError(
                     "dist_async needs the optimizer as (name, hyperparams) "
                     "— pass optimizer='sgd' style, not an optax object "
                     "(the spec ships to the scheduler's updater)")
-            spec = dict(self._optimizer_spec)
-            self.kv.set_optimizer(spec.pop("name"), **spec)
             self._ensure_unravel()
             flat_p, _ = jax.flatten_util.ravel_pytree(self.state.params)
-            # init-or-get: the first worker seeds the master weights, every
-            # other worker (and any joiner) adopts the live server copy
-            cur = self.kv._controller.async_init(
-                "params", np.asarray(jax.device_get(flat_p)))
+            # attach = spec hand-off + init-or-get: the first worker seeds
+            # the master weights, every other worker (and any joiner)
+            # adopts the live server copy
+            cur = self.kv.attach_flat("params", self._optimizer_spec,
+                                      np.asarray(jax.device_get(flat_p)))
             self.state = self.state.replace(
                 params=self._unravel(jnp.asarray(cur)))
 
@@ -479,7 +473,7 @@ class Module:
                     self._ensure_unravel()  # None after elastic rebuilds
                     flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
-                    new_p = self.kv._controller.async_push(
+                    new_p = self.kv.push_flat(
                         "params", np.asarray(jax.device_get(flat_g)))
                     self.state = self.state.replace(
                         params=self._unravel(jnp.asarray(new_p)),

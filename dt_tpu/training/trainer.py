@@ -17,6 +17,7 @@ from typing import Any, Dict, Optional, Union
 
 import flax.serialization
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 import optax
 
@@ -28,15 +29,22 @@ class Trainer:
                  optimizer: Union[str, optax.GradientTransformation] = "sgd",
                  optimizer_params: Optional[Dict] = None,
                  kvstore: Union[str, kvstore_lib.KVStore] = "local"):
+        self._optimizer_spec = None
         if isinstance(optimizer, str):
             from dt_tpu import optim
+            self._optimizer_spec = {"name": optimizer,
+                                    **(optimizer_params or {})}
             optimizer = optim.create(optimizer, **(optimizer_params or {}))
         self.tx = optimizer
         self.params = params
-        self.opt_state = optimizer.init(params)
         self.kv = kvstore_lib.create(kvstore) if isinstance(kvstore, str) \
             else kvstore
+        # dist_async: the optimizer (and its slots) runs on the scheduler —
+        # don't allocate full-size local moment buffers that are never read
+        self.opt_state = None if self.kv.type == "dist_async" \
+            else optimizer.init(params)
         self._step_fn = None
+        self._unravel = None  # dist_async flat-vector plane (set on attach)
 
     def _build(self):
         tx = self.tx
@@ -62,10 +70,39 @@ class Trainer:
                              np.asarray(jax.device_get(flat)))
         return unravel(jnp.asarray(avg))
 
+    def _async_step(self, grads, rescale: float):
+        """dist_async data plane (reference Trainer with a ``dist_async``
+        store, ``gluon/trainer.py:254-281`` + ``kvstore_dist_server.h:347``)
+        via the kvstore's shared attach/push helpers: push the rescaled
+        gradient, adopt the post-update master weights; the optimizer (and
+        its slots) runs on the scheduler."""
+        import numpy as np
+        if self._unravel is None:
+            if self._optimizer_spec is None:
+                raise ValueError("dist_async Trainer takes the optimizer "
+                                 "as (name, hyperparams), not an optax "
+                                 "object (the spec ships to the server)")
+            flat, unravel = jax.flatten_util.ravel_pytree(self.params)
+            cur = self.kv.attach_flat("trainer_params",
+                                      self._optimizer_spec,
+                                      np.asarray(jax.device_get(flat)))
+            # commit the sentinel only after the attach succeeded — a
+            # failed attach is retried whole on the next step()
+            self.params = unravel(jnp.asarray(cur))
+            self._unravel = unravel
+        flat_g, _ = jax.flatten_util.ravel_pytree(
+            jax.tree_util.tree_map(lambda g: g * rescale, grads))
+        new = self.kv.push_flat("trainer_params",
+                                np.asarray(jax.device_get(flat_g)))
+        self.params = self._unravel(jnp.asarray(new))
+        return self.params
+
     def step(self, grads, batch_size: int = 1,
              ignore_stale_grad: bool = False):
         """Rescale by 1/batch_size, sync, update (reference
         ``Trainer.step``)."""
+        if self.kv.type == "dist_async":
+            return self._async_step(grads, 1.0 / batch_size)
         if self._step_fn is None:
             self._build()
         grads = self.allreduce_grads(grads)
@@ -79,8 +116,16 @@ class Trainer:
 
     def save_states(self, fname: str):
         """Serialize optimizer state (reference ``Trainer.save_states`` —
-        which the reference could NOT do in dist mode; here it always
-        works)."""
+        which the reference could NOT do in dist mode; here it works for
+        every store EXCEPT ``dist_async``, whose slots live in the
+        scheduler's updater — the same server-side-state limitation as the
+        reference's dist mode (``kvstore.py:551``), and it raises just as
+        loudly instead of silently writing the unused local state."""
+        if self.kv.type == "dist_async":
+            raise RuntimeError(
+                "dist_async optimizer slots live on the scheduler; "
+                "save_states would serialize unused local state "
+                "(reference dist-mode limitation, kvstore.py:551)")
         blob = flax.serialization.msgpack_serialize(
             flax.serialization.to_state_dict(jax.device_get(self.opt_state)))
         with open(fname, "wb") as f:

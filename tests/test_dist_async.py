@@ -175,3 +175,32 @@ def test_dist_async_elastic_add_remove(tmp_path):
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
+
+
+def test_trainer_dist_async_step():
+    """Gluon-Trainer surface over the async PS: step pushes the rescaled
+    grad and adopts the server's post-update weights (server-side SGD
+    math asserted)."""
+    import jax.numpy as jnp
+
+    from dt_tpu.elastic.client import WorkerClient
+    from dt_tpu.training.trainer import Trainer
+
+    sched = Scheduler(initial_workers=["t0"])
+    ctrl = None
+    try:
+        ctrl = WorkerClient("127.0.0.1", sched.port, host="t0")
+        kv = kvstore_lib.create("dist_async")
+        kv.set_controller(ctrl)
+        params = {"w": jnp.ones(4), "b": jnp.zeros(2)}
+        tr = Trainer(params, "sgd", {"learning_rate": 0.1}, kvstore=kv)
+        grads = {"w": jnp.full(4, 2.0), "b": jnp.full(2, 4.0)}
+        out = tr.step(grads, batch_size=2)  # rescale 1/2
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0 - 0.1, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["b"]), -0.2, rtol=1e-6)
+        out = tr.step(grads, batch_size=2)
+        np.testing.assert_allclose(np.asarray(out["w"]), 0.8, rtol=1e-6)
+    finally:
+        if ctrl is not None:
+            ctrl.close()
+        sched.close()
