@@ -266,6 +266,24 @@ class WorkerClient:
                          "value": np.asarray(grad)})["value"]
         return np.asarray(out)
 
+    def async_push_sparse(self, key: str, ids, vals) -> dict:
+        """Row-sparse async push: ship (ids, rows), the server applies a
+        LAZY update to the touched rows and returns just their new values
+        as ``{"ids", "vals"}`` — O(touched) both ways
+        (``kvstore_dist.h:690-748`` + sparse ``optimizer_op.cc``)."""
+        seq = self._ar_seq.get(("async", key), 0)
+        self._ar_seq[("async", key)] = seq + 1
+        return self._req({"cmd": "async_push", "host": self.host,
+                          "key": key, "seq": seq,
+                          "value": {"ids": np.asarray(ids),
+                                    "vals": np.asarray(vals)}})["value"]
+
+    def async_pull_rows(self, key: str, ids) -> dict:
+        """Pull only the requested rows of the master table (the
+        reference's RowSparsePull, ``kvstore_dist.h:317-376``)."""
+        return self._req({"cmd": "async_pull_rows", "key": key,
+                          "ids": np.asarray(ids)})
+
     def close(self):
         self._stop.set()
 

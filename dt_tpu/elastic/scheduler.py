@@ -244,6 +244,19 @@ class Scheduler:
         if cmd == "async_push":
             return self._async_push(msg["host"], msg["key"], msg["value"],
                                     int(msg.get("seq", -1)))
+        if cmd == "async_pull_rows":
+            with self._async_lock:
+                stored = self._async_store.get(msg["key"])
+                if stored is None:
+                    return {"error":
+                            f"async_pull_rows: key {msg['key']!r} not "
+                            "initialized"}
+                ids = np.asarray(msg["ids"]).ravel()
+                keep = (ids >= 0) & (ids < stored.shape[0])
+                # row_sparse_pull (kvstore_dist.h:317-376): only the
+                # requested live rows travel, never the whole table
+                return {"ids": ids[keep], "vals": stored[ids[keep]],
+                        "num_rows": int(stored.shape[0])}
         if cmd == "membership":
             with self._lock:
                 return {"workers": list(self._workers)}
@@ -629,6 +642,23 @@ class Scheduler:
             stored = self._async_store.get(key)
             if stored is None:
                 return {"error": f"async_push: key {key!r} not initialized"}
+            if isinstance(value, dict) and "ids" in value:
+                # row-sparse push: lazy server-side update of the touched
+                # rows only; the response carries just those rows back
+                # (O(touched) both ways — kvstore_dist.h:690-748 +
+                # optimizer_op.cc sparse variants)
+                ids = np.asarray(value["ids"]).ravel()
+                try:
+                    new = self._async_updater.sparse(
+                        key, ids, np.asarray(value["vals"]), stored)
+                except ValueError as e:
+                    return {"error": f"async_push sparse: {e}"}
+                self._async_store[key] = new
+                keep = (ids >= 0) & (ids < new.shape[0])
+                uniq = np.unique(ids[keep])
+                resp = {"ids": uniq, "vals": new[uniq]}
+                self._async_served[(host, key)] = (seq, resp)
+                return {"value": resp}
             new = self._async_updater(key, np.asarray(value), stored)
             self._async_store[key] = new
             self._async_served[(host, key)] = (seq, new)

@@ -51,6 +51,57 @@ class NpUpdater:
                            "momentum": self.momentum,
                            "weight_decay": self.wd}
 
+    def sparse(self, key: str, ids: np.ndarray, vals: np.ndarray,
+               stored: np.ndarray) -> np.ndarray:
+        """LAZY row-sparse update: only the pushed rows move (the
+        reference's sparse optimizer semantics, ``optimizer_op.cc``
+        row_sparse sgd/adagrad: untouched rows' momentum does NOT decay).
+        ``ids`` may contain duplicates (pre-summed upstream or not — they
+        are summed here); returns the updated ``stored`` (mutated rows
+        only).  Restricted to sgd/adagrad: lazy adam needs per-row step
+        counts the reference doesn't implement either."""
+        if self.name == "adam":
+            raise ValueError(
+                "lazy sparse updates support sgd/adagrad (the reference's "
+                "row_sparse optimizer set, optimizer_op.cc); adam's bias "
+                "correction is global")
+        ids = np.asarray(ids).ravel()
+        vals = np.asarray(vals, np.float32)
+        keep = (ids >= 0) & (ids < stored.shape[0])
+        if not keep.all():
+            import logging
+            logging.getLogger("dt_tpu").warning(
+                "sparse push %s: %d row id(s) outside the registered "
+                "table (%d rows) dropped — client/server vocab mismatch?",
+                key, int((~keep).sum()), stored.shape[0])
+        ids, vals = ids[keep], vals[keep]
+        uniq, inv = np.unique(ids, return_inverse=True)
+        g = np.zeros((len(uniq),) + vals.shape[1:], np.float32)
+        np.add.at(g, inv, vals)
+        # COPY before mutating: np.asarray would alias a float32 stored
+        # array, writing through every holder of it (the scheduler's
+        # replay cache serves by reference)
+        w = np.array(stored, np.float32)
+        rows = w[uniq]
+        slot = self._slots.setdefault(key, {})
+        if self.name == "sgd":
+            g = g + self.wd * rows
+            if self.momentum:
+                m = slot.get("m")
+                if m is None:
+                    m = slot["m"] = np.zeros_like(w)
+                m[uniq] = self.momentum * m[uniq] + g  # touched rows only
+                g = m[uniq]
+            w[uniq] = rows - self.lr * g
+        else:  # adagrad
+            h = slot.get("h")
+            if h is None:
+                h = slot["h"] = np.zeros_like(w)
+            h[uniq] = h[uniq] + g * g
+            w[uniq] = rows - self.lr * (g / np.sqrt(h[uniq] + self.eps)
+                                        + self.wd * rows)
+        return w.astype(stored.dtype, copy=False)  # w is already a copy
+
     def __call__(self, key: str, grad: np.ndarray,
                  stored: np.ndarray) -> np.ndarray:
         g = np.asarray(grad, np.float32)

@@ -252,6 +252,29 @@ class DistAsyncKVStore(TPUSyncKVStore):
         return self._require_controller().async_push(
             key, np.asarray(flat_grad))
 
+    def push_sparse(self, key: str, rs):
+        """Row-sparse async push (embedding-table workloads): the server
+        lazily updates only the touched rows and this returns them as a
+        ``RowSparse`` over the master table — O(touched rows) on the wire
+        each way.  The table itself is registered once via
+        ``attach_flat``-style ``async_init`` with the dense value."""
+        from dt_tpu.ops.sparse import RowSparse
+        import jax.numpy as jnp
+        out = self._require_controller().async_push_sparse(
+            key, np.asarray(rs.indices), np.asarray(rs.values))
+        return RowSparse(jnp.asarray(out["ids"], jnp.int32),
+                         jnp.asarray(out["vals"]), rs.num_rows)
+
+    def pull_rows(self, key: str, row_ids):
+        """Async ``row_sparse_pull`` (``kvstore_dist.h:317-376``): fetch
+        only the requested master-table rows."""
+        from dt_tpu.ops.sparse import RowSparse
+        import jax.numpy as jnp
+        out = self._require_controller().async_pull_rows(
+            key, np.asarray(row_ids))
+        return RowSparse(jnp.asarray(out["ids"], jnp.int32),
+                         jnp.asarray(out["vals"]), int(out["num_rows"]))
+
 
 def create(name: str = "local", mesh=None) -> KVStore:
     """Reference ``mx.kv.create`` type-string dispatch

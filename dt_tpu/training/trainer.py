@@ -28,7 +28,13 @@ class Trainer:
     def __init__(self, params: Any,
                  optimizer: Union[str, optax.GradientTransformation] = "sgd",
                  optimizer_params: Optional[Dict] = None,
-                 kvstore: Union[str, kvstore_lib.KVStore] = "local"):
+                 kvstore: Union[str, kvstore_lib.KVStore] = "local",
+                 async_key: str = "trainer_params"):
+        """``async_key`` names this Trainer's master-weight vector on the
+        dist_async scheduler.  Workers of ONE job share the default; give
+        each distinct param group (multiple Trainers against the same
+        scheduler) its own key, or the second group would init-or-get the
+        first's weights."""
         self._optimizer_spec = None
         if isinstance(optimizer, str):
             from dt_tpu import optim
@@ -44,6 +50,7 @@ class Trainer:
         self.opt_state = None if self.kv.type == "dist_async" \
             else optimizer.init(params)
         self._step_fn = None
+        self._async_key = async_key
         self._unravel = None  # dist_async flat-vector plane (set on attach)
 
     def _build(self):
@@ -83,7 +90,7 @@ class Trainer:
                                  "as (name, hyperparams), not an optax "
                                  "object (the spec ships to the server)")
             flat, unravel = jax.flatten_util.ravel_pytree(self.params)
-            cur = self.kv.attach_flat("trainer_params",
+            cur = self.kv.attach_flat(self._async_key,
                                       self._optimizer_spec,
                                       np.asarray(jax.device_get(flat)))
             # commit the sentinel only after the attach succeeded — a
@@ -92,7 +99,7 @@ class Trainer:
             self._unravel = unravel
         flat_g, _ = jax.flatten_util.ravel_pytree(
             jax.tree_util.tree_map(lambda g: g * rescale, grads))
-        new = self.kv.push_flat("trainer_params",
+        new = self.kv.push_flat(self._async_key,
                                 np.asarray(jax.device_get(flat_g)))
         self.params = self._unravel(jnp.asarray(new))
         return self.params
@@ -132,6 +139,11 @@ class Trainer:
             f.write(blob)
 
     def load_states(self, fname: str):
+        if self.kv.type == "dist_async":
+            raise RuntimeError(
+                "dist_async optimizer slots live on the scheduler; "
+                "load_states cannot restore them (reference dist-mode "
+                "limitation, kvstore.py:551)")
         with open(fname, "rb") as f:
             restored = flax.serialization.msgpack_restore(f.read())
         self.opt_state = flax.serialization.from_state_dict(

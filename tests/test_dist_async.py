@@ -204,3 +204,96 @@ def test_trainer_dist_async_step():
         if ctrl is not None:
             ctrl.close()
         sched.close()
+
+
+def test_async_sparse_push_lazy_semantics():
+    """Row-sparse async push: only touched rows move, momentum decays
+    only on touch (lazy sparse sgd, reference optimizer_op.cc row_sparse
+    variants), and responses carry just the touched rows."""
+    sched = Scheduler(initial_workers=["w0"])
+    try:
+        sched._dispatch({"cmd": "set_optimizer",
+                         "spec": {"name": "sgd", "learning_rate": 0.1,
+                                  "momentum": 0.9}})
+        table = np.zeros((6, 2), np.float32)
+        sched._dispatch({"cmd": "async_init", "key": "emb",
+                         "value": table})
+        # push rows 1,3 (and a duplicate of 1: summed server-side)
+        r = sched._dispatch({"cmd": "async_push", "host": "w0",
+                             "key": "emb", "seq": 0,
+                             "value": {"ids": np.array([1, 3, 1]),
+                                       "vals": np.ones((3, 2),
+                                                       np.float32)}})
+        out = r["value"]
+        np.testing.assert_array_equal(out["ids"], [1, 3])
+        np.testing.assert_allclose(out["vals"][0], -0.2, rtol=1e-6)  # 2x g
+        np.testing.assert_allclose(out["vals"][1], -0.1, rtol=1e-6)
+        stored = sched._async_store["emb"]
+        assert (stored[[0, 2, 4, 5]] == 0).all()  # untouched rows
+        # second push touching only row 3: row 1's momentum must NOT
+        # decay (lazy), row 3's must (0.9*1 + 1 = 1.9 -> -0.19 more)
+        r = sched._dispatch({"cmd": "async_push", "host": "w0",
+                             "key": "emb", "seq": 1,
+                             "value": {"ids": np.array([3]),
+                                       "vals": np.ones((1, 2),
+                                                       np.float32)}})
+        np.testing.assert_allclose(r["value"]["vals"][0], -0.1 - 0.19,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(sched._async_store["emb"][1], -0.2,
+                                   rtol=1e-6)  # row 1 untouched
+        # row_sparse_pull of live + out-of-range ids
+        r = sched._dispatch({"cmd": "async_pull_rows", "key": "emb",
+                             "ids": np.array([1, 99])})
+        np.testing.assert_array_equal(r["ids"], [1])
+        np.testing.assert_allclose(r["vals"][0], -0.2, rtol=1e-6)
+        assert r["num_rows"] == 6
+    finally:
+        sched.close()
+
+
+def test_async_sparse_rejects_adam():
+    sched = Scheduler(initial_workers=["w0"])
+    try:
+        sched._dispatch({"cmd": "set_optimizer",
+                         "spec": {"name": "adam", "learning_rate": 0.1}})
+        sched._dispatch({"cmd": "async_init", "key": "emb",
+                         "value": np.zeros((4, 2), np.float32)})
+        r = sched._dispatch({"cmd": "async_push", "host": "w0",
+                             "key": "emb", "seq": 0,
+                             "value": {"ids": np.array([0]),
+                                       "vals": np.ones((1, 2),
+                                                       np.float32)}})
+        assert "sparse" in r["error"] and "adam" in r["error"]
+    finally:
+        sched.close()
+
+
+def test_kvstore_sparse_async_roundtrip():
+    """push_sparse/pull_rows through the real wire (client + scheduler)
+    with RowSparse in/out."""
+    import jax.numpy as jnp
+
+    from dt_tpu.elastic.client import WorkerClient
+    from dt_tpu.ops.sparse import RowSparse
+
+    sched = Scheduler(initial_workers=["s0"])
+    ctrl = None
+    try:
+        ctrl = WorkerClient("127.0.0.1", sched.port, host="s0")
+        kv = kvstore_lib.create("dist_async")
+        kv.set_controller(ctrl)
+        kv.set_optimizer("adagrad", learning_rate=0.5)
+        ctrl.async_init("emb", np.zeros((8, 3), np.float32))
+        rs = RowSparse(jnp.asarray([2, 5], jnp.int32),
+                       jnp.ones((2, 3)), 8)
+        out = kv.push_sparse("emb", rs)
+        # adagrad: h=1 -> w -= 0.5 * 1/sqrt(1+eps)
+        np.testing.assert_allclose(np.asarray(out.values), -0.5, rtol=1e-4)
+        pulled = kv.pull_rows("emb", [5])
+        np.testing.assert_allclose(np.asarray(pulled.values)[0], -0.5,
+                                   rtol=1e-4)
+        assert pulled.num_rows == 8
+    finally:
+        if ctrl is not None:
+            ctrl.close()
+        sched.close()
