@@ -106,6 +106,13 @@ class Scheduler:
         # (kvstore_dist_server.h:347 !sync_mode_ — each push is applied
         # immediately, no aggregation barrier)
         self._async_lock = threading.Lock()
+        # mirror of the live-worker set for the async plane, guarded by
+        # _async_lock (NOT _lock): _async_push's dedup-cache eviction needs
+        # an up-to-date view without inverting the _lock -> _async_lock
+        # order, and a pre-snapshot under _lock would go stale by the time
+        # eviction runs (a just-registered host's fresh dedup entry must
+        # never be evicted as "departed")
+        self._async_live: Set[str] = set()
         self._async_store: Dict[str, np.ndarray] = {}
         self._async_updater = None
         self._async_served: Dict[tuple, tuple] = {}  # (host,key)->(seq,val)
@@ -288,6 +295,7 @@ class Scheduler:
             for key in [k for k in self._profile_posted if k[0] == host]:
                 del self._profile_posted[key]
             with self._async_lock:
+                self._async_live.add(host)
                 for key in [k for k in self._async_served if k[0] == host]:
                     del self._async_served[key]
             self._cv.notify_all()
@@ -340,6 +348,8 @@ class Scheduler:
                     self._removed_hosts.add(h)
                     self._base.discard(h)
                     self._append_log("REMOVED", h)
+                with self._async_lock:
+                    self._async_live -= set(dead)
                 self._rewrite_host_file(dead)
                 self._complete_pending_locked()
                 self._cv.notify_all()
@@ -454,6 +464,8 @@ class Scheduler:
             self._workers = [w for w in self._workers if w not in removable]
             self._removed_hosts |= removable
             self._registered -= removable
+            with self._async_lock:
+                self._async_live -= removable
             for h in removed:
                 self._append_log("REMOVED", h)
         else:
@@ -662,14 +674,13 @@ class Scheduler:
             new = self._async_updater(key, np.asarray(value), stored)
             self._async_store[key] = new
             self._async_served[(host, key)] = (seq, new)
-            if len(self._async_served) > 4 * max(len(self._workers), 1):
+            if len(self._async_served) > 4 * max(len(self._async_live), 1):
                 # bound the cache by dropping DEPARTED hosts' entries only —
                 # evicting a live worker's entry would re-open the
                 # double-apply window this dedup exists to close (live
                 # entries are bounded: one per (host, key))
-                live = set(self._workers)
                 for k in [k for k in self._async_served
-                          if k[0] not in live]:
+                          if k[0] not in self._async_live]:
                     del self._async_served[k]
             return {"value": new}
 

@@ -118,36 +118,37 @@ def entropy_calibrate(samples: np.ndarray, num_bins: int = 2048,
         return 1e-8
     hist, edges = np.histogram(samples, bins=num_bins, range=(0, amax))
     hist = hist.astype(np.float64)
-    p_full = hist / hist.sum()
     best_kl, best_t = np.inf, amax
-    # Sweep candidate thresholds.  KL is measured against the FULL
-    # distribution, with the candidate reconstructing clipped mass as
-    # saturation into its edge bin — comparing against the clipped
-    # distribution instead (as a naive reading of the algorithm does)
-    # makes the first candidate lossless (factor 1 -> q == p -> KL 0) and
-    # the sweep degenerates to always returning the smallest threshold.
+    # Sweep candidate thresholds.  Per the reference's algorithm: p is the
+    # clipped histogram with the saturated (outlier) mass folded into its
+    # edge bin, q is the int8 reconstruction built from the *non-outlier*
+    # sliced histogram, and KL runs over the clipped support only.  The
+    # outlier fold on p (and not q) is what keeps the sweep from
+    # degenerating: the smallest candidate reconstructs its in-range bins
+    # exactly (factor 1) but still pays for every clipped sample.
     for i in range(num_quantized_bins, num_bins + 1,
                    max((num_bins - num_quantized_bins) // 64, 1)):
         t = edges[i]
-        p = hist[:i].copy()
-        outlier = hist[i:].sum()
-        if p.sum() == 0:
+        sliced = hist[:i]
+        if sliced.sum() == 0:
             continue
+        p = sliced.copy()
+        p[i - 1] += hist[i:].sum()  # int8 saturates everything beyond t
         # quantize the in-range histogram into num_quantized_bins, expand
         factor = i / num_quantized_bins
-        q = np.zeros(num_bins)
+        q = np.zeros(i)
         for j in range(num_quantized_bins):
             lo = int(np.floor(j * factor))
             hi = min(int(np.ceil((j + 1) * factor)), i)
-            chunk = p[lo:hi]
+            chunk = sliced[lo:hi]
             nz = (chunk > 0).sum()
             if nz:
                 q[lo:hi][chunk > 0] = chunk[chunk > 0].sum() / nz
-        q[i - 1] += outlier  # int8 saturates everything beyond t
-        qn = q / q.sum()
-        mask = p_full > 0
-        kl = float(np.sum(p_full[mask] * np.log(
-            p_full[mask] / np.maximum(qn[mask], 1e-12))))
+        pn = p / p.sum()
+        qn = q / max(q.sum(), 1e-12)
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(
+            pn[mask] / np.maximum(qn[mask], 1e-12))))
         if kl < best_kl:
             best_kl, best_t = kl, t
     return float(best_t)

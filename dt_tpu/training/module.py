@@ -97,7 +97,7 @@ class Module:
                  kvstore: Union[str, kvstore_lib.KVStore] = "local",
                  mesh=None, mesh_manager=None, seed: int = 0,
                  remat: bool = False, shard_opt_state: bool = False,
-                 shard_params: bool = False):
+                 shard_params: bool = False, async_key: str = "params"):
         self.model = model
         self.loss_fn = loss_fn
         self._optimizer_spec = None
@@ -142,6 +142,13 @@ class Module:
         # model outgrows a chip.  The reference has no analog (its workers
         # always held full replicas; only the SERVER side was split).
         self.shard_params = shard_params
+        # dist_async: names this Module's master-weight vector on the
+        # scheduler.  Two Modules training against the same scheduler MUST
+        # use distinct keys — attach is init-or-get, so a shared key makes
+        # the second job silently adopt (and corrupt) the first job's
+        # master weights when sizes happen to match.  Mirrors
+        # Trainer(async_key=...).
+        self.async_key = async_key
         self.state: Optional[TrainState] = None
         self._train_step = None
         self._eval_step = None
@@ -411,7 +418,7 @@ class Module:
             # attach = spec hand-off + init-or-get: the first worker seeds
             # the master weights, every other worker (and any joiner)
             # adopts the live server copy
-            cur = self.kv.attach_flat("params", self._optimizer_spec,
+            cur = self.kv.attach_flat(self.async_key, self._optimizer_spec,
                                       np.asarray(jax.device_get(flat_p)))
             self.state = self.state.replace(
                 params=self._unravel(jnp.asarray(cur)))
@@ -474,7 +481,7 @@ class Module:
                     flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
                     new_p = self.kv.push_flat(
-                        "params", np.asarray(jax.device_get(flat_g)))
+                        self.async_key, np.asarray(jax.device_get(flat_g)))
                     self.state = self.state.replace(
                         params=self._unravel(jnp.asarray(new_p)),
                         batch_stats=self._unravel_stats(flat_s)
