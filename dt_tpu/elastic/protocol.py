@@ -11,8 +11,11 @@ launcher propagates env to workers) and every frame becomes
 ``b"DTH1" | len | hmac(tag|len) | payload | hmac(tag|len|payload)`` —
 the *header* MAC is verified before any payload buffering (an
 unauthenticated peer cannot make the receiver allocate), and the payload
-MAC before unpickling.  With no secret set the legacy unauthenticated
-framing is used (trusted single-host clusters, tests).  Mixed
+MAC before unpickling.  The launcher generates a per-job secret by
+default (``launcher/launch.py _ensure_secret``); running without one
+requires the explicit ``DT_ELASTIC_INSECURE=1`` opt-out and falls back to
+the legacy unauthenticated framing (trusted single-host clusters, tests
+that build schedulers/clients directly).  Mixed
 configurations fail loudly and immediately: an authenticated receiver
 rejects a legacy frame on the 4-byte tag; a legacy receiver sees the tag
 bytes as an absurd length and rejects it oversize.  The scheduler's bind
@@ -51,7 +54,21 @@ _MAC_SIZE = hashlib.sha256().digest_size
 _AUTH_TAG = b"DTH1"
 
 
+_SECRET_OVERRIDE: Optional[str] = None
+
+
+def set_secret(secret: Optional[str]) -> None:
+    """Process-local secret override (takes precedence over the env var).
+    The launcher uses this for its in-process scheduler so a generated
+    per-job secret never enters ``os.environ``, where every later
+    unrelated subprocess of the host program would inherit it."""
+    global _SECRET_OVERRIDE
+    _SECRET_OVERRIDE = secret or None
+
+
 def _secret() -> Optional[bytes]:
+    if _SECRET_OVERRIDE:
+        return _SECRET_OVERRIDE.encode()
     s = os.environ.get("DT_ELASTIC_SECRET", "")
     return s.encode() if s else None
 

@@ -130,3 +130,35 @@ def test_launch_ssh_requires_enough_hosts(tmp_path):
     with pytest.raises(ValueError):
         launch_ssh(2, ["true"], str(hostfile),
                    ssh_cmd=_fake_ssh(tmp_path), root_uri="127.0.0.1")
+
+
+def test_launch_ssh_secret_not_in_argv(tmp_path, monkeypatch):
+    """The auto-generated HMAC secret reaches ssh workers via stdin, NOT
+    the remote command line (argv is world-readable in process listings) —
+    and the workers are authenticated end-to-end."""
+    monkeypatch.delenv("DT_ELASTIC_SECRET", raising=False)
+    monkeypatch.delenv("DT_ELASTIC_INSECURE", raising=False)
+    hostfile = tmp_path / "host_worker"
+    hostfile.write_text("solo\n")
+    # shim logs the FULL remote command line for inspection
+    shim = tmp_path / "fake_ssh_logall"
+    shim.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        host="$1"; shift
+        printf '%s\\n' "$@" >> {tmp_path}/ssh_argv.log
+        exec env -i PATH="$PATH" HOME="$HOME" sh -c "$1"
+    """))
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    script = _trainee(tmp_path, extra=(
+        "assert len(os.environ.get('DT_ELASTIC_SECRET','')) >= 32, "
+        "'secret missing on remote'\n"
+        f"open({str(tmp_path)!r} + '/secret.out', 'w')"
+        ".write(os.environ['DT_ELASTIC_SECRET'])"))
+    rcs = launch_ssh(1, [sys.executable, script], str(hostfile),
+                     elastic=True, ssh_cmd=str(shim),
+                     root_uri="127.0.0.1", workdir=str(tmp_path))
+    assert rcs == {"solo": 0}, rcs
+    secret = open(str(tmp_path / "secret.out")).read()
+    argv_log = open(str(tmp_path / "ssh_argv.log")).read()
+    assert secret not in argv_log, "secret leaked into the ssh command line"
+    assert "read -r DT_ELASTIC_SECRET" in argv_log  # stdin hand-off used
