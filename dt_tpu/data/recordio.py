@@ -350,11 +350,17 @@ class ImageRecordIter:
             results = [f.result() for f in work]
         if self.augmenter is not None:
             results = [(self.augmenter(img), lab) for img, lab in results]
+        results = self._collect(results)
         imgs = [r[0] for r in results]
         labels = [r[1] for r in results]
         data = np.stack(imgs).astype(self.dtype)
         label = np.asarray(labels)
         return self._DataBatch(data, label, pad)
+
+    def _collect(self, results):
+        """Hook between decode+augment and batch stacking; subclasses
+        post-process (img, label) pairs serially here (det augmentation)."""
+        return results
 
     def __iter__(self):
         self.reset()
@@ -386,20 +392,51 @@ class ImageDetRecordIter(ImageRecordIter):
 
     def __init__(self, path_imgrec: str, data_shape: Sequence[int],
                  batch_size: int, max_objs: int = 16, obj_width: int = 5,
-                 pad_value: float = -1.0, **kwargs):
+                 pad_value: float = -1.0, det_augmenter=None, **kwargs):
         if kwargs.get("augmenter") is not None:
             # the classification augmenters transform only the image; a
-            # flip/crop here would silently desynchronize the box labels
-            # (the reference's det iterator has its own box-aware augment
-            # chain, image_det_aug_default.cc — not implemented yet)
+            # flip/crop here would silently desynchronize the box labels —
+            # pass det_augmenter (a dt_tpu.data.augment.DetAugmenter, the
+            # box-aware chain of image_det_aug_default.cc) instead
             raise ValueError(
                 "ImageDetRecordIter does not take the classification "
-                "augmenter (it would corrupt box labels); augment "
-                "image+boxes together downstream instead")
+                "augmenter (it would corrupt box labels); pass "
+                "det_augmenter=DetCompose(...) instead")
         self.max_objs = int(max_objs)
         self.obj_width = int(obj_width)
         self.pad_value = float(pad_value)
+        # box-aware augmentation chain; applied serially at collection
+        # time (stateful RandomState, same discipline as `augmenter`)
+        self.det_augmenter = det_augmenter
         super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+        from dt_tpu.data.augment import Resize
+        self._resize = Resize((self.data_shape[0], self.data_shape[1]))
+
+    def _collect(self, results):
+        """Apply the det chain to (img, boxes) together, then bring every
+        image to ``data_shape`` (crops/pads change the raw size; box
+        coordinates are normalized so only the image needs resizing)."""
+        th, tw = self.data_shape[0], self.data_shape[1]
+        out = []
+        for img, lab in results:
+            if self.det_augmenter is not None:
+                real = lab[:, 0] != self.pad_value
+                img, boxes = self.det_augmenter(img, lab[real])
+                if len(boxes) > self.max_objs:
+                    # same contract as _decode_one: never silently drop
+                    # ground truths (an augmenter that synthesizes boxes
+                    # must fit the declared capacity)
+                    raise ValueError(
+                        f"det_augmenter produced {len(boxes)} boxes, over "
+                        f"max_objs={self.max_objs}")
+                lab = np.full((self.max_objs, self.obj_width),
+                              self.pad_value, np.float32)
+                if len(boxes):
+                    lab[:len(boxes)] = boxes
+            if img.shape[:2] != (th, tw):
+                img = self._resize(img)
+            out.append((img, lab))
+        return out
 
     def _decode_one(self, i: int):
         lab, _, payload = unpack_label(self._records[i])

@@ -73,10 +73,14 @@ def main():
 
     if args.rec:
         from dt_tpu import data as data_lib
+        from dt_tpu.data.augment import ssd_train_augmenter
         det_iter = data_lib.ImageDetRecordIter(
             args.rec, (args.image_size, args.image_size, 3),
             args.batch_size, max_objs=args.max_boxes, shuffle=True,
-            seed=args.seed)
+            seed=args.seed,
+            # the reference SSD chain: color distortion + zoom-out pad +
+            # IoU-constrained crop + mirror (image_det_aug_default.cc)
+            det_augmenter=ssd_train_augmenter(seed=args.seed))
         det_stream = iter(det_iter)
 
         def next_batch(_rng):
