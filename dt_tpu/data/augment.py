@@ -238,7 +238,8 @@ class HSLJitter(Augmenter):
     """Additive jitter in HLS space (reference ``random_h/s/l``,
     ``image_aug_default.cc:495-520``): offsets drawn with the reference's
     pseudo-gaussian ``(u + 4u)/5`` scheme, added in OpenCV's u8 HLS ranges
-    (H wraps at 180; L/S clamp at 255), converted back to RGB u8."""
+    and clamped to their limits (H at [0,180], L/S at [0,255] — the
+    reference saturates rather than wraps), converted back to RGB u8."""
 
     def __init__(self, random_h: int = 0, random_s: int = 0,
                  random_l: int = 0, seed: int = 0):

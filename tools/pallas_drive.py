@@ -2,10 +2,13 @@
 oracles — run on a real TPU (also runs on CPU in interpret mode, slowly).
 
 Round-1 VERDICT item 5: prove the kernels help compiled, or delete them.
-Each line of output is a JSON record: {kernel, parity_max_abs_err,
-oracle_ms, pallas_ms, speedup}.
+Round-2 VERDICT items 3/9: sweep >= 3 shapes per kernel (batch/seq/
+channels; 1M/16M/64M for the 2-bit quantizer) so "wired into hot paths"
+never rests on one point.  Each line of output is a JSON record:
+{kernel, shape, parity_max_abs_err, oracle_ms, pallas_ms, speedup}.
 
-Usage:  python tools/pallas_drive.py            # default sizes
+Usage:  python tools/pallas_drive.py                       # full sweep
+        python tools/pallas_drive.py --only quantize_2bit  # one kernel
         DT_FORCE_CPU=1 python tools/pallas_drive.py --small   # smoke
 """
 
@@ -45,6 +48,8 @@ def main():
     ap.add_argument("--small", action="store_true",
                     help="tiny shapes (CPU interpret smoke)")
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--only", default=None,
+                    help="comma list of kernel names to run")
     args = ap.parse_args()
 
     from dt_tpu.config import maybe_force_cpu, enable_compilation_cache
@@ -59,9 +64,13 @@ def main():
 
     backend = jax.default_backend()
     rng = np.random.RandomState(0)
+    only = set(args.only.split(",")) if args.only else None
+
+    def wanted(name):
+        return only is None or name in only
 
     def emit(rec):
-        # print per-kernel, flushed: a crash in a later kernel must not
+        # print per-record, flushed: a crash in a later kernel must not
         # lose earlier evidence (round-2 lesson: the uint32-reduction crash
         # in quantize_2bit ate the LSTM/BN records)
         rec["backend"] = backend
@@ -69,90 +78,120 @@ def main():
             if rec["pallas_ms"] else None
         print(json.dumps(rec), flush=True)
 
-    # ---- LSTM: full sequence fwd+bwd, oracle cell vs fused cell ---------
-    T, B, I, H = (8, 8, 32, 32) if args.small else (64, 64, 512, 512)
     dt = jnp.float32 if args.small else jnp.bfloat16
-    w = rnn.LSTMWeights(
-        jnp.asarray(rng.randn(I, 4 * H) * 0.05, dt),
-        jnp.asarray(rng.randn(H, 4 * H) * 0.05, dt),
-        jnp.asarray(np.zeros(4 * H), jnp.float32))
-    x = jnp.asarray(rng.randn(T, B, I), dt)
-    h0 = jnp.zeros((1, B, H), dt)
-    c0 = jnp.zeros((1, B, H), dt)
 
-    def make_step(fused):
-        def loss(w):
-            outs, hT, cT = rnn.lstm(x, h0, c0, [w], fused=fused)
-            return jnp.sum(outs.astype(jnp.float32) ** 2)
-        return jax.jit(jax.value_and_grad(loss))  # jit ONCE; _timeit warms
+    # ---- LSTM: full sequence fwd+bwd, oracle cell vs fused cell ---------
+    if wanted("lstm_seq_fwd_bwd"):
+        lstm_shapes = ([(8, 8, 32, 32)] if args.small else
+                       [(64, 64, 512, 512),    # round-2 point
+                        (128, 32, 256, 256),   # long seq, small model
+                        (32, 128, 1024, 1024)])  # big batch, wide model
+        for T, B, I, H in lstm_shapes:
+            w = rnn.LSTMWeights(
+                jnp.asarray(rng.randn(I, 4 * H) * 0.05, dt),
+                jnp.asarray(rng.randn(H, 4 * H) * 0.05, dt),
+                jnp.asarray(np.zeros(4 * H), jnp.float32))
+            x = jnp.asarray(rng.randn(T, B, I), dt)
+            h0 = jnp.zeros((1, B, H), dt)
+            c0 = jnp.zeros((1, B, H), dt)
 
-    oracle_lstm, pallas_lstm = make_step(False), make_step(True)
-    emit({
-        "kernel": "lstm_seq_fwd_bwd",
-        "shape": f"T{T}xB{B}xI{I}xH{H} {dt.__name__}",
-        "parity_max_abs_err": _err(oracle_lstm(w), pallas_lstm(w)),
-        "oracle_ms": round(_timeit(oracle_lstm, w, iters=args.iters), 3),
-        "pallas_ms": round(_timeit(pallas_lstm, w, iters=args.iters), 3),
-    })
+            def make_step(fused, x=x, h0=h0, c0=c0):
+                def loss(w):
+                    outs, hT, cT = rnn.lstm(x, h0, c0, [w], fused=fused)
+                    return jnp.sum(outs.astype(jnp.float32) ** 2)
+                return jax.jit(jax.value_and_grad(loss))
+
+            oracle_lstm, pallas_lstm = make_step(False), make_step(True)
+            emit({
+                "kernel": "lstm_seq_fwd_bwd",
+                "shape": f"T{T}xB{B}xI{I}xH{H} {dt.__name__}",
+                "parity_max_abs_err": _err(oracle_lstm(w), pallas_lstm(w)),
+                "oracle_ms": round(_timeit(oracle_lstm, w,
+                                           iters=args.iters), 3),
+                "pallas_ms": round(_timeit(pallas_lstm, w,
+                                           iters=args.iters), 3),
+            })
 
     # ---- BN inference epilogue -----------------------------------------
-    N, HW, C = (4, 8, 64) if args.small else (64, 56, 256)
-    xb = jnp.asarray(rng.randn(N, HW, HW, C), dt)
-    gamma = jnp.asarray(rng.rand(C) + 0.5, jnp.float32)
-    beta = jnp.asarray(rng.randn(C), jnp.float32)
-    mean = jnp.asarray(rng.randn(C) * 0.1, jnp.float32)
-    var = jnp.asarray(rng.rand(C) + 0.5, jnp.float32)
+    if wanted("fused_bn_inference"):
+        bn_shapes = ([(4, 8, 64)] if args.small else
+                     [(64, 56, 256),    # round-2 point
+                      (32, 112, 64),    # early-layer: big spatial
+                      (8, 28, 512)])    # late-layer: channel-heavy
+        for N, HW, C in bn_shapes:
+            xb = jnp.asarray(rng.randn(N, HW, HW, C), dt)
+            gamma = jnp.asarray(rng.rand(C) + 0.5, jnp.float32)
+            beta = jnp.asarray(rng.randn(C), jnp.float32)
+            mean = jnp.asarray(rng.randn(C) * 0.1, jnp.float32)
+            var = jnp.asarray(rng.rand(C) + 0.5, jnp.float32)
 
-    oracle_bn = jax.jit(lambda x: nn.batch_norm(
-        x, gamma, beta, mean, var, training=False)[0])
-    pallas_bn = jax.jit(lambda x: kernels.fused_bn_inference(
-        x, gamma, beta, mean, var))
-    emit({
-        "kernel": "fused_bn_inference",
-        "shape": f"{N}x{HW}x{HW}x{C} {dt.__name__}",
-        "parity_max_abs_err": _err(oracle_bn(xb), pallas_bn(xb)),
-        "oracle_ms": round(_timeit(oracle_bn, xb, iters=args.iters), 3),
-        "pallas_ms": round(_timeit(pallas_bn, xb, iters=args.iters), 3),
-    })
+            oracle_bn = jax.jit(lambda x, g=gamma, b=beta, m=mean, v=var:
+                                nn.batch_norm(x, g, b, m, v,
+                                              training=False)[0])
+            pallas_bn = jax.jit(lambda x, g=gamma, b=beta, m=mean, v=var:
+                                kernels.fused_bn_inference(x, g, b, m, v))
+            emit({
+                "kernel": "fused_bn_inference",
+                "shape": f"{N}x{HW}x{HW}x{C} {dt.__name__}",
+                "parity_max_abs_err": _err(oracle_bn(xb), pallas_bn(xb)),
+                "oracle_ms": round(_timeit(oracle_bn, xb,
+                                           iters=args.iters), 3),
+                "pallas_ms": round(_timeit(pallas_bn, xb,
+                                           iters=args.iters), 3),
+            })
 
-    # ---- 2-bit gradient quantize ---------------------------------------
-    n = 1 << 14 if args.small else 1 << 24
-    g = jnp.asarray(rng.randn(n), jnp.float32)
-    r = jnp.zeros((n,), jnp.float32)
-
-    oracle_q = jax.jit(lambda g, r: compression.quantize_2bit(g, r, 0.5))
-    pallas_q = jax.jit(lambda g, r: kernels.quantize_2bit(g, r, 0.5))
-    emit({
-        "kernel": "quantize_2bit",
-        "shape": f"{n} f32",
-        "parity_max_abs_err": _err(oracle_q(g, r), pallas_q(g, r)),
-        "oracle_ms": round(_timeit(oracle_q, g, r, iters=args.iters), 3),
-        "pallas_ms": round(_timeit(pallas_q, g, r, iters=args.iters), 3),
-    })
+    # ---- 2-bit gradient quantize (1M/16M/64M sweep) ---------------------
+    if wanted("quantize_2bit"):
+        q_sizes = [1 << 14] if args.small else \
+            [1 << 20, 1 << 24, 1 << 26]
+        for n in q_sizes:
+            g = jnp.asarray(rng.randn(n), jnp.float32)
+            r = jnp.zeros((n,), jnp.float32)
+            oracle_q = jax.jit(
+                lambda g, r: compression.quantize_2bit(g, r, 0.5))
+            pallas_q = jax.jit(
+                lambda g, r: kernels.quantize_2bit(g, r, 0.5))
+            emit({
+                "kernel": "quantize_2bit",
+                "shape": f"{n} f32",
+                "parity_max_abs_err": _err(oracle_q(g, r), pallas_q(g, r)),
+                "oracle_ms": round(_timeit(oracle_q, g, r,
+                                           iters=args.iters), 3),
+                "pallas_ms": round(_timeit(pallas_q, g, r,
+                                           iters=args.iters), 3),
+            })
 
     # ---- flash attention fwd+bwd vs full-attention oracle ---------------
-    from dt_tpu.ops.pallas import attention as attn
-    from dt_tpu.parallel.ring_attention import full_attention
-    B, S, H, D = (1, 256, 2, 64) if args.small else (4, 2048, 8, 128)
-    qkv = [jnp.asarray(rng.randn(B, S, H, D) * 0.3, dt) for _ in range(3)]
+    if wanted("flash_attention_fwd_bwd"):
+        from dt_tpu.ops.pallas import attention as attn
+        from dt_tpu.parallel.ring_attention import full_attention
+        fa_shapes = ([(1, 256, 2, 64)] if args.small else
+                     [(4, 2048, 8, 128),   # round-2 point
+                      (8, 1024, 8, 128),   # shorter seq, bigger batch
+                      (1, 8192, 8, 128)])  # long-context: O(S^2) oracle
+        for B, S, H, D in fa_shapes:
+            qkv = [jnp.asarray(rng.randn(B, S, H, D) * 0.3, dt)
+                   for _ in range(3)]
 
-    def attn_loss(f):
-        def loss(q, k, v):
-            return jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
-        return jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+            def attn_loss(f):
+                def loss(q, k, v):
+                    return jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+                return jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
 
-    oracle_fa = attn_loss(lambda q, k, v: full_attention(
-        q, k, v, causal=True))
-    pallas_fa = attn_loss(lambda q, k, v: attn.flash_attention(
-        q, k, v, causal=True))
-    emit({
-        "kernel": "flash_attention_fwd_bwd",
-        "shape": f"B{B}xS{S}xH{H}xD{D} {dt.__name__}",
-        "parity_max_abs_err": _err(oracle_fa(*qkv), pallas_fa(*qkv)),
-        "oracle_ms": round(_timeit(oracle_fa, *qkv, iters=args.iters), 3),
-        "pallas_ms": round(_timeit(pallas_fa, *qkv, iters=args.iters), 3),
-    })
-
+            oracle_fa = attn_loss(lambda q, k, v: full_attention(
+                q, k, v, causal=True))
+            pallas_fa = attn_loss(lambda q, k, v: attn.flash_attention(
+                q, k, v, causal=True))
+            emit({
+                "kernel": "flash_attention_fwd_bwd",
+                "shape": f"B{B}xS{S}xH{H}xD{D} {dt.__name__}",
+                "parity_max_abs_err": _err(oracle_fa(*qkv),
+                                           pallas_fa(*qkv)),
+                "oracle_ms": round(_timeit(oracle_fa, *qkv,
+                                           iters=args.iters), 3),
+                "pallas_ms": round(_timeit(pallas_fa, *qkv,
+                                           iters=args.iters), 3),
+            })
 
 
 if __name__ == "__main__":
