@@ -200,7 +200,7 @@ def main():
         jsonl = os.environ.get("DT_BENCH_JSONL")
         if jsonl is None and result.get("backend") == "tpu":
             jsonl = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "BENCH_local_r03.jsonl")
+                                 "BENCH_local_r04.jsonl")
         if jsonl:
             with open(jsonl, "a") as f:
                 f.write(json.dumps(

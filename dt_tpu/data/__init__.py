@@ -11,6 +11,7 @@ after a membership change to re-shard.
 
 from dt_tpu.data.io import (
     DataBatch as DataBatch,
+    DataDesc as DataDesc,
     DataIter as DataIter,
     NDArrayIter as NDArrayIter,
     CSVIter as CSVIter,

@@ -19,9 +19,18 @@ import numpy as np
 
 
 class Augmenter:
-    """Composable augmenter: call with HWC array -> HWC array."""
+    """Composable augmenter: call with HWC array -> HWC array.
 
-    def __call__(self, img: np.ndarray) -> np.ndarray:
+    Every stochastic augmenter draws from ``rng`` when given one and from
+    its own seeded ``RandomState`` otherwise.  The explicit-``rng`` form is
+    what lets :class:`dt_tpu.data.recordio.ImageRecordIter` run the chain
+    INSIDE its decode pool with a per-record stream (seed = record
+    position), matching the reference's decode+augment-in-one-parallel-
+    region design (``iter_image_recordio_2.cc:335,364``) while keeping the
+    draws independent of thread scheduling.
+    """
+
+    def __call__(self, img: np.ndarray, rng=None) -> np.ndarray:
         raise NotImplementedError
 
 
@@ -29,9 +38,9 @@ class Compose(Augmenter):
     def __init__(self, *augs: Augmenter):
         self.augs = augs
 
-    def __call__(self, img):
+    def __call__(self, img, rng=None):
         for a in self.augs:
-            img = a(img)
+            img = a(img, rng)
         return img
 
 
@@ -43,14 +52,15 @@ class RandomCrop(Augmenter):
         self.pad = pad
         self._rng = np.random.RandomState(seed)
 
-    def __call__(self, img):
+    def __call__(self, img, rng=None):
+        rng = self._rng if rng is None else rng
         if self.pad:
             img = np.pad(img, ((self.pad, self.pad), (self.pad, self.pad),
                                (0, 0)), mode="reflect")
         h, w = img.shape[:2]
         th, tw = self.size
-        y = self._rng.randint(0, h - th + 1)
-        x = self._rng.randint(0, w - tw + 1)
+        y = rng.randint(0, h - th + 1)
+        x = rng.randint(0, w - tw + 1)
         return img[y:y + th, x:x + tw]
 
 
@@ -58,7 +68,7 @@ class CenterCrop(Augmenter):
     def __init__(self, size: Tuple[int, int]):
         self.size = size
 
-    def __call__(self, img):
+    def __call__(self, img, rng=None):
         h, w = img.shape[:2]
         th, tw = self.size
         y = (h - th) // 2
@@ -72,8 +82,9 @@ class RandomMirror(Augmenter):
     def __init__(self, seed: int = 0):
         self._rng = np.random.RandomState(seed)
 
-    def __call__(self, img):
-        if self._rng.rand() < 0.5:
+    def __call__(self, img, rng=None):
+        rng = self._rng if rng is None else rng
+        if rng.rand() < 0.5:
             return img[:, ::-1]
         return img
 
@@ -84,7 +95,7 @@ class Resize(Augmenter):
     def __init__(self, size: Tuple[int, int]):
         self.size = size
 
-    def __call__(self, img):
+    def __call__(self, img, rng=None):
         from PIL import Image
         mode = Image.fromarray(img.astype(np.uint8))
         return np.asarray(mode.resize((self.size[1], self.size[0]),
@@ -98,7 +109,7 @@ class Normalize(Augmenter):
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)
 
-    def __call__(self, img):
+    def __call__(self, img, rng=None):
         return (img.astype(np.float32) - self.mean) / self.std
 
 
@@ -111,18 +122,19 @@ class ColorJitter(Augmenter):
         self.b, self.c, self.s = brightness, contrast, saturation
         self._rng = np.random.RandomState(seed)
 
-    def __call__(self, img):
+    def __call__(self, img, rng=None):
+        rng = self._rng if rng is None else rng
         img = img.astype(np.float32)
         if self.b:
-            img = img * (1.0 + self._rng.uniform(-self.b, self.b))
+            img = img * (1.0 + rng.uniform(-self.b, self.b))
         if self.c:
             coef = np.array([0.299, 0.587, 0.114], np.float32)
-            alpha = 1.0 + self._rng.uniform(-self.c, self.c)
+            alpha = 1.0 + rng.uniform(-self.c, self.c)
             gray_mean = (img * coef).sum(-1, keepdims=True).mean()
             img = img * alpha + gray_mean * (1 - alpha)
         if self.s:
             coef = np.array([0.299, 0.587, 0.114], np.float32)
-            alpha = 1.0 + self._rng.uniform(-self.s, self.s)
+            alpha = 1.0 + rng.uniform(-self.s, self.s)
             gray = (img * coef).sum(-1, keepdims=True)
             img = img * alpha + gray * (1 - alpha)
         return img
@@ -145,19 +157,20 @@ class RandomResizedCrop(Augmenter):
         self.attempts = attempts
         self._rng = np.random.RandomState(seed)
 
-    def __call__(self, img):
+    def __call__(self, img, rng=None):
+        rng = self._rng if rng is None else rng
         h, w = img.shape[:2]
         area = float(h * w)
         for _ in range(self.attempts):
-            target = area * self._rng.uniform(*self.area)
-            r = self._rng.uniform(*self.ratio)
+            target = area * rng.uniform(*self.area)
+            r = rng.uniform(*self.ratio)
             ch = int(round(np.sqrt(target / r)))
             cw = int(round(np.sqrt(target * r)))
-            if self._rng.rand() > 0.5:
+            if rng.rand() > 0.5:
                 ch, cw = cw, ch
             if ch <= h and cw <= w:
-                y = self._rng.randint(0, h - ch + 1)
-                x = self._rng.randint(0, w - cw + 1)
+                y = rng.randint(0, h - ch + 1)
+                x = rng.randint(0, w - cw + 1)
                 return Resize(self.size)(img[y:y + ch, x:x + cw])
         # fallback: largest center crop at the target aspect
         th, tw = self.size
@@ -184,8 +197,9 @@ class PCALighting(Augmenter):
         self.std = float(noise_std)
         self._rng = np.random.RandomState(seed)
 
-    def __call__(self, img):
-        alpha = self._rng.normal(0.0, self.std, 3).astype(np.float32)
+    def __call__(self, img, rng=None):
+        rng = self._rng if rng is None else rng
+        alpha = rng.normal(0.0, self.std, 3).astype(np.float32)
         shift = _PCA_EIGVEC_SCALED @ alpha  # (3,) RGB
         out = img.astype(np.float32) + shift
         if np.issubdtype(img.dtype, np.integer):
@@ -247,17 +261,18 @@ class HSLJitter(Augmenter):
             int(random_h), int(random_s), int(random_l)
         self._rng = np.random.RandomState(seed)
 
-    def _offset(self, mag: int) -> float:
-        r = (self._rng.rand() + 4 * self._rng.rand()) / 5
+    def _offset(self, mag: int, rng) -> float:
+        r = (rng.rand() + 4 * rng.rand()) / 5
         return r * mag * 2 - mag
 
-    def __call__(self, img):
+    def __call__(self, img, rng=None):
+        rng = self._rng if rng is None else rng
         if not (self.random_h or self.random_s or self.random_l):
             return img
         hls = _rgb_to_hls_u8(np.clip(img, 0, 255).astype(np.uint8))
-        dh, ds, dl = (self._offset(self.random_h),
-                      self._offset(self.random_s),
-                      self._offset(self.random_l))
+        dh, ds, dl = (self._offset(self.random_h, rng),
+                      self._offset(self.random_s, rng),
+                      self._offset(self.random_l, rng))
         # reference clamps H at its [0,180] limit rather than wrapping
         hls[..., 0] = np.clip(hls[..., 0] + dh, 0, 180)
         hls[..., 1] = np.clip(hls[..., 1] + dl, 0, 255)
@@ -308,10 +323,12 @@ def imagenet_train_augmenter(size: int = 224, seed: int = 0,
 
 
 class DetAugmenter:
-    """Box-aware augmenter: ``(img, boxes) -> (img, boxes)``."""
+    """Box-aware augmenter: ``(img, boxes) -> (img, boxes)``; same
+    optional-``rng`` contract as :class:`Augmenter` (pass a per-record
+    stream to run the chain inside the decode pool)."""
 
-    def __call__(self, img: np.ndarray,
-                 boxes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    def __call__(self, img: np.ndarray, boxes: np.ndarray,
+                 rng=None) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
 
 
@@ -319,9 +336,9 @@ class DetCompose(DetAugmenter):
     def __init__(self, *augs: DetAugmenter):
         self.augs = augs
 
-    def __call__(self, img, boxes):
+    def __call__(self, img, boxes, rng=None):
         for a in self.augs:
-            img, boxes = a(img, boxes)
+            img, boxes = a(img, boxes, rng)
         return img, boxes
 
 
@@ -333,8 +350,8 @@ class DetImageOnly(DetAugmenter):
     def __init__(self, aug: Augmenter):
         self.aug = aug
 
-    def __call__(self, img, boxes):
-        return self.aug(img), boxes
+    def __call__(self, img, boxes, rng=None):
+        return self.aug(img, rng), boxes
 
 
 class DetRandomMirror(DetAugmenter):
@@ -345,8 +362,9 @@ class DetRandomMirror(DetAugmenter):
         self.prob = prob
         self._rng = np.random.RandomState(seed)
 
-    def __call__(self, img, boxes):
-        if self._rng.rand() < self.prob:
+    def __call__(self, img, boxes, rng=None):
+        rng = self._rng if rng is None else rng
+        if rng.rand() < self.prob:
             img = img[:, ::-1]
             if len(boxes):
                 boxes = boxes.copy()
@@ -368,16 +386,17 @@ class DetRandomPad(DetAugmenter):
         self.fill = fill_value
         self._rng = np.random.RandomState(seed)
 
-    def __call__(self, img, boxes):
-        if self._rng.rand() >= self.prob or self.max_scale <= 1.05:
+    def __call__(self, img, boxes, rng=None):
+        rng = self._rng if rng is None else rng
+        if rng.rand() >= self.prob or self.max_scale <= 1.05:
             return img, boxes
-        scale = self._rng.uniform(1.0, self.max_scale)
+        scale = rng.uniform(1.0, self.max_scale)
         if scale < 1.05:
             return img, boxes
         h, w = img.shape[:2]
         nh, nw = int(round(h * scale)), int(round(w * scale))
-        y0 = self._rng.randint(0, nh - h + 1)
-        x0 = self._rng.randint(0, nw - w + 1)
+        y0 = rng.randint(0, nh - h + 1)
+        x0 = rng.randint(0, nw - w + 1)
         canvas = np.full((nh, nw) + img.shape[2:], self.fill, img.dtype)
         canvas[y0:y0 + h, x0:x0 + w] = img
         if len(boxes):
@@ -428,19 +447,20 @@ class DetRandomCrop(DetAugmenter):
         self.emit_thresh = emit_overlap_thresh
         self._rng = np.random.RandomState(seed)
 
-    def _draw_crop(self, s: dict, img_ar: float) -> Optional[np.ndarray]:
-        scale = self._rng.uniform(s.get("min_scale", 0.3),
-                                  s.get("max_scale", 1.0)) + 1e-12
+    def _draw_crop(self, s: dict, img_ar: float,
+                   rng) -> Optional[np.ndarray]:
+        scale = rng.uniform(s.get("min_scale", 0.3),
+                            s.get("max_scale", 1.0)) + 1e-12
         min_r = max(s.get("min_ratio", 0.5) / img_ar, scale * scale)
         max_r = min(s.get("max_ratio", 2.0) / img_ar,
                     1.0 / (scale * scale))
         if min_r > max_r:
             return None
-        ratio = np.sqrt(self._rng.uniform(min_r, max_r))
+        ratio = np.sqrt(rng.uniform(min_r, max_r))
         cw = min(1.0, scale * ratio)
         ch = min(1.0, scale / ratio)
-        x0 = self._rng.uniform(0, 1 - cw)
-        y0 = self._rng.uniform(0, 1 - ch)
+        x0 = rng.uniform(0, 1 - cw)
+        y0 = rng.uniform(0, 1 - ch)
         return np.array([x0, y0, x0 + cw, y0 + ch], np.float32)
 
     def _emit(self, crop: np.ndarray,
@@ -471,15 +491,16 @@ class DetRandomCrop(DetAugmenter):
         out[:, 4] = np.clip((out[:, 4] - crop[1]) / ch, 0, 1)
         return out
 
-    def __call__(self, img, boxes):
-        if self._rng.rand() >= self.prob or not len(boxes):
+    def __call__(self, img, boxes, rng=None):
+        rng = self._rng if rng is None else rng
+        if rng.rand() >= self.prob or not len(boxes):
             return img, boxes
         h, w = img.shape[:2]
-        order = self._rng.permutation(len(self.samplers))
+        order = rng.permutation(len(self.samplers))
         for idx in order:
             s = self.samplers[idx]
             for _ in range(int(s.get("trials", 25))):
-                crop = self._draw_crop(s, w / h)
+                crop = self._draw_crop(s, w / h, rng)
                 if crop is None:
                     continue
                 lo = s.get("min_overlap", 0.0)
@@ -511,14 +532,76 @@ def ssd_crop_samplers() -> list:
     return bank
 
 
+class DetColorDistort(DetAugmenter):
+    """The det-pipeline color distortion
+    (``image_det_aug_default.cc:536-567``): per-channel offsets drawn
+    ``uniform(-1,1) * max_random_{hue,saturation,illumination}``, each
+    zeroed unless its own ``*_prob`` gate passes, added in OpenCV-u8 HLS
+    ranges (H clamped to [0,180], L/S to [0,255]); then an independent
+    contrast term ``c ~ uniform(-1,1) * max_random_contrast`` (same gate
+    scheme) applied as ``img * (1 + c)``.  The reference draws all four
+    offsets BEFORE evaluating any gate — the draw order is reproduced so a
+    seeded stream matches."""
+
+    def __init__(self, max_random_hue: int = 0, random_hue_prob: float = 0.0,
+                 max_random_saturation: int = 0,
+                 random_saturation_prob: float = 0.0,
+                 max_random_illumination: int = 0,
+                 random_illumination_prob: float = 0.0,
+                 max_random_contrast: float = 0.0,
+                 random_contrast_prob: float = 0.0, seed: int = 0):
+        self.max_h, self.p_h = int(max_random_hue), float(random_hue_prob)
+        self.max_s, self.p_s = (int(max_random_saturation),
+                                float(random_saturation_prob))
+        self.max_l, self.p_l = (int(max_random_illumination),
+                                float(random_illumination_prob))
+        self.max_c, self.p_c = (float(max_random_contrast),
+                                float(random_contrast_prob))
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img, boxes, rng=None):
+        rng = self._rng if rng is None else rng
+        if not (self.p_h or self.p_s or self.p_l or self.p_c):
+            return img, boxes
+        # reference order: draw h, s, l, c first, then the 4 prob gates
+        h = int(rng.uniform(-1, 1) * self.max_h)
+        s = int(rng.uniform(-1, 1) * self.max_s)
+        l = int(rng.uniform(-1, 1) * self.max_l)
+        c = rng.uniform(-1, 1) * self.max_c
+        h = h if rng.rand() < self.p_h else 0
+        s = s if rng.rand() < self.p_s else 0
+        l = l if rng.rand() < self.p_l else 0
+        c = c if rng.rand() < self.p_c else 0.0
+        if h or s or l:
+            hls = _rgb_to_hls_u8(np.clip(img, 0, 255).astype(np.uint8))
+            hls[..., 0] = np.clip(hls[..., 0] + h, 0, 180)
+            hls[..., 1] = np.clip(hls[..., 1] + l, 0, 255)
+            hls[..., 2] = np.clip(hls[..., 2] + s, 0, 255)
+            out = _hls_to_rgb_u8(hls)
+            img = out if np.issubdtype(img.dtype, np.integer)                 else out.astype(img.dtype)
+        if abs(c) > 1e-3:
+            out = img.astype(np.float32) * (1.0 + c)
+            img = (np.clip(out, 0, 255).astype(img.dtype)
+                   if np.issubdtype(img.dtype, np.integer) else out)
+        return img, boxes
+
+
 def ssd_train_augmenter(seed: int = 0) -> DetAugmenter:
-    """The reference SSD training chain: color distortion, zoom-out pad,
-    IoU-constrained crop, mirror (``image_det_aug_default.cc`` Process
-    order; resize-to-data_shape happens in the det iterator)."""
+    """The reference SSD training chain in ``image_det_aug_default.cc``
+    Process order — color distortion, mirror, zoom-out pad,
+    IoU-constrained crop (``:536,570,578,597``); resize-to-data_shape
+    happens in the det iterator.  Color settings follow the SSD example's
+    train.py (hue 18 / saturation 32 / illumination 32 at p=0.5 each,
+    contrast 0.3 at p=0.5)."""
     return DetCompose(
-        DetImageOnly(HSLJitter(random_h=18, random_s=32, random_l=32,
-                               seed=seed)),
-        DetRandomPad(prob=0.5, max_pad_scale=4.0, seed=seed + 1),
-        DetRandomCrop(seed=seed + 2),
-        DetRandomMirror(prob=0.5, seed=seed + 3),
+        DetColorDistort(max_random_hue=18, random_hue_prob=0.5,
+                        max_random_saturation=32,
+                        random_saturation_prob=0.5,
+                        max_random_illumination=32,
+                        random_illumination_prob=0.5,
+                        max_random_contrast=0.3, random_contrast_prob=0.5,
+                        seed=seed),
+        DetRandomMirror(prob=0.5, seed=seed + 1),
+        DetRandomPad(prob=0.5, max_pad_scale=4.0, seed=seed + 2),
+        DetRandomCrop(seed=seed + 3),
     )

@@ -2,10 +2,13 @@
 
 Reference: ``python/mxnet/gluon/data/`` — ``Dataset`` (random access),
 ``ArrayDataset``, transforms, ``Sampler`` zoo, ``DataLoader`` (batchify +
-shuffle + multi-worker prefetch).  Worker processes become a prefetch
-thread here (host-side batching is numpy; the heavy decode work already
-releases the GIL in PIL/numpy, and device feeding is the jit step's job).
-"""
+shuffle + multi-worker prefetch).  ``num_workers > 0`` forks a real
+N-process worker pool exactly like the reference's
+``dataloader.py:26-75`` (fork start method: the dataset is inherited by
+the workers, one BATCH per task, ``2 * num_workers`` batches in flight,
+results reordered to the sampler order); transform code that holds the
+GIL (pure-Python augmenters) therefore scales with processes, not
+threads."""
 
 from __future__ import annotations
 
@@ -13,7 +16,7 @@ from typing import Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from dt_tpu.data.io import DataBatch, DataIter, PrefetchingIter
+from dt_tpu.data.io import DataBatch, DataIter
 
 
 class Dataset:
@@ -129,14 +132,18 @@ def default_batchify(items: List) -> DataBatch:
 
 class DataLoader(DataIter):
     """Reference ``gluon.data.DataLoader``: dataset + sampler -> batches;
-    ``num_workers > 0`` enables background prefetch; ``last_batch`` in
-    {'keep','discard'}."""
+    ``num_workers > 0`` runs ``__getitem__`` + ``batchify_fn`` in that
+    many forked worker processes (the reference's multiprocessing pool,
+    ``gluon/data/dataloader.py:26-75``); ``last_batch`` in
+    {'keep','discard'}.  ``prefetch`` (default ``2 * num_workers``) is the
+    number of batches kept in flight."""
 
     def __init__(self, dataset: Dataset, batch_size: int,
                  shuffle: bool = False, sampler: Optional[Sampler] = None,
                  last_batch: str = "keep",
                  batchify_fn: Callable = default_batchify,
-                 num_workers: int = 0, seed: int = 0):
+                 num_workers: int = 0, seed: int = 0,
+                 prefetch: Optional[int] = None):
         super().__init__(batch_size)
         self.dataset = dataset
         if sampler is None:
@@ -147,9 +154,12 @@ class DataLoader(DataIter):
             raise ValueError(last_batch)
         self.last_batch = last_batch
         self.batchify_fn = batchify_fn
-        self._inner = _LoaderIter(self)
-        self._it: DataIter = PrefetchingIter(self._inner) if num_workers \
-            else self._inner
+        if num_workers > 0:
+            self._it: DataIter = _MPLoaderIter(
+                self, num_workers,
+                2 * num_workers if prefetch is None else max(prefetch, 1))
+        else:
+            self._it = _LoaderIter(self)
 
     def reset(self):
         self._it.reset()
@@ -162,6 +172,11 @@ class DataLoader(DataIter):
 
     def next(self) -> DataBatch:
         return self._it.next()
+
+    def close(self):
+        """Shut down worker processes (no-op for the in-process path)."""
+        if hasattr(self._it, "close"):
+            self._it.close()
 
 
 class _LoaderIter(DataIter):
@@ -192,3 +207,97 @@ class _LoaderIter(DataIter):
         self._cursor = end
         return self._loader.batchify_fn([self._loader.dataset[i]
                                          for i in idx])
+
+
+# worker-side state for _MPLoaderIter: installed by the pool initializer
+# (fork start method — inherited, never pickled, so unpicklable datasets
+# and closures work, matching the reference's worker_loop globals)
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _mp_worker_init(dataset, batchify_fn):
+    global _worker_dataset, _worker_batchify
+    _worker_dataset = dataset
+    _worker_batchify = batchify_fn
+
+
+def _mp_worker_batch(indices):
+    return _worker_batchify([_worker_dataset[i] for i in indices])
+
+
+class _MPLoaderIter(DataIter):
+    """N-process batch evaluation (reference ``gluon/data/dataloader.py``
+    ``DataLoader.__iter__`` multi-worker path): the fork pool inherits the
+    dataset, the master streams index lists, each task returns one
+    batchified batch, and ``prefetch`` tasks ride in flight.  Results pop
+    in submission order so the sampler order is preserved regardless of
+    worker timing."""
+
+    def __init__(self, loader: DataLoader, num_workers: int,
+                 prefetch: int):
+        super().__init__(loader.batch_size)
+        import multiprocessing as mp
+        self._loader = loader
+        self._prefetch = prefetch
+        self._pool = mp.get_context("fork").Pool(
+            num_workers, initializer=_mp_worker_init,
+            initargs=(loader.dataset, loader.batchify_fn))
+        self._order: List[int] = []
+        self._cursor = 0
+        self._consumed = 0  # next() calls since the order was generated
+        self._pending: List = []
+        self.reset()
+
+    def reset(self):
+        # prefetch advances _cursor ahead of consumption, so the
+        # regenerate-only-if-used check (same contract as _LoaderIter:
+        # construction + a for-loop's reset() must not burn a
+        # RandomSampler epoch) keys off batches actually handed out.
+        # When nothing was consumed the in-flight work IS the epoch
+        # prefix from cursor 0 — keep it rather than recompute it.
+        if self._consumed == 0 and self._order:
+            return
+        self._order = list(iter(self._loader.sampler))
+        self._consumed = 0
+        self._cursor = 0
+        # drain stale in-flight results (cheap: at most `prefetch`)
+        for r in self._pending:
+            try:
+                r.get()
+            except Exception:
+                pass
+        self._pending = []
+        self._fill()
+
+    def _fill(self):
+        while len(self._pending) < self._prefetch:
+            n = len(self._order)
+            if self._cursor >= n:
+                break
+            end = self._cursor + self.batch_size
+            if end > n and self._loader.last_batch == "discard":
+                self._cursor = n
+                break
+            idx = self._order[self._cursor:end]
+            self._cursor = end
+            self._pending.append(
+                self._pool.apply_async(_mp_worker_batch, (idx,)))
+
+    def next(self) -> DataBatch:
+        if not self._pending:
+            raise StopIteration
+        batch = self._pending.pop(0).get()
+        self._consumed += 1
+        self._fill()
+        return batch
+
+    def close(self):
+        self._pool.terminate()
+        self._pool.join()
+
+    def __del__(self):
+        try:
+            self._pool.terminate()
+        except Exception:
+            pass

@@ -30,6 +30,40 @@ class DataBatch:
         self.bucket_key = bucket_key  # set by bucketing iterators
 
 
+class DataDesc:
+    """Shape/dtype/layout descriptor for one iterator stream (reference
+    ``mx.io.DataDesc``, ``python/mxnet/io/io.py:39-90``): what
+    ``provide_data``/``provide_label`` advertise so a consumer can bind
+    buffers before the first batch."""
+
+    __slots__ = ("name", "shape", "dtype", "layout")
+
+    def __init__(self, name: str, shape: tuple, dtype=np.float32,
+                 layout: str = "NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.layout = layout
+
+    def __repr__(self):
+        return (f"DataDesc[{self.name},{self.shape},"
+                f"{self.dtype},{self.layout}]")
+
+    def __eq__(self, other):
+        return (isinstance(other, DataDesc)
+                and (self.name, self.shape, self.dtype, self.layout)
+                == (other.name, other.shape, other.dtype, other.layout))
+
+    def __hash__(self):
+        # hashable like the reference namedtuple (descs key buffer maps)
+        return hash((self.name, self.shape, self.dtype, self.layout))
+
+    def __iter__(self):
+        # reference parity: DataDesc unpacks like the (name, shape) tuple
+        # it replaced (io.py:83 "DataDesc is a namedtuple")
+        return iter((self.name, self.shape))
+
+
 class DataIter:
     """Iterator base.  Reference: ``mx.io.DataIter`` (reset/next/iter).
 
@@ -60,26 +94,58 @@ class DataIter:
         return None
 
 
+def _take(arr, sel: np.ndarray) -> np.ndarray:
+    """Gather rows ``sel`` as a dense numpy array.
+
+    - numpy: fancy index.
+    - scipy CSR: row-slice then densify (the reference keeps CSR for its
+      sparse-PS pull path, ``io.py:682``; on TPU the host boundary is
+      where sparse densifies — XLA wants static shapes).
+    - h5py.Dataset: h5py fancy indexing requires strictly increasing
+      unique indices (its ``io.py:700`` pain point too), so gather via
+      argsort + inverse permutation; duplicates (wrap-pad) via unique.
+    """
+    if isinstance(arr, np.ndarray):
+        return arr[sel]
+    mod = type(arr).__module__
+    if mod.startswith("scipy.sparse"):
+        return np.asarray(arr[sel].todense())
+    if mod.startswith("h5py"):
+        uniq, inverse = np.unique(sel, return_inverse=True)
+        return np.asarray(arr[uniq.tolist()])[inverse]
+    return np.asarray(arr)[sel]
+
+
 class NDArrayIter(DataIter):
     """In-memory iterator with sharding + shuffle + pad semantics.
 
-    Reference: ``mx.io.NDArrayIter``; ``last_batch_handle`` in
-    {'pad','discard','roll_over'} with reference behavior.  Sharding: this
-    part sees ``data[part_index::num_parts]`` (the reference's RecordIO
-    sharding is also strided by part).
+    Reference: ``mx.io.NDArrayIter`` (``python/mxnet/io/io.py:489-530``);
+    ``last_batch_handle`` in {'pad','discard','roll_over'} with reference
+    behavior.  ``data``/``label`` accept numpy arrays, ``h5py.Dataset``
+    objects (kept on disk; batches gathered per access) and
+    ``scipy.sparse.csr_matrix`` (densified per batch at the host
+    boundary).  ``provide_data``/``provide_label`` advertise
+    :class:`DataDesc` rows like the reference.  Sharding: this part sees
+    ``data[part_index::num_parts]`` (the reference's RecordIO sharding is
+    also strided by part).
     """
 
-    def __init__(self, data: np.ndarray, label: Optional[np.ndarray] = None,
+    def __init__(self, data, label=None,
                  batch_size: int = 32, shuffle: bool = False,
                  last_batch_handle: str = "pad", num_parts: int = 1,
-                 part_index: int = 0, seed: int = 0):
+                 part_index: int = 0, seed: int = 0,
+                 data_name: str = "data", label_name: str = "softmax_label"):
         super().__init__(batch_size)
         if not 0 <= part_index < num_parts:
             raise ValueError(f"part_index {part_index} not in [0, {num_parts})")
         if last_batch_handle not in ("pad", "discard", "roll_over"):
             raise ValueError(last_batch_handle)
+        # data/label: numpy ndarray, h5py.Dataset, or scipy CSR — all are
+        # consumed through _take/shape[0], no wrapping needed
         self._data = data
         self._label = label
+        self.data_name = data_name
+        self.label_name = label_name
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.num_parts = num_parts
@@ -90,7 +156,7 @@ class NDArrayIter(DataIter):
         self._setup_epoch()
 
     def _setup_epoch(self):
-        n = len(self._data)
+        n = self._data.shape[0]  # len() is a TypeError on scipy CSR
         idx = np.arange(n)
         if self.shuffle:
             rng = np.random.RandomState(self._seed + self._epoch)
@@ -136,9 +202,25 @@ class NDArrayIter(DataIter):
             pad = end - n
             sel = np.concatenate([sel, self._order[:pad]])  # wrap like reference
         self._cursor = end
-        data = self._data[sel]
-        label = self._label[sel] if self._label is not None else None
+        data = _take(self._data, sel)
+        label = _take(self._label, sel) if self._label is not None else None
         return DataBatch(data, label, pad)
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        """[DataDesc] for the data stream (reference ``provide_data``);
+        shape leads with batch_size like the reference's."""
+        shape = (self.batch_size,) + tuple(self._data.shape[1:])
+        dtype = getattr(self._data, "dtype", np.float32)
+        return [DataDesc(self.data_name, shape, dtype)]
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        if self._label is None:
+            return []
+        shape = (self.batch_size,) + tuple(self._label.shape[1:])
+        dtype = getattr(self._label, "dtype", np.float32)
+        return [DataDesc(self.label_name, shape, dtype)]
 
 
 class CSVIter(NDArrayIter):

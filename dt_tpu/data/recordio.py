@@ -216,14 +216,21 @@ class ImageRecordIter:
 
     Reference: ``ImageRecordIter`` (``src/io/iter_image_recordio_2.cc``) with
     ``num_parts``/``part_index`` sharding
-    (``src/io/image_iter_common.h:127-162``).  JPEG decode is PARALLEL
-    across the batch on a thread pool (``num_decode_threads``, default
-    ``DT_DECODE_THREADS`` or the CPU count — the role OMP played in the
-    reference's TJimdecode loop, ``iter_image_recordio_2.cc:75``); PIL/
-    libjpeg releases the GIL during decode so threads scale.  Decode of
-    the NEXT ``pipeline_batches`` batches is submitted before the current
-    one is returned, so decode overlaps consumption even without an outer
-    :class:`dt_tpu.data.io.PrefetchingIter` (add one — or
+    (``src/io/image_iter_common.h:127-162``).  JPEG decode AND
+    augmentation run PARALLEL across the batch on a thread pool
+    (``num_decode_threads``, default ``DT_DECODE_THREADS`` or the CPU
+    count — the role OMP played in the reference's decode+augment region,
+    ``iter_image_recordio_2.cc:335,364``); PIL/libjpeg releases the GIL
+    during decode so threads scale, and the augmenters are numpy (GIL
+    released in the kernels).  Each record's augmenter draws come from a
+    private stream seeded by ``(seed, epoch, position-in-epoch)`` —
+    deterministic regardless of thread scheduling (the reference instead
+    keeps one engine per worker thread, ``image_iter_common.h:123``, which
+    makes its output depend on the thread the record lands on; per-record
+    streams keep the parallel path byte-identical to the serial one).
+    Decode of the NEXT ``pipeline_batches`` batches is submitted before
+    the current one is returned, so decode overlaps consumption even
+    without an outer :class:`dt_tpu.data.io.PrefetchingIter` (add one — or
     ``DevicePrefetchIter`` — to also overlap host->device transfer).
     Records whose payload length equals ``prod(data_shape)`` (+raw
     float32 = 4x) are treated as raw arrays, so tests and synthetic packs
@@ -257,7 +264,7 @@ class ImageRecordIter:
                 max_workers=num_decode_threads,
                 thread_name_prefix="dt_decode")
         self._pipeline_batches = max(pipeline_batches, 1)
-        self._inflight: list = []  # [(sel, pad, [futures|images])]
+        self._inflight: list = []  # [(pad, [futures | (i, pos) pairs])]
         reader = RecordIOReader(path_imgrec, path_imgidx)
         self._records = reader.read_all()
         reader.close()
@@ -303,16 +310,26 @@ class ImageRecordIter:
         arr = np.asarray(img, np.uint8)
         return arr.astype(self.dtype)
 
-    def _decode_one(self, i: int):
-        # decode ONLY — augmenters are stateful (shared RandomState) and
-        # run serially at collection time, in batch order, so a seeded
-        # augmenter reproduces the exact serial-path draw sequence
+    def _record_rng(self, pos: int) -> np.random.RandomState:
+        """Private draw stream for the record at epoch position ``pos`` —
+        thread-schedule-independent, so pooled augmentation reproduces the
+        serial path exactly (see class docstring)."""
+        ss = np.random.SeedSequence([self._seed, self._epoch, int(pos)])
+        return np.random.RandomState(ss.generate_state(1)[0])
+
+    def _decode_one(self, i: int, pos: int):
+        # decode + augment, both inside the pool (the reference's OMP
+        # region does the same, iter_image_recordio_2.cc:335,364)
         lab, _, payload = unpack_label(self._records[i])
         img = self._decode(payload)
+        if self.augmenter is not None:
+            img = self.augmenter(img, rng=self._record_rng(pos))
         return img, (lab[0] if lab.size == 1 else lab)
 
     def _next_selection(self):
-        """(sel, pad) for the batch at the current cursor, advancing it."""
+        """(sel, positions, pad) for the batch at the current cursor,
+        advancing it.  ``positions`` are epoch-unique (wrap-pad tiles keep
+        counting up) so every sample gets a distinct augmenter stream."""
         n = len(self._order)
         if self._cursor >= n:
             return None
@@ -324,13 +341,15 @@ class ImageRecordIter:
             reps = -(-pad // n)
             sel = np.concatenate([sel] + [self._order] * reps)[
                 :self.batch_size]
+        positions = range(self._cursor, self._cursor + len(sel))
         self._cursor += self.batch_size
-        return sel, pad
+        return sel, positions, pad
 
-    def _submit(self, sel):
+    def _submit(self, sel, positions):
         if self._pool is None:
-            return sel  # decode lazily at collection time
-        return [self._pool.submit(self._decode_one, i) for i in sel]
+            return list(zip(sel, positions))  # decode at collection time
+        return [self._pool.submit(self._decode_one, i, p)
+                for i, p in zip(sel, positions)]
 
     def next(self):
         # keep `pipeline_batches` batches of decode work in flight so the
@@ -340,16 +359,14 @@ class ImageRecordIter:
             nxt = self._next_selection()
             if nxt is None:
                 break
-            self._inflight.append((nxt[1], self._submit(nxt[0])))
+            self._inflight.append((nxt[2], self._submit(nxt[0], nxt[1])))
         if not self._inflight:
             raise StopIteration
         pad, work = self._inflight.pop(0)
         if self._pool is None:
-            results = [self._decode_one(i) for i in work]
+            results = [self._decode_one(i, p) for i, p in work]
         else:
             results = [f.result() for f in work]
-        if self.augmenter is not None:
-            results = [(self.augmenter(img), lab) for img, lab in results]
         results = self._collect(results)
         imgs = [r[0] for r in results]
         labels = [r[1] for r in results]
@@ -358,8 +375,9 @@ class ImageRecordIter:
         return self._DataBatch(data, label, pad)
 
     def _collect(self, results):
-        """Hook between decode+augment and batch stacking; subclasses
-        post-process (img, label) pairs serially here (det augmentation)."""
+        """Hook between the pooled decode+augment and batch stacking, for
+        post-processing that genuinely needs the whole batch (none in the
+        base pipeline; kept as a subclass extension point)."""
         return results
 
     def __iter__(self):
@@ -405,40 +423,17 @@ class ImageDetRecordIter(ImageRecordIter):
         self.max_objs = int(max_objs)
         self.obj_width = int(obj_width)
         self.pad_value = float(pad_value)
-        # box-aware augmentation chain; applied serially at collection
-        # time (stateful RandomState, same discipline as `augmenter`)
+        # box-aware augmentation chain; runs inside the decode pool with a
+        # per-record stream (same discipline as `augmenter`)
         self.det_augmenter = det_augmenter
         super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
         from dt_tpu.data.augment import Resize
         self._resize = Resize((self.data_shape[0], self.data_shape[1]))
 
-    def _collect(self, results):
-        """Apply the det chain to (img, boxes) together, then bring every
-        image to ``data_shape`` (crops/pads change the raw size; box
-        coordinates are normalized so only the image needs resizing)."""
-        th, tw = self.data_shape[0], self.data_shape[1]
-        out = []
-        for img, lab in results:
-            if self.det_augmenter is not None:
-                real = lab[:, 0] != self.pad_value
-                img, boxes = self.det_augmenter(img, lab[real])
-                if len(boxes) > self.max_objs:
-                    # same contract as _decode_one: never silently drop
-                    # ground truths (an augmenter that synthesizes boxes
-                    # must fit the declared capacity)
-                    raise ValueError(
-                        f"det_augmenter produced {len(boxes)} boxes, over "
-                        f"max_objs={self.max_objs}")
-                lab = np.full((self.max_objs, self.obj_width),
-                              self.pad_value, np.float32)
-                if len(boxes):
-                    lab[:len(boxes)] = boxes
-            if img.shape[:2] != (th, tw):
-                img = self._resize(img)
-            out.append((img, lab))
-        return out
-
-    def _decode_one(self, i: int):
+    def _decode_one(self, i: int, pos: int):
+        """Decode + det-augment + resize-to-data_shape, all in the pool
+        (crops/pads change the raw size; box coordinates are normalized so
+        only the image needs resizing)."""
         lab, _, payload = unpack_label(self._records[i])
         img = self._decode(payload)
         flat = np.asarray(lab, np.float32).ravel()
@@ -452,7 +447,24 @@ class ImageDetRecordIter(ImageRecordIter):
                 f"record {i}: {k} objects exceed max_objs={self.max_objs}; "
                 "raise max_objs (fixed label capacity keeps the jit step "
                 "shape-stable)")
-        out = np.full((self.max_objs, self.obj_width), self.pad_value,
+        lab = np.full((self.max_objs, self.obj_width), self.pad_value,
                       np.float32)
-        out[:k] = flat.reshape(k, self.obj_width)
-        return img, out
+        lab[:k] = flat.reshape(k, self.obj_width)
+        if self.det_augmenter is not None:
+            real = lab[:, 0] != self.pad_value
+            img, boxes = self.det_augmenter(img, lab[real],
+                                            rng=self._record_rng(pos))
+            if len(boxes) > self.max_objs:
+                # never silently drop ground truths (an augmenter that
+                # synthesizes boxes must fit the declared capacity)
+                raise ValueError(
+                    f"det_augmenter produced {len(boxes)} boxes, over "
+                    f"max_objs={self.max_objs}")
+            lab = np.full((self.max_objs, self.obj_width),
+                          self.pad_value, np.float32)
+            if len(boxes):
+                lab[:len(boxes)] = boxes
+        th, tw = self.data_shape[0], self.data_shape[1]
+        if img.shape[:2] != (th, tw):
+            img = self._resize(img)
+        return img, lab

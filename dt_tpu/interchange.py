@@ -1,5 +1,7 @@
 """Model interchange: serve dt_tpu-trained weights from a third-party
-framework (torch), plus ONNX export when the onnx toolchain is present.
+framework (torch).  ONNX export/import lives in ``dt_tpu.onnx`` (self-
+contained protobuf codec — it runs in-container; the old torch.onnx
+gate here is retired).
 
 Reference surface: ``python/mxnet/contrib/onnx/`` (mx2onnx/onnx2mx) — the
 reference's model-interchange story, where a trained MXNet symbol+params
@@ -13,10 +15,9 @@ layers:
    third-party serving path, numerically parity-tested in
    ``tests/test_interchange.py`` — the proof that weights leave the
    framework losslessly.
-2. :func:`export_onnx` — ``torch.onnx.export`` of that serving module.
-   The container this framework is built in has no ``onnx`` package
-   (zero egress), so the export is gated: it raises a clear error
-   locally and runs wherever ``pip install onnx`` is possible.
+2. ONNX interchange moved to ``dt_tpu.onnx`` (round 4): a self-contained
+   protobuf codec that exports AND imports in-container, round-trip
+   parity-tested — no ``onnx`` package or torch required.
 
 Supported archs: mlp, lenet, resnet20/56/110 (CIFAR), resnet18/34/50/
 101/152 (v1 and _v2) — the families the reference's mx2onnx examples
@@ -274,16 +275,3 @@ def _build_module(arch, params, stats):
     mod.eval()
     return mod
 
-
-def export_onnx(arch: str, variables: Dict[str, Any], sample_nhwc,
-                path: str, opset: int = 13) -> str:
-    """Export via ``torch.onnx.export``.  Needs the ``onnx`` package
-    (absent in this zero-egress build container — run where it's
-    installable); raises its clear OnnxExporterError otherwise."""
-    import torch
-    serving = TorchServing(arch, variables)
-    x = torch.from_numpy(np.asarray(sample_nhwc, np.float32)) \
-        .permute(0, 3, 1, 2).contiguous()
-    torch.onnx.export(serving.module(), (x,), path, opset_version=opset,
-                      dynamo=False)
-    return path

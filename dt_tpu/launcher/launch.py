@@ -168,9 +168,16 @@ def _ssh_popen(host: str, command: List[str], env: dict, ssh_cmd: str,
     proc = subprocess.Popen(shlex.split(ssh_cmd) + [host, remote],
                             stdin=subprocess.PIPE if secret else None)
     if secret:
-        proc.stdin.write((secret + "\n").encode())
-        proc.stdin.flush()
-        proc.stdin.close()
+        try:
+            proc.stdin.write((secret + "\n").encode())
+            proc.stdin.flush()
+            proc.stdin.close()
+        except (BrokenPipeError, OSError) as e:
+            # ssh died before reading (dead host mid-elastic-relaunch):
+            # don't let the daemon launch thread die on the write — the
+            # reaper sees the nonzero exit and handles the failed worker
+            print(f"# launch: ssh to {host} exited before secret hand-off "
+                  f"({e})", file=sys.stderr)
     return proc
 
 

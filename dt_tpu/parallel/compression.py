@@ -115,12 +115,21 @@ def quantize_2bit_best(grad: jax.Array, residual: jax.Array,
     width.  The reference shipped CUDA kernels because its naive path was
     slow (``gradient_compression.cu``); here the naive path IS the fast
     path, so the Pallas kernel is retired behind ``DT_PALLAS_QUANT=1``
-    (kept for drive comparisons on future hardware)."""
-    import os
-    if os.environ.get("DT_PALLAS_QUANT", "") in ("1", "true"):
+    (kept for drive comparisons on future hardware).
+
+    NOTE: callers that jit this must read the env var OUTSIDE the traced
+    function (``_use_pallas_quant()``) — a read inside the trace is baked
+    in at compile time and later toggles would silently no-op
+    (ADVICE r3)."""
+    if _use_pallas_quant():
         from dt_tpu.ops.pallas import kernels
         return kernels.quantize_2bit(grad, residual, threshold)
     return quantize_2bit(grad, residual, threshold)
+
+
+def _use_pallas_quant() -> bool:
+    import os
+    return os.environ.get("DT_PALLAS_QUANT", "") in ("1", "true")
 
 
 class GradientCompression:
@@ -134,6 +143,7 @@ class GradientCompression:
         self._residual: np.ndarray = None
         self._residual_dev = None
         self._jit_compress = None
+        self._jit_uses_pallas = False
 
     def compress(self, grad: np.ndarray) -> np.ndarray:
         if self._residual is None or self._residual.shape != grad.shape:
@@ -149,11 +159,24 @@ class GradientCompression:
         boundary, and the error-feedback residual never leaves HBM.
         Routes through :func:`quantize_2bit_best` (fused jnp by default;
         Pallas behind ``DT_PALLAS_QUANT=1``)."""
+        use_pallas = _use_pallas_quant()  # read OUTSIDE jit: a read under
+        # trace is baked in for the cached program (ADVICE r3)
         if self._residual_dev is None or \
-                self._residual_dev.shape != grad.shape:
-            self._residual_dev = jnp.zeros(grad.shape, jnp.float32)
+                self._residual_dev.shape != grad.shape or \
+                use_pallas != self._jit_uses_pallas:
+            self._residual_dev = (
+                jnp.zeros(grad.shape, jnp.float32)
+                if self._residual_dev is None
+                or self._residual_dev.shape != grad.shape
+                else self._residual_dev)
+            if use_pallas:
+                from dt_tpu.ops.pallas import kernels
+                impl = kernels.quantize_2bit
+            else:
+                impl = quantize_2bit
             self._jit_compress = jax.jit(
-                lambda g, r: quantize_2bit_best(g, r, self.threshold))
+                lambda g, r: impl(g, r, self.threshold))
+            self._jit_uses_pallas = use_pallas
         packed, self._residual_dev = self._jit_compress(
             grad.astype(jnp.float32), self._residual_dev)
         return packed

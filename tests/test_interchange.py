@@ -11,7 +11,7 @@ torch = pytest.importorskip("torch")
 import jax  # noqa: E402
 
 from dt_tpu import models  # noqa: E402
-from dt_tpu.interchange import TorchServing, export_onnx  # noqa: E402
+from dt_tpu.interchange import TorchServing  # noqa: E402
 
 
 def _flax_logits(model, variables, x):
@@ -87,30 +87,13 @@ def test_trained_checkpoint_serves_from_torch(tmp_path):
     assert (got.argmax(1) == ref.argmax(1)).all()
 
 
-def test_export_onnx_gated():
-    """The ONNX file itself needs the onnx package (absent in the build
-    container); the export path must fail with torch's clear exporter
-    error, not something cryptic."""
-    pytest.importorskip("torch")
-    try:
-        import onnx  # noqa: F401
-        have_onnx = True
-    except ImportError:
-        have_onnx = False
-    rng = np.random.RandomState(0)
-    model = models.create("mlp", num_classes=3, hidden=(8,))
-    x = rng.randn(1, 4, 4, 3).astype(np.float32)
-    variables = model.init({"params": jax.random.PRNGKey(0)}, x,
-                           training=False)
-    import tempfile
-    path = tempfile.mktemp(suffix=".onnx")
-    if have_onnx:
-        out = export_onnx("mlp", variables, x, path)
-        import os
-        assert os.path.getsize(out) > 0
-    else:
-        with pytest.raises(Exception, match="onnx"):
-            export_onnx("mlp", variables, x, path)
+def test_export_onnx_moved_to_dt_tpu_onnx():
+    """The torch.onnx gated path is retired; dt_tpu.onnx exports without
+    the onnx package (full round-trip coverage in tests/test_onnx.py)."""
+    assert not hasattr(__import__("dt_tpu.interchange",
+                                  fromlist=["x"]), "export_onnx")
+    from dt_tpu import onnx as donnx
+    assert callable(donnx.export_onnx) and callable(donnx.import_onnx)
 
 
 def test_unsupported_arch_raises():

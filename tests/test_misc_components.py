@@ -89,6 +89,34 @@ def test_visualization_summary():
     assert "dot" in hlo or "stablehlo" in hlo or "func" in hlo
 
 
+def test_plot_network_dot(tmp_path):
+    """plot_network (reference visualization.py:198): dot source over the
+    traced jaxpr — inputs as ovals, conv/dense boxes with the reference's
+    labels, shape-annotated edges, params hidden by default."""
+    from dt_tpu import visualization as viz
+    model = models.create("lenet", num_classes=4)
+    x = np.ones((2, 28, 28, 1), np.float32)
+    out = str(tmp_path / "net.dot")
+    dot = viz.plot_network(model, jnp.asarray(x), title="lenet",
+                           save_path=out)
+    assert dot.startswith('digraph "lenet"')
+    assert dot.rstrip().endswith("}")
+    assert "Convolution" in dot and "FullyConnected" in dot
+    assert "Pooling" in dot
+    assert "shape=oval" in dot          # the data input
+    assert "param[" not in dot           # hide_weights default
+    assert "->" in dot and "2x28x28x1" in dot  # shape-labeled edge
+    import os
+    assert os.path.exists(out) and open(out).read() == dot
+    # weights visible on request
+    dot2 = viz.plot_network(model, jnp.asarray(x), hide_weights=False)
+    assert "param[" in dot2
+    # plain callables trace too; big graphs truncate
+    dot3 = viz.plot_network(lambda a: (a @ a).sum(), np.eye(4),
+                            max_nodes=1)
+    assert "more ops" in dot3 or dot3.count("[label=") <= 4
+
+
 def test_fit_metric_pipelining_counts_all_batches():
     """The one-step-behind metric update must still account every batch
     (incl. the final one)."""

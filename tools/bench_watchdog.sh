@@ -1,0 +1,18 @@
+#!/bin/bash
+# Retry the TPU preflight until the axon tunnel clears, then run the full
+# bench (writes BENCH_local_r04.jsonl evidence rows per completed tier).
+# Round-3 postmortem: the bench only ran at round end against a wedged
+# tunnel; this watchdog runs it as early as the tunnel allows.
+cd /root/repo
+export DT_COMPILE_CACHE=/root/repo/.xla_cache
+n=0
+while true; do
+  n=$((n+1))
+  echo "[watchdog $(date +%T)] preflight attempt $n" >&2
+  if timeout 240 python bench.py --preflight; then
+    echo "[watchdog $(date +%T)] tunnel healthy; running bench" >&2
+    break
+  fi
+  sleep 180
+done
+DT_BENCH_TIMEOUT_S=${DT_BENCH_TIMEOUT_S:-3600} python bench.py
