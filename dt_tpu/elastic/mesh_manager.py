@@ -35,9 +35,21 @@ logger = logging.getLogger("dt_tpu.elastic")
 
 
 def snapshot_state(state: Any) -> Any:
-    """Pull a (possibly sharded) pytree fully to host RAM (numpy)."""
-    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
-                                  state)
+    """Pull a (possibly sharded) pytree fully to host RAM (numpy).
+
+    Leaves sharded ACROSS processes (ZeRO/FSDP state in a multi-host
+    world) are not locally fetchable — ``device_get`` raises on
+    non-addressable shards — so those gather via
+    ``multihost_utils.process_allgather`` (a collective: every process
+    must reach this snapshot, which the epoch-boundary contract
+    guarantees).  Caught by the 2-process x 4-device ZeRO test."""
+    def pull(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+    return jax.tree_util.tree_map(pull, state)
 
 
 def restore_state(host_state: Any, mesh, shardings: Any = None) -> Any:
@@ -87,6 +99,18 @@ class MeshManager:
             self._initialized = True
         self.mesh = mesh_lib.make_mesh()
         return self.mesh
+
+    def depart(self, state: Any) -> None:
+        """A REMOVED worker's exit path: participate in the final
+        collective snapshot (survivors' ``rebuild`` gathers cross-process
+        ZeRO/FSDP shards — a collective the old world must fully attend,
+        see :func:`snapshot_state`), then leave the world.  Call this
+        instead of bare ``teardown`` whenever the training state may be
+        sharded across processes; with fully-addressable state it
+        degenerates to a local copy + teardown."""
+        if self._initialized and jax.process_count() > 1:
+            snapshot_state(state)  # result unused; the collective matters
+        self.teardown()
 
     def teardown(self):
         if self._initialized:

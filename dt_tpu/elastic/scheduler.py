@@ -441,7 +441,14 @@ class Scheduler:
 
     def _apply_membership_change(self, epoch: int) -> dict:
         """Diff host_worker vs live set; removals beat adds
-        (``elastic_training.cc:91-157``).  Caller holds the lock."""
+        (``elastic_training.cc:91-157``).  Caller holds the lock.
+
+        INVARIANT other layers rely on: one barrier applies removals OR
+        additions, never both — so any change involving a removal always
+        changes the worker count.  ``Module.fit``'s mesh-rebuild trigger
+        (count comparison) and ``MeshManager.depart``'s collective
+        matching both depend on this; if this ever applies mixed changes
+        in one barrier, fit must switch to comparing the member LIST."""
         if self._pre_change_hook is not None:
             try:
                 self._pre_change_hook(epoch)

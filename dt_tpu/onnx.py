@@ -548,10 +548,16 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
         out(g.add("Pad", [inp(0), g.const(np.asarray(pads, np.int64)),
                           inp(1)]))
     elif prim == "iota":
-        n = int(np.prod(rec["out_avals"][0].shape))
-        arr = np.arange(n).reshape(rec["out_avals"][0].shape) \
+        # broadcasted_iota: counts along params["dimension"], broadcast
+        # over the rest
+        shape = rec["out_avals"][0].shape
+        dim = params.get("dimension", 0)
+        rng_shape = [1] * len(shape)
+        rng_shape[dim] = shape[dim]
+        arr = np.broadcast_to(
+            np.arange(shape[dim]).reshape(rng_shape), shape) \
             .astype(rec["out_avals"][0].dtype)
-        out([g.const(arr, "iota")])
+        out([g.const(np.ascontiguousarray(arr), "iota")])
     elif prim in ("argmax", "argmin"):
         op = "ArgMax" if prim == "argmax" else "ArgMin"
         axes = params["axes"]
@@ -561,8 +567,6 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
             y = g.add("Cast", [y],
                       to=_NP_TO_ONNX.get(np.dtype(odt), _DT_INT32))[0]
         out([y])
-    elif prim == "stop_gradient":
-        out([inp(0)])
     else:
         raise NotImplementedError(
             f"ONNX export: unsupported primitive '{prim}' "
@@ -690,11 +694,9 @@ def _run_node(node: dict, ins: List, jnp, lax, static: List = None):
     if op == "Cast":
         return [ins[0].astype(_ONNX_TO_NP.get(a["to"], np.float32))]
     if op == "Reshape":
-        shape = shp(1)
-        if shape.count(-1) == 0:
-            # tolerate size-preserving mismatch (export bakes exact shapes)
-            pass
-        return [jnp.reshape(ins[0], shape)]
+        # export bakes exact shapes; jnp.reshape also accepts a -1 from
+        # externally-produced files
+        return [jnp.reshape(ins[0], shp(1))]
     if op == "Transpose":
         return [jnp.transpose(ins[0], a["perm"])]
     if op == "Expand":
@@ -752,11 +754,11 @@ def _run_node(node: dict, ins: List, jnp, lax, static: List = None):
     if op == "Pad":
         pads = shp(1)
         nd = ins[0].ndim
-        cval = (np.asarray(static[2]).item()
-                if len(ins) > 2 and static[2] is not None
-                else 0.0) if len(ins) > 2 else 0.0
-        if len(ins) > 2 and static[2] is None:
-            cval = 0.0  # traced pad value unsupported; export emits consts
+        # pad value must be static (export emits it as an initializer);
+        # a traced value falls back to 0
+        cval = 0.0
+        if len(ins) > 2 and static[2] is not None:
+            cval = np.asarray(static[2]).item()
         cfg = [(pads[d], pads[nd + d], 0) for d in range(nd)]
         return [lax.pad(ins[0], jnp.asarray(cval, ins[0].dtype), cfg)]
     if op == "Gemm":

@@ -472,7 +472,18 @@ class Module:
                     self.kv._membership_change_barrier({"EPOCH_BEGIN": epoch})
                 except WorkerRemoved:
                     # the reference terminates removed instances
-                    # (launch.py:196-199); exit the fit loop cleanly
+                    # (launch.py:196-199); exit the fit loop cleanly.
+                    # With a multi-process world the survivors' rebuild
+                    # gathers cross-process ZeRO/FSDP shards — a
+                    # collective this (still-member-of-the-old-world)
+                    # process must attend before leaving, or they hang.
+                    # Matching is guaranteed by the scheduler's
+                    # removals-beat-adds rule (_apply_membership_change
+                    # applies removals and additions in SEPARATE
+                    # barriers), so any removal also changes num_workers
+                    # and survivors take the rebuild branch below.
+                    if self.mesh_manager is not None:
+                        self.mesh_manager.depart(self.state)
                     logger.info("Epoch[%d] this worker was removed from the "
                                 "job; stopping", epoch)
                     return eval_metric
