@@ -370,6 +370,7 @@ class _GraphBuilder:
 
     def add(self, op: str, inputs: Sequence[str], n_out: int = 1,
             **attrs) -> List[str]:
+        """Emit one node; ``n_out`` names that many outputs."""
         outs = [self.name(op.lower()) for _ in range(n_out)]
         self.nodes.append(_node(op, inputs, outs,
                                 name=self.name(op), **attrs))
@@ -419,8 +420,30 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
           "neg": "Neg", "abs": "Abs", "sqrt": "Sqrt", "sign": "Sign",
           "floor": "Floor", "ceil": "Ceil", "erf": "Erf"}
 
+    cmp = {"lt": "Less", "gt": "Greater", "le": "LessOrEqual",
+           "ge": "GreaterOrEqual", "eq": "Equal"}
+    logical = {"and": "And", "or": "Or", "xor": "Xor", "not": "Not"}
+
+    def require_bool():
+        # jax and/or/xor/not are BITWISE on ints; ONNX And/Or/Xor/Not are
+        # bool-only.  Exporting an int version as the bool op silently
+        # changes semantics (6&3 -> True), so only bool maps.
+        if not all(a.dtype == np.bool_ for a in rec["in_avals"]):
+            raise NotImplementedError(
+                f"integer bitwise '{prim}' has no ONNX mapping here "
+                f"(bool logical ops only)")
+
     if prim in ("stop_gradient", "copy"):
         out([inp(0)])
+    elif prim in cmp:
+        out(g.add(cmp[prim], [inp(0), inp(1)]))
+    elif prim == "ne":
+        e = g.add("Equal", [inp(0), inp(1)])[0]
+        out(g.add("Not", [e]))
+    elif prim in logical:
+        require_bool()
+        out(g.add(logical[prim],
+                  [inp(k) for k in range(len(rec["invals"]))]))
     elif prim == "convert_element_type":
         to = _NP_TO_ONNX.get(np.dtype(params["new_dtype"]), _DT_FLOAT)
         out(g.add("Cast", [inp(0)], to=to))
@@ -436,6 +459,16 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
     elif prim == "rsqrt":
         s = g.add("Sqrt", [inp(0)])[0]
         out(g.add("Reciprocal", [s]))
+    elif prim == "square":
+        out(g.add("Mul", [inp(0), inp(0)]))
+    elif prim == "cbrt":
+        # real cube root: sign(x) * |x|^(1/3) (plain Pow NaNs on x<0)
+        sgn = g.add("Sign", [inp(0)])[0]
+        mag = g.add("Abs", [inp(0)])[0]
+        p = g.const(np.asarray(1.0 / 3.0,
+                               rec["in_avals"][0].dtype))
+        root = g.add("Pow", [mag, p])[0]
+        out(g.add("Mul", [sgn, root]))
     elif prim == "integer_pow":
         y = params["y"]
         if y == 2:
@@ -462,6 +495,10 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
     elif prim == "concatenate":
         out(g.add("Concat", [inp(k) for k in range(len(rec["invals"]))],
                   axis=params["dimension"]))
+    elif prim == "split":
+        sizes = [int(s) for s in params["sizes"]]
+        out(g.add("Split", [inp(0), g.const(np.asarray(sizes, np.int64))],
+                  n_out=len(sizes), axis=params["axis"]))
     elif prim == "select_n":
         # select_n(pred, on_false, on_true) -> Where(pred, true, false)
         out(g.add("Where", [inp(0), inp(2), inp(1)]))
@@ -478,18 +515,43 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
     elif prim == "dot_general":
         ((lc, rc), (lb, rb)) = params["dimension_numbers"]
         la, ra = aval(0), aval(1)
-        if lb or rb or len(lc) != 1 or len(rc) != 1:
-            raise NotImplementedError(
-                f"dot_general with batch/multi contraction dims "
-                f"{params['dimension_numbers']}")
-        a, b = inp(0), inp(1)
-        if lc[0] != la.ndim - 1:
-            perm = [d for d in range(la.ndim) if d != lc[0]] + [lc[0]]
-            a = g.add("Transpose", [a], perm=perm)[0]
-        if rc[0] != 0:
-            perm = [rc[0]] + [d for d in range(ra.ndim) if d != rc[0]]
-            b = g.add("Transpose", [b], perm=perm)[0]
-        out(g.add("MatMul", [a, b]))
+        if not lb and not rb and len(lc) == 1 and len(rc) == 1:
+            # plain matmul: cheap MatMul node (+ Transpose if needed)
+            a, b = inp(0), inp(1)
+            if lc[0] != la.ndim - 1:
+                perm = [d for d in range(la.ndim) if d != lc[0]] + [lc[0]]
+                a = g.add("Transpose", [a], perm=perm)[0]
+            if rc[0] != 0:
+                perm = [rc[0]] + [d for d in range(ra.ndim) if d != rc[0]]
+                b = g.add("Transpose", [b], perm=perm)[0]
+            out(g.add("MatMul", [a, b]))
+        else:
+            # general contraction (batched attention einsums etc.) ->
+            # ONNX Einsum (opset >= 12), spelled from dimension_numbers
+            # with the dot_general output order: batch dims, lhs free,
+            # rhs free
+            letters = "abcdefghijklmnopqrstuvwxyz"
+            it = iter(letters)
+            l_sub = [None] * la.ndim
+            r_sub = [None] * ra.ndim
+            for ld, rd in zip(lb, rb):
+                l_sub[ld] = r_sub[rd] = next(it)
+            for ld, rd in zip(lc, rc):
+                l_sub[ld] = r_sub[rd] = next(it)
+            for d in range(la.ndim):
+                if l_sub[d] is None:
+                    l_sub[d] = next(it)
+            for d in range(ra.ndim):
+                if r_sub[d] is None:
+                    r_sub[d] = next(it)
+            out_sub = ([l_sub[d] for d in lb]
+                       + [l_sub[d] for d in range(la.ndim)
+                          if d not in lb and d not in lc]
+                       + [r_sub[d] for d in range(ra.ndim)
+                          if d not in rb and d not in rc])
+            eq = (f"{''.join(l_sub)},{''.join(r_sub)}"
+                  f"->{''.join(out_sub)}")
+            out(g.add("Einsum", [inp(0), inp(1)], equation=eq))
     elif prim == "conv_general_dilated":
         dn = params["dimension_numbers"]
         lhs, rhs = aval(0), aval(1)
@@ -547,6 +609,42 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
         pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
         out(g.add("Pad", [inp(0), g.const(np.asarray(pads, np.int64)),
                           inp(1)]))
+    elif prim == "gather":
+        # the jnp.take / Embed-lookup pattern: one indexed axis, full
+        # slices elsewhere -> ONNX Gather(axis).  Anything fancier
+        # (multi-dim start_index_map, batching dims) is out of scope.
+        dn = params["dimension_numbers"]
+        ss = params["slice_sizes"]
+        op_aval = aval(0)
+        idx_aval = aval(1)
+        axis0 = dn.start_index_map[0] if dn.start_index_map else 0
+        ib_rank = idx_aval.ndim - 1 if idx_aval.shape and \
+            idx_aval.shape[-1] == 1 else idx_aval.ndim
+        # ONNX Gather splices the index dims at `axis` in the output;
+        # the jaxpr's offset_dims must match that exact layout or the
+        # result silently lands transposed
+        expected_offsets = tuple(range(axis0)) + tuple(
+            range(axis0 + ib_rank, op_aval.ndim - 1 + ib_rank))
+        simple = (len(dn.start_index_map) == 1
+                  and tuple(dn.collapsed_slice_dims)
+                  == tuple(dn.start_index_map)
+                  and tuple(dn.offset_dims) == expected_offsets
+                  and not getattr(dn, "operand_batching_dims", ())
+                  and all(ss[d] == op_aval.shape[d]
+                          for d in range(op_aval.ndim)
+                          if d != dn.start_index_map[0])
+                  and ss[dn.start_index_map[0]] == 1)
+        if not simple:
+            raise NotImplementedError(
+                f"gather with dimension_numbers {dn} (only take-style "
+                f"single-axis gathers export)")
+        axis = dn.start_index_map[0]
+        idx = inp(1)
+        if idx_aval.shape and idx_aval.shape[-1] == 1:
+            # drop the trailing index-vector dim
+            idx = g.add("Reshape", [idx, g.const(np.asarray(
+                idx_aval.shape[:-1], np.int64))])[0]
+        out(g.add("Gather", [inp(0), idx], axis=axis))
     elif prim == "iota":
         # broadcasted_iota: counts along params["dimension"], broadcast
         # over the rest
@@ -761,6 +859,25 @@ def _run_node(node: dict, ins: List, jnp, lax, static: List = None):
             cval = np.asarray(static[2]).item()
         cfg = [(pads[d], pads[nd + d], 0) for d in range(nd)]
         return [lax.pad(ins[0], jnp.asarray(cval, ins[0].dtype), cfg)]
+    if op == "Einsum":
+        return [jnp.einsum(a["equation"], *ins)]
+    c2 = {"Less": jnp.less, "Greater": jnp.greater,
+          "LessOrEqual": jnp.less_equal,
+          "GreaterOrEqual": jnp.greater_equal, "Equal": jnp.equal,
+          "And": jnp.logical_and, "Or": jnp.logical_or,
+          "Xor": jnp.logical_xor}
+    if op in c2:
+        return [c2[op](ins[0], ins[1])]
+    if op == "Not":
+        return [jnp.logical_not(ins[0])]
+    if op == "Gather":
+        return [jnp.take(ins[0], ins[1].astype(np.int32),
+                         axis=a.get("axis", 0))]
+    if op == "Split":
+        sizes = [int(d) for d in np.asarray(static[1] if static[1]
+                                            is not None else ins[1])]
+        return jnp.split(ins[0], np.cumsum(sizes)[:-1].tolist(),
+                         axis=a.get("axis", 0))
     if op == "Gemm":
         y = jnp.matmul(
             ins[0].T if a.get("transA") else ins[0],

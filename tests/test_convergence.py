@@ -2,11 +2,14 @@
 
 ``tools/convergence_run.py`` trains ResNet-20 on the digits dataset (the
 only real image data available in the zero-egress build container) through
-the full example pipeline and commits CONVERGENCE_r03.json + the final
-checkpoint.  This test proves the committed artifacts are real: the curve
-passed the 0.85 gate, and the checkpoint RELOADS and re-scores >= 0.85 on
-the deterministically rebuilt validation split (reference analog: the
-nightly dist_lenet convergence gate, ``tests/nightly/test_all.sh:98``, and
+the full example pipeline and commits CONVERGENCE_r04.json + the final
+checkpoint.  Hardened round-4 gate (VERDICT r3 item 5): threshold 0.97,
+curve shape vs the committed known-good curve, and the elastic +/-1-worker
+cycle's full-dataset accuracy within 0.2% of the 2-worker baseline.  This
+test proves the committed artifacts are real: all gates passed, and the
+checkpoint RELOADS and re-scores on the deterministically rebuilt
+validation split (reference analog: the nightly dist_lenet convergence
+gate, ``tests/nightly/test_all.sh:98``, and
 model_backwards_compatibility_check).
 """
 
@@ -17,7 +20,7 @@ import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CURVE = os.path.join(REPO, "CONVERGENCE_r03.json")
+CURVE = os.path.join(REPO, "CONVERGENCE_r04.json")
 CKPT = os.path.join(REPO, "tests", "fixtures", "digits_resnet20.state")
 
 pytestmark = pytest.mark.skipif(
@@ -30,11 +33,39 @@ def test_curve_passed_gate():
     with open(CURVE) as f:
         rec = json.load(f)
     assert rec["passed"] is True
-    assert rec["final_val_acc"] >= rec["gate"] == 0.85
+    assert rec["final_val_acc"] >= rec["gate"] == 0.97
+    assert all(rec["gates"].values()), rec["gates"]
     # the curve is a real trajectory: monotone-ish growth from near-chance
     accs = [c["val_acc"] for c in rec["curve"]]
     assert len(accs) == rec["epochs"]
     assert accs[0] < 0.7 < accs[-1]
+
+
+def test_elastic_cycle_tracked_static():
+    """BASELINE north star at the real-data task: the +1/-1 worker cycle
+    lands within 0.2% full-dataset accuracy of the 2-worker baseline."""
+    with open(CURVE) as f:
+        rec = json.load(f)
+    if "elastic_full_acc_delta" not in rec:
+        pytest.skip("run recorded with DT_CONV_SKIP_ELASTIC=1")
+    assert rec["elastic_full_acc_delta"] <= rec["elastic_delta_gate"] \
+        == 0.002
+    # the cycle really happened: the joiner (w2) bootstrapped from the
+    # live snapshot mid-run and left before the base workers finished
+    assert rec["elastic_cycle"]["joiner_bootstrap_step"] > 0
+    assert rec["elastic_cycle"]["joiner_final_step"] \
+        < rec["elastic_cycle"]["final_step"]
+    assert rec["elastic_cycle"]["num_workers_at_end"] == 2
+
+
+def test_known_good_curve_fixture_committed():
+    path = os.path.join(REPO, "tests", "fixtures",
+                        "digits_resnet20_curve.json")
+    assert os.path.exists(path), "known-good curve fixture missing"
+    with open(path) as f:
+        fix = json.load(f)
+    assert fix["epochs"] == len(fix["curve"])
+    assert fix["curve"][-1]["val_acc"] >= 0.97
 
 
 def test_checkpoint_reloads_and_scores():
@@ -86,4 +117,4 @@ def test_checkpoint_reloads_and_scores():
         out = logits_of(state.params, state.batch_stats, x[i:i + 64])
         preds.append(np.asarray(out).argmax(1))
     acc = float((np.concatenate(preds) == y).mean())
-    assert acc >= 0.85, f"reloaded checkpoint scored {acc:.3f}"
+    assert acc >= 0.97, f"reloaded checkpoint scored {acc:.3f}"

@@ -683,6 +683,44 @@ def test_det_crop_drops_centerless_boxes():
                                atol=1e-6)
 
 
+def test_det_color_distort():
+    """DetColorDistort (image_det_aug_default.cc:536-567): draw order is
+    h,s,l,c then 4 prob gates; contrast is img*(1+c); boxes untouched."""
+    img = np.random.RandomState(0).randint(0, 256, (8, 8, 3)) \
+        .astype(np.uint8)
+    boxes = np.array([[1, 0.1, 0.1, 0.9, 0.9]], np.float32)
+
+    # prob=0 on all channels: image must pass through untouched
+    aug0 = augment.DetColorDistort(max_random_hue=18, seed=1)
+    out, b = aug0(img, boxes)
+    np.testing.assert_array_equal(out, img)
+    np.testing.assert_array_equal(b, boxes)
+
+    # contrast-only with prob 1: reproducible img*(1+c) from the same
+    # draw sequence the augmenter uses (4 uniforms, then 4 gates)
+    aug1 = augment.DetColorDistort(max_random_contrast=0.5,
+                                   random_contrast_prob=1.0, seed=7)
+    out, b = aug1(img, boxes)
+    rng = np.random.RandomState(7)
+    for _ in range(3):
+        rng.uniform(-1, 1)  # h, s, l draws (magnitudes 0 -> ints 0)
+    c = rng.uniform(-1, 1) * 0.5
+    for _ in range(3):
+        rng.rand()  # h, s, l gates
+    rng.rand()  # c gate (prob 1 -> passes)
+    want = np.clip(img.astype(np.float32) * (1.0 + c), 0, 255) \
+        .astype(np.uint8)
+    np.testing.assert_array_equal(out, want)
+    np.testing.assert_array_equal(b, boxes)
+
+    # hue-only at prob 1 changes the image but stays valid u8
+    aug2 = augment.DetColorDistort(max_random_hue=90, random_hue_prob=1.0,
+                                   seed=3)
+    out, _ = aug2(img, boxes)
+    assert out.dtype == img.dtype and out.shape == img.shape
+    assert not np.array_equal(out, img)
+
+
 def test_imagenet_augmenter_full_recipe():
     aug = augment.imagenet_train_augmenter(
         size=32, random_resized_crop=True, pca_noise=0.05,

@@ -80,6 +80,24 @@ def test_onnx_importer_is_jittable():
         rtol=2e-5, atol=2e-5)
 
 
+def test_onnx_roundtrip_transformer_lm():
+    """Attention-model export: batched dot_general -> Einsum, Embed ->
+    Gather, causal mask -> Less/Where, qkv split -> Split."""
+    model = models.create("transformer_lm", vocab_size=50, num_layers=1,
+                          embed_dim=16, num_heads=2, max_len=12)
+    x = jnp.asarray(np.random.RandomState(3).randint(0, 50, (2, 12)))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x,
+                           training=False)
+    want = model.apply(variables, x, training=False)
+    blob = donnx.export_onnx(model, x, variables=variables)
+    fn, params = donnx.import_onnx(blob)
+    got = fn(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    ops = {n["op_type"] for n in donnx.parse_model(blob)["nodes"]}
+    assert "Einsum" in ops and "Gather" in ops
+
+
 def test_onnx_iota_dimension():
     """broadcasted_iota must count along its `dimension`, not flat-range
     the output shape (regression: round-4 review)."""
@@ -92,6 +110,48 @@ def test_onnx_iota_dimension():
     fn, params = donnx.import_onnx(blob)
     np.testing.assert_allclose(np.asarray(fn(params, x)),
                                np.asarray(f(x)))
+
+
+def test_onnx_semantic_guards():
+    """Ops whose ONNX mapping would silently change semantics must refuse
+    to export; their safe siblings must round-trip (round-4 review)."""
+    from jax import lax
+
+    # integer bitwise and/or/xor are NOT ONNX And/Or/Xor (bool-only)
+    with pytest.raises(NotImplementedError):
+        donnx.export_onnx(lambda a, b: a & b,
+                          jnp.asarray([6, 2], jnp.int32),
+                          jnp.asarray([3, 4], jnp.int32))
+    ba = jnp.asarray([True, False])
+    bb = jnp.asarray([True, True])
+    blob = donnx.export_onnx(jnp.logical_and, ba, bb)
+    fn, p = donnx.import_onnx(blob)
+    np.testing.assert_array_equal(np.asarray(fn(p, ba, bb)),
+                                  [True, False])
+
+    # cbrt keeps the real root on negatives (Pow alone would NaN)
+    x = jnp.asarray([-8.0, 27.0], jnp.float32)
+    blob = donnx.export_onnx(jnp.cbrt, x)
+    fn, p = donnx.import_onnx(blob)
+    np.testing.assert_allclose(np.asarray(fn(p, x)), [-2.0, 3.0],
+                               rtol=1e-5)
+
+    # gathers that aren't take-style (offset dims elsewhere) must refuse
+    # — ONNX Gather would splice the index dims at the wrong position
+    xm = jnp.arange(12.0).reshape(3, 4)
+    dn = lax.GatherDimensionNumbers(offset_dims=(1,),
+                                    collapsed_slice_dims=(1,),
+                                    start_index_map=(1,))
+    with pytest.raises(NotImplementedError):
+        donnx.export_onnx(
+            lambda x, i: lax.gather(x, i, dn, slice_sizes=(3, 1)),
+            xm, jnp.asarray([[1], [3]], jnp.int32))
+    # ...while axis-k takes round-trip
+    i = jnp.asarray([[1, 3], [0, 2]], jnp.int32)
+    blob = donnx.export_onnx(lambda x, i: jnp.take(x, i, axis=1), xm, i)
+    fn, p = donnx.import_onnx(blob)
+    np.testing.assert_allclose(np.asarray(fn(p, xm, i)),
+                               np.asarray(jnp.take(xm, i, axis=1)))
 
 
 def test_onnx_parse_model_structure():

@@ -1,8 +1,13 @@
 #!/bin/bash
-# Retry the TPU preflight until the axon tunnel clears, then run the full
-# bench (writes BENCH_local_r04.jsonl evidence rows per completed tier).
+# Retry the TPU preflight until the axon tunnel clears, then capture as
+# much TPU evidence as possible while it is provably healthy:
+#   1. bench.py default tiers (resnet18 -> resnet152, the BASELINE row) —
+#      every TPU tier appends to BENCH_local_r04.jsonl
+#   2. the other reference baseline rows (inception_v3 b32@299,
+#      alexnet b512) — best effort
+#   3. tools/profile_step.py trace of the ResNet-152 step (VERDICT item 2)
 # Round-3 postmortem: the bench only ran at round end against a wedged
-# tunnel; this watchdog runs it as early as the tunnel allows.
+# tunnel; this watchdog runs everything as early as the tunnel allows.
 cd /root/repo
 export DT_COMPILE_CACHE=/root/repo/.xla_cache
 n=0
@@ -16,3 +21,11 @@ while true; do
   sleep 180
 done
 DT_BENCH_TIMEOUT_S=${DT_BENCH_TIMEOUT_S:-3600} python bench.py
+echo "[watchdog $(date +%T)] main bench done; extra tiers" >&2
+DT_BENCH_MODEL=inception_v3 DT_BENCH_IMAGE=299 DT_BENCH_BATCH=32 \
+  timeout 1200 python bench.py --run || true
+DT_BENCH_MODEL=alexnet DT_BENCH_BATCH=512 \
+  timeout 1200 python bench.py --run || true
+echo "[watchdog $(date +%T)] profiling resnet152 step" >&2
+timeout 1800 python tools/profile_step.py || true
+echo "[watchdog $(date +%T)] all done" >&2

@@ -1,4 +1,4 @@
-"""Real-data convergence run (round-2 judge item 4).
+"""Real-data convergence run — hardened gate (VERDICT r3 item 5).
 
 The reference's convergence evidence is CIFAR-10 ResNet-20 -> ~0.91 val
 acc (``example/image-classification/README.md`` "Results") and the
@@ -10,12 +10,26 @@ only real image dataset available in-image: sklearn's bundled `digits`
 CIFAR-10 example pipeline (ImageRecordIter + augmenter + Module.fit +
 checkpoint), ResNet-20, SGD-momentum with the multifactor schedule.
 
-Outputs:
-- ``CONVERGENCE_r03.json``   — per-epoch val-accuracy curve + config
-- ``tests/fixtures/digits_resnet20.state`` — the final checkpoint, which
-  ``tests/test_convergence.py`` reloads and re-scores (>= 0.85 gate).
+Three phases, three gates (all must pass):
+1. STATIC: val-acc >= 0.97 (was 0.85 — a gate 10 points under the
+   achieved 0.9972 caught nothing) AND curve SHAPE vs the committed
+   known-good curve (``tests/fixtures/digits_resnet20_curve.json``):
+   epochs-to-0.95 within +5 of committed, final within +/-0.015.
+2. 2-WORKER BASELINE: the same task through the real multi-process
+   host-sync machinery, no membership change.
+3. ELASTIC: same, with a scripted +1/-1 worker cycle at epoch
+   boundaries; |full-dataset acc - phase-2 acc| <= 0.002 (the BASELINE
+   north-star 0.2% top-1 delta; 1797 samples -> 0.056% quantum).
+
+Outputs: ``CONVERGENCE_r04.json`` (all curves + gates),
+``tests/fixtures/digits_resnet20.state`` (checkpoint; reload-tested),
+``tests/fixtures/digits_resnet20_curve.json`` (known-good curve,
+committed once and compared against thereafter).
 
 Run: ``DT_FORCE_CPU=1 python tools/convergence_run.py``
+(``DT_CONV_SKIP_ELASTIC=1`` for the static phase only;
+``DT_CONV_EPOCHS`` to shorten — curve comparison auto-skips when the
+epoch count differs from the committed curve's).
 """
 
 import json
@@ -28,7 +42,116 @@ sys.path.insert(0, REPO)
 
 VAL_FRACTION = 5  # every 5th sample -> 20% validation split
 IMAGE_SHAPE = (32, 32, 3)
-ACC_GATE = 0.85
+ACC_GATE = 0.97
+ELASTIC_DELTA_GATE = 0.002  # BASELINE north star: <0.2% top-1 delta
+CURVE_FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "digits_resnet20_curve.json")
+
+
+def epochs_to(curve, acc):
+    for c in curve:
+        if c["val_acc"] >= acc:
+            return c["epoch"]
+    return None
+
+
+def run_cluster(recs, epochs, elastic_cycle, tag):
+    """Phase 2/3: 2 base workers through Scheduler + host-sync exact
+    averaging; ``elastic_cycle`` adds w2 at the 1/4 boundary and removes
+    it at the 5/8 boundary (epoch-granular, like the reference's EC2
+    manager edits of host_worker)."""
+    import subprocess
+    import tempfile
+    from dt_tpu.elastic import Scheduler
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "digits_elastic_worker.py")
+    tmp = tempfile.mkdtemp(prefix=f"dt_conv_{tag}_")
+    hw = os.path.join(tmp, "host_worker")
+
+    def write_hosts(hosts):
+        with open(hw + ".tmp", "w") as f:
+            f.write("\n".join(hosts) + "\n")
+        os.replace(hw + ".tmp", hw)
+
+    write_hosts(["w0", "w1"])
+    outs = {h: os.path.join(tmp, f"{h}.json") for h in ("w0", "w1", "w2")}
+    procs = {}
+
+    def spawn(host, extra_env=None):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["ELASTIC_TRAINING_ENABLED"] = "1"
+        env.update(extra_env or {})
+        return subprocess.Popen(
+            [sys.executable, worker, "--scheduler-port", str(sched.port),
+             "--host", host, "--train-rec", recs["train"],
+             "--val-rec", recs["val"], "--num-epoch", str(epochs),
+             "--out", outs[host]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def launch_new(host, epoch):
+        procs[host] = spawn(host, {"NEW_WORKER": "1",
+                                   "EPOCH_BEGIN": str(epoch)})
+
+    if elastic_cycle and epochs < 4:
+        raise ValueError("elastic cycle needs >= 4 epochs (join at "
+                         "epochs//4, leave at 5*epochs//8, both must be "
+                         "< epochs)")
+    join_at = max(epochs // 4, 1)
+    leave_at = min(max(5 * epochs // 8, join_at + 1), epochs - 1)
+
+    def operator(epoch):
+        if not elastic_cycle:
+            return
+        if epoch == join_at:
+            write_hosts(["w0", "w1", "w2"])
+        elif epoch == leave_at:
+            write_hosts(["w0", "w1"])
+
+    sched = Scheduler(host_worker_file=hw, launch_callback=launch_new,
+                      pre_change_hook=operator)
+    try:
+        for h in ("w0", "w1"):
+            procs[h] = spawn(h)
+        outs_text = {}
+        for h in ("w0", "w1"):
+            # communicate() drains the pipe (wait() can deadlock once a
+            # chatty child fills the ~64KB pipe buffer)
+            outs_text[h], _ = procs[h].communicate(timeout=3600)
+            if procs[h].returncode != 0:
+                raise RuntimeError(
+                    f"{tag}/{h} rc={procs[h].returncode}:\n"
+                    f"{outs_text[h].decode()[-3000:]}")
+        result_w2 = None
+        if elastic_cycle:
+            # the cycle must REALLY have happened: w2 launched, exited
+            # cleanly, and bootstrapped from the live snapshot mid-run
+            if "w2" not in procs:
+                raise RuntimeError(f"{tag}: scheduler never launched w2")
+            w2_text, _ = procs["w2"].communicate(timeout=300)
+            if procs["w2"].returncode != 0:
+                raise RuntimeError(
+                    f"{tag}/w2 rc={procs['w2'].returncode}:\n"
+                    f"{w2_text.decode()[-3000:]}")
+            with open(outs["w2"]) as f:
+                result_w2 = json.load(f)
+            if not result_w2.get("bootstrap_step"):
+                raise RuntimeError(
+                    f"{tag}: w2 never bootstrapped from the snapshot "
+                    f"({result_w2})")
+        with open(outs["w0"]) as f:
+            result = json.load(f)
+        if result_w2 is not None:
+            result["joiner_bootstrap_step"] = result_w2["bootstrap_step"]
+            result["joiner_final_step"] = result_w2["final_step"]
+        return result
+    finally:
+        sched.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
 
 
 def build_digits_recs(out_dir: str):
@@ -109,6 +232,63 @@ def main():
     os.replace(f"{ckpt_prefix}-{epochs - 1:04d}.state",
                f"{ckpt_prefix}.state")
 
+    # ---- gate 1: absolute threshold + curve shape vs committed curve ----
+    gates = {"static_threshold": final >= ACC_GATE}
+    curve_check = None
+    if os.path.exists(CURVE_FIXTURE):
+        with open(CURVE_FIXTURE) as f:
+            committed = json.load(f)
+        if committed["epochs"] == epochs:
+            ref_curve = committed["curve"]
+            e95_ref = epochs_to(ref_curve, 0.95)
+            e95_now = epochs_to(curve, 0.95)
+            curve_check = {
+                "committed_final": ref_curve[-1]["val_acc"],
+                "committed_epochs_to_0.95": e95_ref,
+                "epochs_to_0.95": e95_now,
+                "final_delta": round(final - ref_curve[-1]["val_acc"], 4),
+            }
+            gates["curve_speed"] = (e95_now is not None and e95_now <=
+                                    (epochs if e95_ref is None
+                                     else e95_ref) + 5)
+            gates["curve_final"] = abs(
+                final - ref_curve[-1]["val_acc"]) <= 0.015
+        else:
+            curve_check = {"skipped": f"epoch count {epochs} != committed "
+                                      f"{committed['epochs']}"}
+    elif gates["static_threshold"]:
+        # first hardened run: commit this curve as the known-good fixture
+        # (only a PASSING curve may become the reference — a failed run
+        # must not poison future comparisons)
+        with open(CURVE_FIXTURE, "w") as f:
+            json.dump({"epochs": epochs, "curve": curve,
+                       "recorded_final": final}, f, indent=1)
+        curve_check = {"recorded_new_fixture": True}
+    else:
+        curve_check = {"fixture_not_recorded": "static gate failed"}
+
+    # ---- gates 2+3: 2-worker baseline, then the elastic +/-1 cycle ----
+    cluster = {}
+    if os.environ.get("DT_CONV_SKIP_ELASTIC") != "1":
+        print("phase 2: 2-worker baseline (no membership change)",
+              flush=True)
+        base = run_cluster(recs, epochs, elastic_cycle=False, tag="base")
+        print(f"  -> full_acc={base['final_full_acc']:.4f} "
+              f"val_acc={base['final_val_acc']:.4f}", flush=True)
+        print("phase 3: elastic +1/-1 worker cycle", flush=True)
+        elas = run_cluster(recs, epochs, elastic_cycle=True, tag="elastic")
+        print(f"  -> full_acc={elas['final_full_acc']:.4f} "
+              f"val_acc={elas['final_val_acc']:.4f}", flush=True)
+        delta = abs(elas["final_full_acc"] - base["final_full_acc"])
+        gates["elastic_delta"] = delta <= ELASTIC_DELTA_GATE
+        cluster = {
+            "two_worker_baseline": base,
+            "elastic_cycle": elas,
+            "elastic_full_acc_delta": round(delta, 5),
+            "elastic_delta_gate": ELASTIC_DELTA_GATE,
+        }
+
+    passed = all(gates.values())
     out = {
         "task": "digits(1797 real 8x8 handwritten digits, sklearn/UCI) "
                 "upsampled 32x32 RGB, ResNet-20, full example pipeline",
@@ -118,16 +298,18 @@ def main():
         "epochs": epochs, "batch_size": batch,
         "optimizer": "sgd momentum=0.9 wd=1e-4 lr=0.05 multifactor",
         "final_val_acc": final, "best_val_acc": best,
-        "gate": ACC_GATE, "passed": final >= ACC_GATE,
+        "gate": ACC_GATE, "gates": gates, "passed": passed,
+        "curve_check": curve_check,
+        **cluster,
         "wall_s": round(time.time() - t0, 1),
         "curve": curve,
         "checkpoint": "tests/fixtures/digits_resnet20.state",
     }
-    with open(os.path.join(REPO, "CONVERGENCE_r03.json"), "w") as f:
+    with open(os.path.join(REPO, "CONVERGENCE_r04.json"), "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps({k: out[k] for k in
-                      ("final_val_acc", "best_val_acc", "passed")}))
-    return 0 if final >= ACC_GATE else 1
+    print(json.dumps({"final_val_acc": final, "best_val_acc": best,
+                      "gates": gates, "passed": passed}))
+    return 0 if passed else 1
 
 
 if __name__ == "__main__":

@@ -5,8 +5,8 @@ step (same step as bench.py), then summarizes where the time goes from
 the trace's event table so the MFU number has a committed explanation.
 
 Outputs:
-- ``profile_output/r03_trace/``  — the raw trace (perfetto-compatible)
-- ``PROFILE_r03.json``           — op-category time breakdown + step time
+- ``profile_output/r04_trace/``  — the raw trace (perfetto-compatible)
+- ``PROFILE_r04.json``           — op-category time breakdown + step time
 
 Usage: python tools/profile_step.py [--model resnet152] [--batch 32]
        (DT_FORCE_CPU=1 for a CPU smoke run)
@@ -112,7 +112,7 @@ def main():
     state, loss = step(state, x, y)  # compile + warm
     jax.block_until_ready((state, loss))
 
-    outdir = os.path.join(REPO, "profile_output", "r03_trace")
+    outdir = os.path.join(REPO, "profile_output", "r04_trace")
     os.makedirs(outdir, exist_ok=True)
     jax.profiler.start_trace(outdir)
     t0 = time.perf_counter()
@@ -131,7 +131,7 @@ def main():
         "trace_dir": os.path.relpath(outdir, REPO),
         **summarize_trace(outdir),
     }
-    with open(os.path.join(REPO, "PROFILE_r03.json"), "w") as f:
+    with open(os.path.join(REPO, "PROFILE_r04.json"), "w") as f:
         json.dump(summary, f, indent=1)
     print(json.dumps(summary))
 
