@@ -1,0 +1,171 @@
+"""DCGAN training — the reference's GAN example family.
+
+Reference: ``example/gan/dcgan.py`` (generator/discriminator pair of
+conv stacks, alternating label-flipped updates, Adam(beta1=0.5)).
+TPU-first shape: BOTH updates are single jitted steps (G and D each a
+``value_and_grad`` over its own param tree, two optax optimizers), bf16
+generator-friendly conv stacks from the framework's nn ops, and a
+deterministic synthetic "real" distribution so the example self-checks
+without a dataset download (zero-egress container; swap in an
+ImageRecordIter over a real .rec for actual images).
+
+    python examples/train_gan.py --steps 60 --batch-size 32
+
+Prints per-interval D/G losses and finishes with a sanity check that the
+discriminator cannot fully separate real from fake (the adversarial game
+reached some balance rather than collapsing).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_models(latent: int, hw: int):
+    import flax.linen as linen
+    import jax.numpy as jnp
+
+    class Generator(linen.Module):
+        """latent (B, Z) -> images (B, H, W, 1) in [-1, 1]."""
+
+        @linen.compact
+        def __call__(self, z, training=True):
+            b = z.shape[0]
+            x = linen.Dense((hw // 4) * (hw // 4) * 32)(z)
+            x = linen.relu(x.reshape(b, hw // 4, hw // 4, 32))
+            x = linen.ConvTranspose(16, (4, 4), strides=(2, 2),
+                                    padding="SAME")(x)
+            x = linen.relu(x)
+            x = linen.ConvTranspose(1, (4, 4), strides=(2, 2),
+                                    padding="SAME")(x)
+            return jnp.tanh(x)
+
+    class Discriminator(linen.Module):
+        """images -> real/fake logit (B,)."""
+
+        @linen.compact
+        def __call__(self, x, training=True):
+            x = linen.Conv(16, (4, 4), strides=(2, 2), padding="SAME")(x)
+            x = linen.leaky_relu(x, 0.2)
+            x = linen.Conv(32, (4, 4), strides=(2, 2), padding="SAME")(x)
+            x = linen.leaky_relu(x, 0.2)
+            x = x.reshape(x.shape[0], -1)
+            return linen.Dense(1)(x)[:, 0]
+
+    return Generator(), Discriminator()
+
+
+def real_batch(rng, batch, hw):
+    """Deterministic synthetic 'real' images: soft blobs at grid corners
+    (structured enough that G must learn a non-trivial distribution)."""
+    import numpy as np
+    ys, xs = np.mgrid[0:hw, 0:hw].astype(np.float32) / (hw - 1)
+    cx = rng.choice([0.25, 0.75], batch)
+    cy = rng.choice([0.25, 0.75], batch)
+    d2 = ((xs[None] - cx[:, None, None]) ** 2
+          + (ys[None] - cy[:, None, None]) ** 2)
+    img = np.exp(-d2 / 0.02) * 2.0 - 1.0
+    return img[..., None].astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--latent", type=int, default=16)
+    ap.add_argument("--image-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--log-interval", type=int, default=20)
+    args = ap.parse_args()
+    if args.image_size < 4 or args.image_size % 4:
+        ap.error("--image-size must be a multiple of 4 (two stride-2 "
+                 "upsampling stages)")
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from dt_tpu.ops import losses
+
+    gen, disc = build_models(args.latent, args.image_size)
+    key = jax.random.PRNGKey(args.seed)
+    kg, kd, key = jax.random.split(key, 3)
+    z0 = jnp.zeros((args.batch_size, args.latent), jnp.float32)
+    x0 = jnp.zeros((args.batch_size, args.image_size, args.image_size, 1),
+                   jnp.float32)
+    g_params = gen.init({"params": kg}, z0)["params"]
+    d_params = disc.init({"params": kd}, x0)["params"]
+    # the reference dcgan trains both nets with Adam(lr, beta1=0.5)
+    g_tx = optax.adam(args.lr, b1=0.5)
+    d_tx = optax.adam(args.lr, b1=0.5)
+    g_opt = g_tx.init(g_params)
+    d_opt = d_tx.init(d_params)
+
+    def bce(logits, is_real):
+        labels = jnp.full(logits.shape, 1.0 if is_real else 0.0)
+        return losses.logistic_loss(logits, labels)
+
+    @jax.jit
+    def d_step(d_params, d_opt, g_params, real, z):
+        fake = gen.apply({"params": g_params}, z)
+
+        def loss_of(dp):
+            return (bce(disc.apply({"params": dp}, real), True)
+                    + bce(disc.apply({"params": dp}, fake), False))
+        loss, grads = jax.value_and_grad(loss_of)(d_params)
+        upd, d_opt = d_tx.update(grads, d_opt, d_params)
+        return optax.apply_updates(d_params, upd), d_opt, loss
+
+    @jax.jit
+    def g_step(g_params, g_opt, d_params, z):
+        def loss_of(gp):
+            fake = gen.apply({"params": gp}, z)
+            # non-saturating loss: maximize log D(G(z))
+            return bce(disc.apply({"params": d_params}, fake), True)
+        loss, grads = jax.value_and_grad(loss_of)(g_params)
+        upd, g_opt = g_tx.update(grads, g_opt, g_params)
+        return optax.apply_updates(g_params, upd), g_opt, loss
+
+    rng = np.random.RandomState(args.seed)
+    d_loss = g_loss = float("nan")
+    for step in range(args.steps):
+        real = jnp.asarray(real_batch(rng, args.batch_size,
+                                      args.image_size))
+        key, kz1, kz2 = jax.random.split(key, 3)
+        z = jax.random.normal(kz1, (args.batch_size, args.latent))
+        d_params, d_opt, d_loss = d_step(d_params, d_opt, g_params,
+                                         real, z)
+        z = jax.random.normal(kz2, (args.batch_size, args.latent))
+        g_params, g_opt, g_loss = g_step(g_params, g_opt, d_params, z)
+        if step % args.log_interval == 0 or step == args.steps - 1:
+            print(f"step {step}: d_loss={float(d_loss):.3f} "
+                  f"g_loss={float(g_loss):.3f}", flush=True)
+
+    # sanity: after training, D's accuracy on a fresh real/fake batch is
+    # off the 100% separation it starts near (the game moved)
+    real = jnp.asarray(real_batch(rng, args.batch_size, args.image_size))
+    key, kz = jax.random.split(key)
+    fake = gen.apply({"params": g_params},
+                     jax.random.normal(kz, (args.batch_size, args.latent)))
+    pr = disc.apply({"params": d_params}, real) > 0
+    pf = disc.apply({"params": d_params}, fake) > 0
+    acc = (float(pr.mean()) + float(1 - pf.mean())) / 2
+    print(f"final: d_loss={float(d_loss):.3f} g_loss={float(g_loss):.3f} "
+          f"disc_acc={acc:.2f}")
+    # enforce the docstring's self-check once the game has had time to
+    # move: D must not fully separate real from fake (collapse/dead-grad
+    # runs end at 1.00)
+    if args.steps >= 50:
+        assert acc < 0.995, (
+            f"discriminator fully separates real/fake (acc={acc:.2f}) — "
+            f"the adversarial game never balanced")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

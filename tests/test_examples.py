@@ -105,3 +105,20 @@ def test_profile_resnet_example(tmp_path):
              "--outdir", out)
     assert "trace:" in r.stdout
     assert os.path.isdir(out) and os.listdir(out)
+
+
+def test_train_gan_smoke():
+    """DCGAN example (reference example/gan/dcgan.py): alternating G/D
+    Adam(0.5) steps run end to end and report the balance check."""
+    r = _run("train_gan.py", "--steps", "8", "--batch-size", "8",
+             "--image-size", "8", "--latent", "8", "--log-interval", "4")
+    assert "disc_acc=" in r.stdout
+
+
+def test_train_autoencoder_smoke():
+    """Stacked AE example (reference example/autoencoder): layer-wise
+    pretrain + finetune beats the mean baseline."""
+    r = _run("train_autoencoder.py", "--dims", "32,16,8", "--epochs", "8",
+             "--pretrain-epochs", "2", "--num-examples", "128",
+             "--batch-size", "32")
+    assert "mean-baseline" in r.stdout
