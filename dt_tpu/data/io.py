@@ -94,6 +94,19 @@ class DataIter:
         return None
 
 
+def _init_streams(arrays, default_name: str):
+    """Normalize NDArrayIter's data/label argument to [(name, array)]
+    (reference ``io.py:_init_data``): a bare array gets ``default_name``,
+    dicts keep insertion order, lists get ``name_i`` suffixes."""
+    if arrays is None:
+        return []
+    if isinstance(arrays, dict):
+        return list(arrays.items())
+    if isinstance(arrays, (list, tuple)):
+        return [(f"{default_name}_{i}", a) for i, a in enumerate(arrays)]
+    return [(default_name, arrays)]
+
+
 def _take(arr, sel: np.ndarray) -> np.ndarray:
     """Gather rows ``sel`` as a dense numpy array.
 
@@ -140,12 +153,25 @@ class NDArrayIter(DataIter):
             raise ValueError(f"part_index {part_index} not in [0, {num_parts})")
         if last_batch_handle not in ("pad", "discard", "roll_over"):
             raise ValueError(last_batch_handle)
-        # data/label: numpy ndarray, h5py.Dataset, or scipy CSR — all are
-        # consumed through _take/shape[0], no wrapping needed
-        self._data = data
-        self._label = label
-        self.data_name = data_name
-        self.label_name = label_name
+        # data/label: array | dict {name: array} | list of arrays
+        # (reference io.py:564 "multiple input and labels"); each array a
+        # numpy ndarray, h5py.Dataset, or scipy CSR — all consumed
+        # through _take/shape[0].  Multi-stream batches come out as
+        # tuples in stream order.
+        self._data_streams = _init_streams(data, data_name)
+        self._label_streams = _init_streams(label, label_name)
+        lens = {a.shape[0] for _, a in
+                self._data_streams + self._label_streams}
+        if len(lens) > 1:
+            raise ValueError(
+                f"all data/label streams must share the leading dim; got "
+                f"{sorted(lens)}")
+        self._data = self._data_streams[0][1]
+        self._label = self._label_streams[0][1] if self._label_streams \
+            else None
+        self.data_name = self._data_streams[0][0]
+        self.label_name = self._label_streams[0][0] if self._label_streams \
+            else label_name
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.num_parts = num_parts
@@ -202,25 +228,27 @@ class NDArrayIter(DataIter):
             pad = end - n
             sel = np.concatenate([sel, self._order[:pad]])  # wrap like reference
         self._cursor = end
-        data = _take(self._data, sel)
-        label = _take(self._label, sel) if self._label is not None else None
+        datas = tuple(_take(a, sel) for _, a in self._data_streams)
+        labels = tuple(_take(a, sel) for _, a in self._label_streams)
+        data = datas[0] if len(datas) == 1 else datas
+        label = (labels[0] if len(labels) == 1
+                 else labels if labels else None)
         return DataBatch(data, label, pad)
+
+    def _descs(self, streams) -> List[DataDesc]:
+        return [DataDesc(name, (self.batch_size,) + tuple(a.shape[1:]),
+                         getattr(a, "dtype", np.float32))
+                for name, a in streams]
 
     @property
     def provide_data(self) -> List[DataDesc]:
-        """[DataDesc] for the data stream (reference ``provide_data``);
-        shape leads with batch_size like the reference's."""
-        shape = (self.batch_size,) + tuple(self._data.shape[1:])
-        dtype = getattr(self._data, "dtype", np.float32)
-        return [DataDesc(self.data_name, shape, dtype)]
+        """[DataDesc] per data stream (reference ``provide_data``);
+        shapes lead with batch_size like the reference's."""
+        return self._descs(self._data_streams)
 
     @property
     def provide_label(self) -> List[DataDesc]:
-        if self._label is None:
-            return []
-        shape = (self.batch_size,) + tuple(self._label.shape[1:])
-        dtype = getattr(self._label, "dtype", np.float32)
-        return [DataDesc(self.label_name, shape, dtype)]
+        return self._descs(self._label_streams)
 
 
 class CSVIter(NDArrayIter):

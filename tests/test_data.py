@@ -81,6 +81,32 @@ def test_ndarray_iter_provide_data_desc():
     assert it2.provide_data[0].name == "img"
 
 
+def test_ndarray_iter_multi_stream():
+    """dict / list data inputs (reference io.py:564 'multiple input and
+    labels'): batches come out as tuples in stream order, provide_data
+    advertises one DataDesc per stream, mismatched lengths raise."""
+    x1 = np.arange(8 * 2).reshape(8, 2).astype(np.float32)
+    x2 = np.arange(8 * 3).reshape(8, 3).astype(np.float32)
+    y = np.arange(8).astype(np.int32)
+    it = data.NDArrayIter({"img": x1, "aux": x2}, y, batch_size=4)
+    descs = it.provide_data
+    assert [d.name for d in descs] == ["img", "aux"]
+    assert descs[0].shape == (4, 2) and descs[1].shape == (4, 3)
+    b = next(iter(it))
+    assert isinstance(b.data, tuple) and len(b.data) == 2
+    np.testing.assert_array_equal(b.data[0], x1[:4])
+    np.testing.assert_array_equal(b.data[1], x2[:4])
+    np.testing.assert_array_equal(b.label, y[:4])
+
+    # list form gets name_i suffixes
+    it2 = data.NDArrayIter([x1, x2], batch_size=4)
+    assert [d.name for d in it2.provide_data] == ["data_0", "data_1"]
+
+    # mismatched leading dims refuse loudly
+    with pytest.raises(ValueError, match="leading dim"):
+        data.NDArrayIter({"a": x1, "b": x2[:5]}, batch_size=4)
+
+
 def test_ndarray_iter_discard():
     x = np.zeros((10, 2), np.float32)
     it = data.NDArrayIter(x, batch_size=4, last_batch_handle="discard")

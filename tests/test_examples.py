@@ -122,3 +122,18 @@ def test_train_autoencoder_smoke():
              "--pretrain-epochs", "2", "--num-examples", "128",
              "--batch-size", "32")
     assert "mean-baseline" in r.stdout
+
+
+def test_train_multi_task_smoke():
+    """Multi-task example (reference example/multi-task): shared trunk +
+    two heads via multi-stream NDArrayIter labels, both heads >0.8."""
+    r = _run("train_multi_task.py", "--epochs", "3")
+    assert "digit_acc=" in r.stdout and "parity_acc=" in r.stdout
+
+
+def test_train_recommender_smoke():
+    """MF recommender (reference example/recommenders): embeddings + dot
+    score recover synthetic low-rank structure (val mse < 0.5*variance)."""
+    r = _run("train_recommender.py", "--epochs", "6", "--ratings", "2000",
+             "--users", "80", "--items", "40")
+    assert "variance-baseline" in r.stdout
