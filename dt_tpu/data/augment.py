@@ -90,12 +90,30 @@ class RandomMirror(Augmenter):
 
 
 class Resize(Augmenter):
-    """Bilinear resize via PIL (reference ``resize`` augmenter)."""
+    """Bilinear resize (reference ``resize`` augmenter).
 
-    def __init__(self, size: Tuple[int, int]):
+    ``backend='pil'`` (default) keeps PIL's filtered resample;
+    ``'native'`` uses the C++ half-pixel bilinear kernel
+    (``native/augment.cc`` — OpenCV INTER_LINEAR convention, faster, but
+    numerically different from PIL's area-averaged downscale), falling
+    back to PIL off-toolchain or for non-u8/HWC-3 inputs."""
+
+    def __init__(self, size: Tuple[int, int], backend: str = "pil"):
+        if backend not in ("pil", "native"):
+            raise ValueError(backend)
         self.size = size
+        self.backend = backend
 
     def __call__(self, img, rng=None):
+        if self.backend == "native" and img.dtype == np.uint8:
+            try:
+                from dt_tpu import native
+                out = native.resize_bilinear(img, self.size[0],
+                                             self.size[1])
+                if out is not None:
+                    return out
+            except ImportError:
+                pass
         from PIL import Image
         mode = Image.fromarray(img.astype(np.uint8))
         return np.asarray(mode.resize((self.size[1], self.size[0]),
@@ -282,14 +300,64 @@ class HSLJitter(Augmenter):
             else out.astype(img.dtype)
 
 
+class FusedCropMirrorNormalize(Augmenter):
+    """The hot tail of every classification chain — (reflect-)pad +
+    random crop + p=0.5 mirror + per-channel normalize — as ONE op.
+
+    Uses the native fused kernel (``native/augment.cc``
+    ``dtaug_crop_mirror_norm``: single pass, no temporaries — the role
+    OpenCV plays inside the reference's C++ augmenter,
+    ``image_aug_default.cc``) when the image is u8 HWC-3 and the
+    toolchain built it; otherwise an arithmetic-identical numpy fallback
+    (same division, same order).  Draw order: crop y, crop x, mirror —
+    one stream, so native and fallback paths are byte-identical for the
+    same rng."""
+
+    def __init__(self, size: Tuple[int, int], mean: Sequence[float],
+                 std: Sequence[float], pad: int = 0,
+                 mirror_prob: float = 0.5, seed: int = 0):
+        self.size = size
+        self.pad = pad
+        self.mirror_prob = mirror_prob
+        # broadcast to per-channel now: the native kernel reads exactly 3
+        # (scalar/1-length means would read out of bounds there)
+        self.mean = np.broadcast_to(
+            np.asarray(mean, np.float32), (3,)).copy()
+        self.std = np.broadcast_to(
+            np.asarray(std, np.float32), (3,)).copy()
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img, rng=None):
+        rng = self._rng if rng is None else rng
+        if self.pad:
+            img = np.pad(img, ((self.pad, self.pad), (self.pad, self.pad),
+                               (0, 0)), mode="reflect")
+        h, w = img.shape[:2]
+        th, tw = self.size
+        y = rng.randint(0, h - th + 1)
+        x = rng.randint(0, w - tw + 1)
+        mirror = rng.rand() < self.mirror_prob
+        try:
+            from dt_tpu import native
+            out = native.crop_mirror_norm(img, y, x, th, tw, mirror,
+                                          self.mean, self.std)
+            if out is not None:
+                return out
+        except ImportError:
+            pass
+        crop = img[y:y + th, x:x + tw]
+        if mirror:
+            crop = crop[:, ::-1]
+        return (crop.astype(np.float32) - self.mean) / self.std
+
+
 def cifar_train_augmenter(seed: int = 0) -> Augmenter:
     """The reference's CIFAR-10 training recipe (``train_cifar10.py``:
-    pad 4 + crop 32 + mirror, /255 normalize)."""
-    return Compose(
-        RandomCrop((32, 32), pad=4, seed=seed),
-        RandomMirror(seed=seed + 1),
-        Normalize([127.5] * 3, [127.5] * 3),
-    )
+    pad 4 + crop 32 + mirror, /255 normalize) — served by the fused
+    single-pass op (native kernel when built; arithmetic-identical numpy
+    otherwise)."""
+    return FusedCropMirrorNormalize((32, 32), [127.5] * 3, [127.5] * 3,
+                                    pad=4, seed=seed)
 
 
 def imagenet_train_augmenter(size: int = 224, seed: int = 0,

@@ -160,15 +160,15 @@ class NDArrayIter(DataIter):
         # tuples in stream order.
         self._data_streams = _init_streams(data, data_name)
         self._label_streams = _init_streams(label, label_name)
+        if not self._data_streams:
+            raise ValueError("data must contain at least one stream "
+                             "(got an empty dict/list)")
         lens = {a.shape[0] for _, a in
                 self._data_streams + self._label_streams}
         if len(lens) > 1:
             raise ValueError(
                 f"all data/label streams must share the leading dim; got "
                 f"{sorted(lens)}")
-        self._data = self._data_streams[0][1]
-        self._label = self._label_streams[0][1] if self._label_streams \
-            else None
         self.data_name = self._data_streams[0][0]
         self.label_name = self._label_streams[0][0] if self._label_streams \
             else label_name
@@ -182,7 +182,8 @@ class NDArrayIter(DataIter):
         self._setup_epoch()
 
     def _setup_epoch(self):
-        n = self._data.shape[0]  # len() is a TypeError on scipy CSR
+        # len() is a TypeError on scipy CSR -> shape[0]
+        n = self._data_streams[0][1].shape[0]
         idx = np.arange(n)
         if self.shuffle:
             rng = np.random.RandomState(self._seed + self._epoch)

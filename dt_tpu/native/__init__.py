@@ -21,4 +21,7 @@ from dt_tpu.native.binding import (
     jpeg_decode as jpeg_decode,
     native_index as native_index,
     native_read_batch as native_read_batch,
+    aug_lib as aug_lib,
+    crop_mirror_norm as crop_mirror_norm,
+    resize_bilinear as resize_bilinear,
 )

@@ -1,0 +1,82 @@
+// Native augmentation kernels — the role OpenCV played in the
+// reference's C++ augmenter (src/io/image_aug_default.cc): the hot tail
+// of every classification chain (crop + mirror + normalize) fused into
+// one pass, and a bilinear resize.  Compiled on demand by
+// dt_tpu/native/binding.py (g++ -O2 -shared), called via ctypes from
+// dt_tpu/data/augment.py; every entry point has a numpy fallback with
+// identical arithmetic (division, not reciprocal-multiply, so results
+// are bit-exact against the numpy oracle).
+//
+// Layout contract: HWC, C=3, uint8 source images.
+
+#include <cstdint>
+
+extern "C" {
+
+// Fused crop(th,tw at y0,x0) + optional horizontal mirror + per-channel
+// (v - mean[c]) / std[c] into float32 dst.  One pass, no temporaries
+// (the numpy chain materializes the crop, the mirrored copy, and the
+// float image separately).
+int dtaug_crop_mirror_norm(const uint8_t* src, int sh, int sw,
+                           float* dst, int th, int tw, int y0, int x0,
+                           int mirror, const float* mean,
+                           const float* stddev) {
+  if (y0 < 0 || x0 < 0 || y0 + th > sh || x0 + tw > sw) return -1;
+  for (int y = 0; y < th; ++y) {
+    const uint8_t* row = src + ((int64_t)(y0 + y) * sw + x0) * 3;
+    float* out = dst + (int64_t)y * tw * 3;
+    if (mirror) {
+      for (int x = 0; x < tw; ++x) {
+        const uint8_t* p = row + (int64_t)(tw - 1 - x) * 3;
+        out[x * 3 + 0] = ((float)p[0] - mean[0]) / stddev[0];
+        out[x * 3 + 1] = ((float)p[1] - mean[1]) / stddev[1];
+        out[x * 3 + 2] = ((float)p[2] - mean[2]) / stddev[2];
+      }
+    } else {
+      for (int x = 0; x < tw; ++x) {
+        const uint8_t* p = row + (int64_t)x * 3;
+        out[x * 3 + 0] = ((float)p[0] - mean[0]) / stddev[0];
+        out[x * 3 + 1] = ((float)p[1] - mean[1]) / stddev[1];
+        out[x * 3 + 2] = ((float)p[2] - mean[2]) / stddev[2];
+      }
+    }
+  }
+  return 0;
+}
+
+// Bilinear resize, half-pixel centers (align_corners=false — the
+// convention shared by OpenCV INTER_LINEAR and jax.image 'linear').
+int dtaug_resize_bilinear(const uint8_t* src, int sh, int sw,
+                          uint8_t* dst, int dh, int dw) {
+  if (sh <= 0 || sw <= 0 || dh <= 0 || dw <= 0) return -1;
+  const float ys = (float)sh / dh;
+  const float xs = (float)sw / dw;
+  for (int y = 0; y < dh; ++y) {
+    float fy = ((float)y + 0.5f) * ys - 0.5f;
+    int y0 = (int)fy;
+    if (fy < 0) { fy = 0; y0 = 0; }
+    int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+    const float wy = fy - (float)y0;
+    const uint8_t* r0 = src + (int64_t)y0 * sw * 3;
+    const uint8_t* r1 = src + (int64_t)y1 * sw * 3;
+    uint8_t* out = dst + (int64_t)y * dw * 3;
+    for (int x = 0; x < dw; ++x) {
+      float fx = ((float)x + 0.5f) * xs - 0.5f;
+      int x0 = (int)fx;
+      if (fx < 0) { fx = 0; x0 = 0; }
+      int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+      const float wx = fx - (float)x0;
+      for (int c = 0; c < 3; ++c) {
+        const float top = (float)r0[x0 * 3 + c] * (1.0f - wx)
+                        + (float)r0[x1 * 3 + c] * wx;
+        const float bot = (float)r1[x0 * 3 + c] * (1.0f - wx)
+                        + (float)r1[x1 * 3 + c] * wx;
+        const float v = top * (1.0f - wy) + bot * wy;
+        out[x * 3 + c] = (uint8_t)(v + 0.5f);
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -200,3 +200,86 @@ def native_read_batch(path: str, offsets: np.ndarray,
         out.append(buf[cursor:cursor + int(ln)].tobytes())
         cursor += int(ln)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Augmentation kernels (augment.cc) — own .so, same degrade-to-Python
+# contract as the others (reference: OpenCV inside
+# src/io/image_aug_default.cc)
+# ---------------------------------------------------------------------------
+
+_AUG_SO = os.path.join(_HERE, "libdtaug.so")
+_AUG_SRC = [os.path.join(_HERE, "augment.cc")]
+_aug_lock = threading.Lock()
+_aug_lib: Optional[ctypes.CDLL] = None
+_aug_failed = False
+
+
+def aug_lib() -> Optional[ctypes.CDLL]:
+    global _aug_lib, _aug_failed
+    with _aug_lock:
+        if _aug_lib is not None or _aug_failed:
+            return _aug_lib
+        L = _compile_and_load(_AUG_SO, _AUG_SRC)
+        if L is None:
+            _aug_failed = True
+            return None
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        L.dtaug_crop_mirror_norm.restype = ctypes.c_int
+        L.dtaug_crop_mirror_norm.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, f32p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            f32p, f32p]
+        L.dtaug_resize_bilinear.restype = ctypes.c_int
+        L.dtaug_resize_bilinear.argtypes = [
+            u8p, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_int,
+            ctypes.c_int]
+        _aug_lib = L
+        return _aug_lib
+
+
+def crop_mirror_norm(img: np.ndarray, y0: int, x0: int, th: int, tw: int,
+                     mirror: bool, mean: np.ndarray,
+                     std: np.ndarray) -> Optional[np.ndarray]:
+    """Fused crop+mirror+normalize -> (th, tw, 3) float32; None when the
+    native layer is unavailable or the image isn't u8 HWC-3."""
+    L = aug_lib()
+    if L is None or img.dtype != np.uint8 or img.ndim != 3 \
+            or img.shape[2] != 3:
+        return None
+    mean = np.ascontiguousarray(mean, np.float32).ravel()
+    std = np.ascontiguousarray(std, np.float32).ravel()
+    if mean.size != 3 or std.size != 3:
+        return None  # kernel reads exactly 3; numpy fallback broadcasts
+    img = np.ascontiguousarray(img)
+    out = np.empty((th, tw, 3), np.float32)
+    u8p = ctypes.POINTER(ctypes.c_ubyte)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    rc = L.dtaug_crop_mirror_norm(
+        img.ctypes.data_as(u8p), img.shape[0], img.shape[1],
+        out.ctypes.data_as(f32p), th, tw, y0, x0, int(mirror),
+        mean.ctypes.data_as(f32p), std.ctypes.data_as(f32p))
+    if rc != 0:
+        raise ValueError(f"crop ({y0},{x0},{th},{tw}) out of bounds for "
+                         f"{img.shape}")
+    return out
+
+
+def resize_bilinear(img: np.ndarray, dh: int, dw: int) \
+        -> Optional[np.ndarray]:
+    """Bilinear u8 HWC-3 resize (half-pixel centers); None if the native
+    layer is unavailable or the input isn't u8 HWC-3."""
+    L = aug_lib()
+    if L is None or img.dtype != np.uint8 or img.ndim != 3 \
+            or img.shape[2] != 3:
+        return None
+    img = np.ascontiguousarray(img)
+    out = np.empty((dh, dw, 3), np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_ubyte)
+    rc = L.dtaug_resize_bilinear(
+        img.ctypes.data_as(u8p), img.shape[0], img.shape[1],
+        out.ctypes.data_as(u8p), dh, dw)
+    if rc != 0:
+        raise ValueError(f"bad resize {img.shape} -> ({dh},{dw})")
+    return out
