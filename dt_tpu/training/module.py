@@ -97,7 +97,8 @@ class Module:
                  kvstore: Union[str, kvstore_lib.KVStore] = "local",
                  mesh=None, mesh_manager=None, seed: int = 0,
                  remat: bool = False, shard_opt_state: bool = False,
-                 shard_params: bool = False, async_key: str = "params"):
+                 shard_params: bool = False, async_key: str = "params",
+                 grad_accum: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self._optimizer_spec = None
@@ -142,6 +143,15 @@ class Module:
         # model outgrows a chip.  The reference has no analog (its workers
         # always held full replicas; only the SERVER side was split).
         self.shard_params = shard_params
+        # Microbatch gradient accumulation: the step splits each batch
+        # into `grad_accum` sequential microbatches under lax.scan and
+        # applies ONE averaged update — the reference's grad_req='add'
+        # multi-forward-backward aggregation (executor_group.py), here as
+        # a compiler-visible loop so activations of microbatch k die
+        # before k+1 runs (peak HBM ~ 1/accum of the monolithic batch).
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = int(grad_accum)
         # dist_async: names this Module's master-weight vector on the
         # scheduler.  Two Modules training against the same scheduler MUST
         # use distinct keys — attach is init-or-get, so a shared key makes
@@ -239,11 +249,51 @@ class Module:
             forward_loss = jax.checkpoint(forward_loss,
                                           static_argnums=())
 
+        accum = self.grad_accum
+
+        def compute_grads(params, batch_stats, data, labels, dropout_rng):
+            """(loss, logits, new_stats, grads) — one shot, or ``accum``
+            sequential microbatches under ``lax.scan`` (the reference's
+            ``grad_req='add'`` accumulation, ``executor_group.py`` grad
+            aggregation) with ONE weight update at the end.  Peak
+            activation memory drops by ~accum x (each microbatch's
+            activations die before the next starts); BN stats chain
+            through the microbatches exactly as they would through
+            sequential steps."""
+            if accum <= 1:
+                (loss, (logits, new_stats)), grads = jax.value_and_grad(
+                    forward_loss, has_aux=True)(params, batch_stats,
+                                                data, labels, dropout_rng)
+                return loss, logits, new_stats, grads
+
+            def micro(carry, xs):
+                stats, gsum = carry
+                d, lb, i = xs
+                (loss, (logits, stats)), grads = jax.value_and_grad(
+                    forward_loss, has_aux=True)(
+                    params, stats, d, lb,
+                    jax.random.fold_in(dropout_rng, i))
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (stats, gsum), (loss, logits)
+
+            if data.shape[0] % accum:
+                raise ValueError(
+                    f"grad_accum={accum} must divide the batch "
+                    f"({data.shape[0]})")
+            d_mb = data.reshape((accum, -1) + data.shape[1:])
+            l_mb = labels.reshape((accum, -1) + labels.shape[1:])
+            zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (new_stats, gsum), (losses, logits_mb) = jax.lax.scan(
+                micro, (batch_stats, zero_g),
+                (d_mb, l_mb, jnp.arange(accum)))
+            grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
+            logits = logits_mb.reshape((-1,) + logits_mb.shape[2:])
+            return losses.mean(), logits, new_stats, grads
+
         def train_step(state: TrainState, data, labels, rng):
             dropout_rng = jax.random.fold_in(rng, state.step)
-            (loss, (logits, new_stats)), grads = jax.value_and_grad(
-                forward_loss, has_aux=True)(state.params, state.batch_stats,
-                                            data, labels, dropout_rng)
+            loss, logits, new_stats, grads = compute_grads(
+                state.params, state.batch_stats, data, labels, dropout_rng)
             new_state = state.apply_gradients(grads)
             new_state = new_state.replace(batch_stats=new_stats)
             return new_state, loss, logits
@@ -318,9 +368,8 @@ class Module:
         # reference's epoch-end >= 10M-key averaging).
         def grad_step(state, data, labels, rng):
             dropout_rng = jax.random.fold_in(rng, state.step)
-            (loss, (logits, new_stats)), grads = jax.value_and_grad(
-                forward_loss, has_aux=True)(state.params, state.batch_stats,
-                                            data, labels, dropout_rng)
+            loss, logits, new_stats, grads = compute_grads(
+                state.params, state.batch_stats, data, labels, dropout_rng)
             # grads and BN stats travel separately: grads may be 2-bit
             # compressed on the wire, stats never are
             flat_g, _ = jax.flatten_util.ravel_pytree(grads)
