@@ -495,6 +495,35 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
     elif prim == "concatenate":
         out(g.add("Concat", [inp(k) for k in range(len(rec["invals"]))],
                   axis=params["dimension"]))
+    elif prim == "dynamic_slice":
+        # static-start case (starts are literals/consts — LRN windows,
+        # positional-embedding slices): ONNX Slice with baked indices
+        starts = []
+        for k in range(1, len(rec["invals"])):
+            v = rec["invals"][k]
+            if not (isinstance(v, tuple) and v[0] in ("lit", "cval")):
+                raise NotImplementedError(
+                    "dynamic_slice with traced start indices")
+            starts.append(int(np.asarray(v[1])))
+        sizes = params["slice_sizes"]
+        nd = aval(0).ndim
+        out(g.add("Slice", [
+            inp(0),
+            g.const(np.asarray(starts, np.int64)),
+            g.const(np.asarray([s + z for s, z in zip(starts, sizes)],
+                               np.int64)),
+            g.const(np.asarray(range(nd), np.int64))]))
+    elif prim == "slice":
+        starts = list(params["start_indices"])
+        limits = list(params["limit_indices"])
+        strides = params.get("strides") or [1] * len(starts)
+        nd = aval(0).ndim
+        out(g.add("Slice", [
+            inp(0),
+            g.const(np.asarray(starts, np.int64)),
+            g.const(np.asarray(limits, np.int64)),
+            g.const(np.asarray(range(nd), np.int64)),
+            g.const(np.asarray(strides, np.int64))]))
     elif prim == "split":
         sizes = [int(s) for s in params["sizes"]]
         out(g.add("Split", [inp(0), g.const(np.asarray(sizes, np.int64))],
@@ -873,6 +902,15 @@ def _run_node(node: dict, ins: List, jnp, lax, static: List = None):
     if op == "Gather":
         return [jnp.take(ins[0], ins[1].astype(np.int32),
                          axis=a.get("axis", 0))]
+    if op == "Slice":
+        starts = shp(1)
+        ends = shp(2)
+        axes = shp(3) if len(ins) > 3 else list(range(ins[0].ndim))
+        steps = shp(4) if len(ins) > 4 else [1] * len(starts)
+        idx = [slice(None)] * ins[0].ndim
+        for s, e, ax, st in zip(starts, ends, axes, steps):
+            idx[ax] = slice(s, e, st)
+        return [ins[0][tuple(idx)]]
     if op == "Split":
         sizes = [int(d) for d in np.asarray(static[1] if static[1]
                                             is not None else ins[1])]

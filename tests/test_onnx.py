@@ -112,6 +112,29 @@ def test_onnx_iota_dimension():
                                np.asarray(f(x)))
 
 
+@pytest.mark.parametrize("name,shape", [
+    ("alexnet", (1, 64, 64, 3)),       # LRN -> Slice ops
+    ("mobilenet", (1, 32, 32, 3)),     # depthwise conv (group attr)
+    ("squeezenet", (1, 64, 64, 3)),    # fire modules (Concat)
+    ("resnet18_v2", (1, 32, 32, 3)),   # pre-act BN ordering
+])
+def test_onnx_roundtrip_zoo(name, shape):
+    """Representative zoo coverage beyond the core tests — the full
+    13-model sweep (vgg/googlenet/resnext/inception_bn/densenet121 too)
+    round-trips; these four pin the distinct op patterns."""
+    model = models.create(name, num_classes=4)
+    x = jnp.asarray(np.random.RandomState(0)
+                    .uniform(-1, 1, shape).astype(np.float32))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x,
+                           training=False)
+    blob = donnx.export_onnx(model, x, variables=variables)
+    fn, params = donnx.import_onnx(blob)
+    np.testing.assert_allclose(
+        np.asarray(fn(params, x)),
+        np.asarray(model.apply(variables, x, training=False)),
+        rtol=1e-4, atol=1e-4)
+
+
 def test_onnx_semantic_guards():
     """Ops whose ONNX mapping would silently change semantics must refuse
     to export; their safe siblings must round-trip (round-4 review)."""
