@@ -137,3 +137,11 @@ def test_train_recommender_smoke():
     r = _run("train_recommender.py", "--epochs", "6", "--ratings", "2000",
              "--users", "80", "--items", "40")
     assert "variance-baseline" in r.stdout
+
+
+def test_train_text_cnn_smoke():
+    """Text-CNN (reference example/cnn_text_classification): Vocabulary
+    tokenization + Kim-2014 window branches learn the negation-flipped
+    polarity task."""
+    r = _run("train_text_cnn.py")  # defaults: 2048 examples, 5 epochs
+    assert "val_acc=" in r.stdout
