@@ -1,14 +1,27 @@
 """Inference/serving surface.
 
 Reference: the C predict API (``src/c_api/c_predict_api.cc``,
-``include/mxnet/c_predict_api.h``) — load a symbol+params checkpoint, bind
-at fixed shapes, feed forward.  Here: load a dt_tpu checkpoint (full
-TrainState), jit the eval forward once per input shape, serve numpy in/out.
+``include/mxnet/c_predict_api.h``) — load a symbol+params checkpoint,
+bind at fixed shapes (``MXPredCreate``), re-bind on shape change
+(``MXPredReshape``), feed forward (``MXPredForward`` +
+``MXPredGetOutput``).  Here: load a dt_tpu checkpoint (full TrainState)
+and jit the eval forward.  TPU-first differences:
+
+- **Batch bucketing** replaces per-shape re-binds: requests pad up to
+  the nearest declared batch bucket (default powers of two), so serving
+  arbitrary request sizes costs a handful of compiled programs, not one
+  per size — XLA compiles are expensive; re-binding per request the
+  MXPredReshape way would be pathological on TPU.
+- ``warmup()`` pre-compiles the buckets before traffic.
+- ``from_onnx`` serves a model imported through :mod:`dt_tpu.onnx`
+  (the C predict API's load-a-foreign-artifact role).
+- ``stats`` exposes request/compile counters for capacity planning.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Union
+import time
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -19,15 +32,30 @@ from dt_tpu.training import checkpoint as ckpt_lib
 from dt_tpu.training.train_state import TrainState
 
 
-class Predictor:
-    """``Predictor(model_or_name, prefix, epoch)`` -> ``predict(x)``.
+def _default_buckets(max_batch: int) -> list:
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
 
-    The jit cache shape-specializes per input shape (the C predict API's
-    ``MXPredReshape`` re-bind is automatic here).
+
+class Predictor:
+    """``Predictor(model_or_name, prefix, epoch, sample_input)`` ->
+    ``predict(x)``.
+
+    ``batch_buckets``: allowed compiled batch sizes (ascending); a
+    request of n rows pads to the smallest bucket >= n (and splits into
+    max-bucket chunks when larger).  ``None`` -> powers of two up to
+    ``max_batch`` (default 256).
     """
 
     def __init__(self, model: Union[str, object], prefix: str, epoch: int,
-                 sample_input: np.ndarray, dtype=jnp.float32, **model_kwargs):
+                 sample_input: np.ndarray, dtype=jnp.float32,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 max_batch: int = 256, **model_kwargs):
         if isinstance(model, str):
             model = models_lib.create(model, dtype=dtype, **model_kwargs)
         self.model = model
@@ -48,12 +76,90 @@ class Predictor:
             out = model.apply(v, x, training=False)
             return out[0] if isinstance(out, tuple) else out
 
-        self._fwd = jax.jit(fwd)
+        self._init_serving(fwd, batch_buckets, max_batch)
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        out = self._fwd(self.state.params, self.state.batch_stats,
-                        jnp.asarray(x, self.dtype))
-        return np.asarray(jax.device_get(out))
+    def _init_serving(self, fwd, batch_buckets, max_batch):
+        self._fwd = jax.jit(fwd)
+        self.batch_buckets = sorted(batch_buckets) if batch_buckets \
+            else _default_buckets(max_batch)
+        self.stats = {"requests": 0, "rows": 0, "compiles": 0,
+                      "serve_s": 0.0}
+        self._compiled = set()
+
+    @classmethod
+    def from_onnx(cls, model_bytes_or_path, dtype=jnp.float32,
+                  batch_buckets: Optional[Sequence[int]] = None,
+                  max_batch: int = 256) -> "Predictor":
+        """Serve an ONNX artifact (``dt_tpu.onnx.import_onnx``) with the
+        same bucketed pipeline — the reference's load-foreign-model
+        serving role (``onnx2mx`` -> Module.bind -> predict)."""
+        from dt_tpu import onnx as onnx_lib
+        fn, params = onnx_lib.import_onnx(model_bytes_or_path)
+        self = cls.__new__(cls)
+        self.model = None
+        self.state = None
+        self.dtype = dtype
+        self._onnx_params = params
+        self._init_serving(lambda params, _stats, x: fn(params, x),
+                           batch_buckets, max_batch)
+        return self
+
+    # ------------------------------------------------------------------
+
+    def _params_stats(self):
+        if self.state is not None:
+            return self.state.params, self.state.batch_stats
+        return self._onnx_params, {}
+
+    def _bucket_of(self, n: int) -> int:
+        for b in self.batch_buckets:
+            if b >= n:
+                return b
+        return self.batch_buckets[-1]
+
+    def warmup(self, feature_shape: Optional[tuple] = None,
+               buckets: Optional[Sequence[int]] = None) -> None:
+        """Pre-compile the bucket programs before serving traffic (the
+        first compile otherwise lands on a live request).
+        ``feature_shape``: per-row shape; required unless a request has
+        already established it."""
+        shape = feature_shape or getattr(self, "_row_shape", None)
+        if shape is None:
+            raise ValueError("warmup needs feature_shape before the "
+                             "first request")
+        for b in buckets or self.batch_buckets:
+            self.predict(np.zeros((b,) + tuple(shape), np.float32),
+                         _warmup=True)
+
+    def predict(self, x: np.ndarray, _warmup: bool = False) -> np.ndarray:
+        x = np.asarray(x)
+        self._row_shape = x.shape[1:]
+        n = x.shape[0]
+        t0 = time.perf_counter()
+        chunks = []
+        max_b = self.batch_buckets[-1]
+        params, stats = self._params_stats()
+        for start in range(0, n, max_b):
+            part = x[start:start + max_b]
+            b = self._bucket_of(len(part))
+            if b not in self._compiled:
+                self._compiled.add(b)
+                if not _warmup:
+                    self.stats["compiles"] += 1
+            if len(part) < b:  # pad up to the bucket, slice back after
+                pad = np.zeros((b - len(part),) + part.shape[1:],
+                               part.dtype)
+                padded = np.concatenate([part, pad])
+            else:
+                padded = part
+            out = self._fwd(params, stats,
+                            jnp.asarray(padded, self.dtype))
+            chunks.append(np.asarray(jax.device_get(out))[:len(part)])
+        if not _warmup:
+            self.stats["requests"] += 1
+            self.stats["rows"] += n
+            self.stats["serve_s"] += time.perf_counter() - t0
+        return chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
 
     def predict_proba(self, x: np.ndarray) -> np.ndarray:
         logits = self.predict(x)
