@@ -2,9 +2,9 @@
 
 Reference: the C predict API (``src/c_api/c_predict_api.cc``,
 ``include/mxnet/c_predict_api.h``) — load a symbol+params checkpoint,
-bind at fixed shapes (``MXPredCreate``), re-bind on shape change
-(``MXPredReshape``), feed forward (``MXPredForward`` +
-``MXPredGetOutput``).  Here: load a dt_tpu checkpoint (full TrainState)
+bind at fixed shapes (``MXPredCreate``, ``c_predict_api.cc:278``),
+re-bind on shape change (``MXPredReshape``, ``:339``), feed forward
+(``MXPredForward`` ``:461`` + ``MXPredGetOutput`` ``:477``).  Here: load a dt_tpu checkpoint (full TrainState)
 and jit the eval forward.  TPU-first differences:
 
 - **Batch bucketing** replaces per-shape re-binds: requests pad up to
@@ -100,8 +100,14 @@ class Predictor:
         self.state = None
         self.dtype = dtype
         self._onnx_params = params
-        self._init_serving(lambda params, _stats, x: fn(params, x),
-                           batch_buckets, max_batch)
+
+        def fwd(params, _stats, x):
+            out = fn(params, x)
+            # multi-output graphs: serve the first output like the
+            # checkpoint path's forward does
+            return out[0] if isinstance(out, tuple) else out
+
+        self._init_serving(fwd, batch_buckets, max_batch)
         return self
 
     # ------------------------------------------------------------------
@@ -136,14 +142,20 @@ class Predictor:
         self._row_shape = x.shape[1:]
         n = x.shape[0]
         t0 = time.perf_counter()
-        chunks = []
+        dev_outs = []  # (device array, real row count)
         max_b = self.batch_buckets[-1]
         params, stats = self._params_stats()
-        for start in range(0, n, max_b):
+        # an empty request still answers with the right feature shape:
+        # run the smallest bucket once and slice to zero rows
+        starts = range(0, n, max_b) if n else [0]
+        for start in starts:
             part = x[start:start + max_b]
             b = self._bucket_of(len(part))
-            if b not in self._compiled:
-                self._compiled.add(b)
+            # compiles are per (bucket, row shape, dtype) — a feature-
+            # shape change recompiles even for a known bucket
+            key = (b, part.shape[1:], str(self.dtype))
+            if key not in self._compiled:
+                self._compiled.add(key)
                 if not _warmup:
                     self.stats["compiles"] += 1
             if len(part) < b:  # pad up to the bucket, slice back after
@@ -152,9 +164,13 @@ class Predictor:
                 padded = np.concatenate([part, pad])
             else:
                 padded = part
-            out = self._fwd(params, stats,
-                            jnp.asarray(padded, self.dtype))
-            chunks.append(np.asarray(jax.device_get(out))[:len(part)])
+            # dispatch only — device_get after the loop, so chunk k+1's
+            # compute overlaps chunk k's device-to-host transfer
+            dev_outs.append((self._fwd(params, stats,
+                                       jnp.asarray(padded, self.dtype)),
+                             len(part)))
+        chunks = [np.asarray(jax.device_get(o))[:keep]
+                  for o, keep in dev_outs]
         if not _warmup:
             self.stats["requests"] += 1
             self.stats["rows"] += n

@@ -148,3 +148,20 @@ def test_predictor_from_onnx(tmp_path):
     want = np.asarray(model.apply(variables, jnp.asarray(x),
                                   training=False))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_empty_batch(tmp_path):
+    """A zero-row request answers with (0, C) instead of crashing."""
+    model = models.create("mlp", num_classes=3, hidden=(8,))
+    x = np.random.RandomState(3).rand(2, 6, 6, 1).astype(np.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           jnp.asarray(x), training=False)
+    from dt_tpu import optim
+    state = TrainState.create(model.apply, variables["params"],
+                              optim.create("sgd"), {})
+    prefix = str(tmp_path / "m")
+    checkpoint.save_checkpoint(prefix, 0, state)
+    pred = Predictor("mlp", prefix, 0, sample_input=x,
+                     batch_buckets=[2], num_classes=3, hidden=(8,))
+    out = pred.predict(x[:0])
+    assert out.shape == (0, 3)
