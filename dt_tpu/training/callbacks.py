@@ -74,16 +74,24 @@ class Speedometer:
             self.tic = time.time()
 
 
-def do_checkpoint(prefix: str, period: int = 1, meta: Optional[dict] = None):
+def do_checkpoint(prefix: str, period: int = 1, meta: Optional[dict] = None,
+                  async_save: bool = False):
     """Epoch-end callback saving the FULL TrainState every ``period`` epochs
     (reference ``mx.callback.do_checkpoint`` — but including optimizer state,
-    closing the reference's dist-checkpoint gap)."""
+    closing the reference's dist-checkpoint gap).  ``async_save=True``
+    overlaps serialization/IO with the next epoch's compute."""
     period = max(period, 1)
 
     def _callback(epoch: int, state, metrics=None):
         if (epoch + 1) % period == 0:
-            path = ckpt_lib.save_checkpoint(prefix, epoch, state, meta)
-            logger.info("Saved checkpoint to \"%s\"", path)
+            out = ckpt_lib.save_checkpoint(prefix, epoch, state, meta,
+                                           async_save=async_save)
+            if async_save:
+                out.add_done_callback(
+                    lambda f: logger.info("Saved checkpoint to \"%s\"",
+                                          f.result()))
+            else:
+                logger.info("Saved checkpoint to \"%s\"", out)
     return _callback
 
 
