@@ -1,11 +1,17 @@
-"""Long-context Transformer LM with sequence parallelism.
+"""Long-context Transformer LM with sequence/tensor parallelism.
 
 Beyond the reference's RNN ceiling: causal TransformerLM whose attention
 shards the sequence over the mesh (``--seq-parallel ring|ulysses``), so
-context length scales with devices.
+context length scales with devices; ``--tensor-parallel N`` additionally
+shards the QKV/MLP matmuls over an N-way ``model`` axis (the reference's
+``example/model-parallel`` role, done as GSPMD sharding annotations
+instead of manual layer placement).
 
     python examples/train_transformer_lm.py --seq-len 4096 \
         --seq-parallel ring --num-layers 4 --embed-dim 256
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 DT_FORCE_CPU=1 \
+    python examples/train_transformer_lm.py --tensor-parallel 2 \
+        --seq-parallel ring
 """
 
 import argparse
@@ -29,6 +35,9 @@ def main():
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--seq-parallel", default=None,
                     choices=[None, "ring", "ulysses"])
+    ap.add_argument("--tensor-parallel", type=int, default=1,
+                    help="shard QKV/MLP weights over an N-way 'model' "
+                         "mesh axis (devices must be divisible by N)")
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     args = ap.parse_args()
@@ -45,11 +54,20 @@ def main():
     from dt_tpu.parallel import mesh as mesh_lib
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    mesh = mesh_lib.make_mesh() if args.seq_parallel else None
+    tp = args.tensor_parallel
+    if tp > 1:
+        n_dev = len(jax.devices())
+        if n_dev % tp:
+            raise SystemExit(f"--tensor-parallel {tp} does not divide "
+                             f"{n_dev} devices")
+        mesh = mesh_lib.make_mesh(data=n_dev // tp, model=tp)
+    else:
+        mesh = mesh_lib.make_mesh() if args.seq_parallel else None
     model = models.TransformerLM(
         vocab_size=args.vocab_size, embed_dim=args.embed_dim,
         num_layers=args.num_layers, num_heads=args.num_heads,
         max_len=args.seq_len, seq_parallel=args.seq_parallel, mesh=mesh,
+        axis_name="model" if tp > 1 else "data",
         dtype=dtype)
 
     rng = np.random.RandomState(0)
@@ -58,6 +76,22 @@ def main():
     variables = model.init({"params": jax.random.PRNGKey(0)}, toks,
                            training=False)
     params = variables["params"]
+    if tp > 1:
+        # tensor parallelism: column-shard qkv/mlp_in, row-shard the
+        # projections; GSPMD inserts the activation collectives
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shard_param(path, p):
+            name = "/".join(str(k.key) for k in path if hasattr(k, "key"))
+            if p.ndim == 2 and ("qkv" in name or "mlp_in" in name):
+                return jax.device_put(p, NamedSharding(mesh,
+                                                       P(None, "model")))
+            if p.ndim == 2 and ("proj" in name or "mlp_out" in name):
+                return jax.device_put(p, NamedSharding(mesh,
+                                                       P("model", None)))
+            return jax.device_put(p, NamedSharding(mesh, P()))
+
+        params = jax.tree_util.tree_map_with_path(shard_param, params)
     tx = optim.create("adam", learning_rate=args.lr)
     opt_state = tx.init(params)
 
@@ -80,8 +114,8 @@ def main():
     jax.block_until_ready(loss)
     dt = time.time() - t0
     tok_s = args.steps * args.batch_size * args.seq_len / dt
-    logging.info("seq_parallel=%s loss %.3f | %.0f tokens/sec",
-                 args.seq_parallel, float(loss), tok_s)
+    logging.info("seq_parallel=%s tp=%d loss %.3f | %.0f tokens/sec",
+                 args.seq_parallel, tp, float(loss), tok_s)
 
 
 if __name__ == "__main__":

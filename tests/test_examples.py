@@ -145,3 +145,19 @@ def test_train_text_cnn_smoke():
     polarity task."""
     r = _run("train_text_cnn.py")  # defaults: 2048 examples, 5 epochs
     assert "val_acc=" in r.stdout
+
+
+def test_train_transformer_tp_smoke():
+    """--tensor-parallel 2 shards QKV/MLP over a 'model' axis on the
+    8-device CPU mesh (reference example/model-parallel role)."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["DT_FORCE_CPU"] = "1"
+    r = subprocess.run(
+        [sys.executable, os.path.join(EX, "train_transformer_lm.py"),
+         "--tensor-parallel", "2", "--seq-parallel", "ring",
+         "--seq-len", "64", "--embed-dim", "64", "--num-layers", "2",
+         "--num-heads", "4", "--batch-size", "4", "--steps", "2"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "tp=2" in r.stderr + r.stdout
