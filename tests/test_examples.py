@@ -161,3 +161,11 @@ def test_train_transformer_tp_smoke():
         capture_output=True, text=True, timeout=300, env=env)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "tp=2" in r.stderr + r.stdout
+
+
+def test_train_ctc_ocr_smoke():
+    """CTC OCR (reference example/ctc + captcha): column-strip conv
+    encoder + ctc_loss learns unaligned digit sequences to perfect val
+    sequence accuracy."""
+    r = _run("train_ctc_ocr.py", timeout=420)
+    assert "sequence_acc=" in r.stdout
