@@ -87,9 +87,19 @@ def do_checkpoint(prefix: str, period: int = 1, meta: Optional[dict] = None,
             out = ckpt_lib.save_checkpoint(prefix, epoch, state, meta,
                                            async_save=async_save)
             if async_save:
-                out.add_done_callback(
-                    lambda f: logger.info("Saved checkpoint to \"%s\"",
-                                          f.result()))
+                def _report(f):
+                    err = f.exception()
+                    if err is not None:
+                        # surface the failure loudly: the sync path would
+                        # have aborted training; silently continuing
+                        # leaves the user with no checkpoints at all
+                        logger.error(
+                            "ASYNC CHECKPOINT WRITE FAILED (%s) — later "
+                            "restores will miss this epoch", err)
+                    else:
+                        logger.info("Saved checkpoint to \"%s\"",
+                                    f.result())
+                out.add_done_callback(_report)
             else:
                 logger.info("Saved checkpoint to \"%s\"", out)
     return _callback

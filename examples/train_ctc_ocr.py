@@ -61,6 +61,12 @@ def main():
     ap.add_argument("--lr", type=float, default=2e-3)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    # render places digits at x<=2 start + 4 columns each; labels must
+    # never name digits the image cannot contain
+    need = 2 + 4 * args.max_digits - 1
+    if args.width < need:
+        ap.error(f"--width {args.width} cannot fit --max-digits "
+                 f"{args.max_digits} (needs >= {need})")
 
     from dt_tpu.config import maybe_force_cpu
     maybe_force_cpu()

@@ -73,6 +73,16 @@ def main():
     rng = np.random.RandomState(0)
     toks = jnp.asarray(rng.randint(0, args.vocab_size,
                                    (args.batch_size, args.seq_len)))
+    if tp > 1:
+        # Megatron + SP layout: batch data-parallel over 'data', weights
+        # + sequence over 'model' — without this the data-axis replicas
+        # would all compute the same unsharded batch
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        dp = mesh.shape["data"]
+        if args.batch_size % dp:
+            raise SystemExit(f"--batch-size {args.batch_size} must be "
+                             f"divisible by the data axis ({dp})")
+        toks = jax.device_put(toks, NamedSharding(mesh, P("data", None)))
     variables = model.init({"params": jax.random.PRNGKey(0)}, toks,
                            training=False)
     params = variables["params"]
