@@ -169,3 +169,18 @@ def test_train_ctc_ocr_smoke():
     sequence accuracy."""
     r = _run("train_ctc_ocr.py", timeout=420)
     assert "sequence_acc=" in r.stdout
+
+
+def test_train_fcn_seg_smoke():
+    """FCN segmentation (reference example/fcn-xs): deconv ladder with
+    skip fusion reaches >0.85 pixel acc / >0.5 fg mIoU."""
+    r = _run("train_fcn_seg.py", "--epochs", "6", "--num-examples",
+             "192")
+    assert "fg_mIoU=" in r.stdout
+
+
+def test_train_vae_smoke():
+    """VAE (reference mxnet_adversarial_vae's VAE half): reparameterized
+    ELBO on digits reconstructs at < 0.5x the mean baseline."""
+    r = _run("train_vae.py", timeout=420)
+    assert "recon_mse=" in r.stdout
