@@ -184,3 +184,10 @@ def test_train_vae_smoke():
     ELBO on digits reconstructs at < 0.5x the mean baseline."""
     r = _run("train_vae.py", timeout=420)
     assert "recon_mse=" in r.stdout
+
+
+def test_train_bilstm_sort_smoke():
+    """bi-LSTM sort (reference example/bi-lstm-sort): the fused-scan
+    bidirectional LSTM learns seq->sorted-seq transduction."""
+    r = _run("train_bilstm_sort.py", timeout=420)
+    assert "token_acc=" in r.stdout
