@@ -191,3 +191,12 @@ def test_train_bilstm_sort_smoke():
     bidirectional LSTM learns seq->sorted-seq transduction."""
     r = _run("train_bilstm_sort.py", timeout=420)
     assert "token_acc=" in r.stdout
+
+
+def test_train_dec_smoke():
+    """DEC (reference example/deep-embedded-clustering): AE pretrain ->
+    k-means init -> Student-t/KL sharpening must not degrade and must
+    beat 0.6 clustering accuracy on digits."""
+    r = _run("train_dec.py", "--pretrain-epochs", "15",
+             "--dec-epochs", "15", timeout=420)
+    assert "DEC refined" in r.stdout
