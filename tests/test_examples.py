@@ -197,6 +197,5 @@ def test_train_dec_smoke():
     """DEC (reference example/deep-embedded-clustering): AE pretrain ->
     k-means init -> Student-t/KL sharpening must not degrade and must
     beat 0.6 clustering accuracy on digits."""
-    r = _run("train_dec.py", "--pretrain-epochs", "15",
-             "--dec-epochs", "15", timeout=420)
+    r = _run("train_dec.py", timeout=420)  # defaults: 30+30 epochs
     assert "DEC refined" in r.stdout
