@@ -199,3 +199,10 @@ def test_train_dec_smoke():
     beat 0.6 clustering accuracy on digits."""
     r = _run("train_dec.py", timeout=420)  # defaults: 30+30 epochs
     assert "DEC refined" in r.stdout
+
+
+def test_train_adversary_smoke():
+    """FGSM adversary (reference example/adversary): attack collapses
+    accuracy; adversarial retraining recovers robustness."""
+    r = _run("train_adversary.py", timeout=420)
+    assert "after adversarial training" in r.stdout
