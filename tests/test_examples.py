@@ -206,3 +206,11 @@ def test_train_adversary_smoke():
     accuracy; adversarial retraining recovers robustness."""
     r = _run("train_adversary.py", timeout=420)
     assert "after adversarial training" in r.stdout
+
+
+def test_neural_style_smoke():
+    """Neural style (reference example/neural-style): input-space
+    optimization drops the Gram style loss >5x while staying closer to
+    content than the style image is."""
+    r = _run("neural_style.py", "--steps", "250", timeout=420)
+    assert "x down)" in r.stdout
