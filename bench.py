@@ -49,8 +49,10 @@ def _emit_failure(err):
             lines = [ln for ln in f if "preflight attempt" in ln]
         if lines:
             extra["watchdog_preflight_attempts"] = len(lines)
-            extra["watchdog_first_attempt"] = lines[0].split("]")[0][1:]
-            extra["watchdog_last_attempt"] = lines[-1].split("]")[0][1:]
+            def ts(ln):  # "[watchdog HH:MM:SS] ..." -> "HH:MM:SS"
+                return ln.split("]")[0][len("[watchdog "):]
+            extra["watchdog_first_attempt"] = ts(lines[0])
+            extra["watchdog_last_attempt"] = ts(lines[-1])
     except OSError:
         pass
     print(json.dumps({

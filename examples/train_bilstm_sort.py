@@ -50,10 +50,20 @@ def main():
     E, H, L = 32, args.hidden, args.layers
     key = jax.random.PRNGKey(args.seed)
     k_emb, k_f, k_b, k_out = jax.random.split(key, 4)
+
+    def bi_weights(k):
+        # layer 0 consumes the E-dim embedding; upper layers consume the
+        # 2H fwd/bwd concat (bidirectional_lstm's cuDNN-style stacking)
+        ws = rnn.init_lstm_weights(k, 1, E, H)
+        for layer in range(1, L):
+            k, sub = jax.random.split(k)
+            ws += rnn.init_lstm_weights(sub, 1, 2 * H, H)
+        return ws
+
     params = {
         "embed": 0.1 * jax.random.normal(k_emb, (args.vocab, E)),
-        "fwd": rnn.init_lstm_weights(k_f, L, E, H),
-        "bwd": rnn.init_lstm_weights(k_b, L, E, H),
+        "fwd": bi_weights(k_f),
+        "bwd": bi_weights(k_b),
         "w_out": 0.1 * jax.random.normal(k_out, (2 * H, args.vocab)),
         "b_out": jnp.zeros((args.vocab,)),
     }

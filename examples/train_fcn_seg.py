@@ -59,6 +59,7 @@ def main():
     import numpy as np
     import optax
     from dt_tpu import data
+    from dt_tpu.ops import losses
 
     NCLS = 3
     hw = args.image_size
@@ -100,9 +101,9 @@ def main():
     def step(params, opt, xb, mb):
         def loss_of(p):
             logits = model.apply({"params": p}, xb)  # (B, H, W, C)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            ll = jnp.take_along_axis(logp, mb[..., None], axis=-1)
-            return -ll.mean()
+            # shared per-pixel CE (handles leading dims + f32 upcast)
+            return losses.softmax_cross_entropy(
+                logits.reshape(-1, NCLS), mb.reshape(-1))
         loss, grads = jax.value_and_grad(loss_of)(params)
         upd, opt = tx.update(grads, opt, params)
         return optax.apply_updates(params, upd), opt, loss

@@ -58,10 +58,6 @@ def main():
             recon = linen.Dense(D, name="dec_out")(h)
             return recon, mu, logvar
 
-        def decode(self, z):
-            h = jax.nn.relu(linen.Dense(args.hidden, name="dec1")(z))
-            return linen.Dense(D, name="dec_out")(h)
-
     model = VAE()
     key = jax.random.PRNGKey(args.seed)
     params = model.init({"params": key}, jnp.asarray(x[:1]), key)["params"]
@@ -99,17 +95,18 @@ def main():
               flush=True)
 
     # held-out reconstruction through the MEAN latent (no sampling
-    # noise): re-apply the named sublayers directly
-    def dense(name, width, v):
+    # noise): re-apply the named sublayers with the TRACED params (a
+    # closure over the outer variable would bake weights into the jit)
+    def dense(p, name, width, v):
         return linen.Dense(width, name=name).apply(
-            {"params": params[name]}, v)
+            {"params": p[name]}, v)
 
     @jax.jit
-    def recon_mean(params, xb):
-        h = jax.nn.relu(dense("enc1", args.hidden, xb))
-        mu = dense("mu", args.latent, h)
-        h2 = jax.nn.relu(dense("dec1", args.hidden, mu))
-        return dense("dec_out", D, h2)
+    def recon_mean(p, xb):
+        h = jax.nn.relu(dense(p, "enc1", args.hidden, xb))
+        mu = dense(p, "mu", args.latent, h)
+        h2 = jax.nn.relu(dense(p, "dec1", args.hidden, mu))
+        return dense(p, "dec_out", D, h2)
 
     rec = np.asarray(recon_mean(params, jnp.asarray(x[:n_val])))
     mse = float(np.mean((rec - x[:n_val]) ** 2))
@@ -119,9 +116,9 @@ def main():
 
     # prior samples decode to digit-like pixel statistics (in-range)
     z = jax.random.normal(jax.random.PRNGKey(7), (16, args.latent))
-    samples = np.asarray(dense("dec_out", D,
-                               jax.nn.relu(dense("dec1", args.hidden,
-                                                 z))))
+    samples = np.asarray(dense(params, "dec_out", D,
+                               jax.nn.relu(dense(params, "dec1",
+                                                 args.hidden, z))))
     print(f"prior-sample pixel range [{samples.min():.2f}, "
           f"{samples.max():.2f}]")
     return 0
