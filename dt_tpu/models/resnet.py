@@ -134,6 +134,15 @@ class ResNet(linen.Module):
     num_classes: int = 1000
     version: int = 1
     dtype: Any = jnp.float32
+    # Per-BLOCK rematerialization (the reference's
+    # MXNET_BACKWARD_DO_MIRROR memory mirror, applied at the residual-
+    # block granularity its planner used): each block's activations are
+    # recomputed during backward instead of stored, so live activation
+    # memory is ~one block deep instead of the whole network.  Wrapping
+    # the WHOLE forward in jax.checkpoint would NOT save memory (the
+    # rematerialized forward is all live at once) — block granularity is
+    # what makes it real; verified by tools/memcost.py.
+    remat: bool = False
 
     @linen.compact
     def __call__(self, x, training: bool = True):
@@ -142,6 +151,11 @@ class ResNet(linen.Module):
             block = BasicBlockV1 if block_type == "basic" else BottleneckV1
         else:
             block = BasicBlockV2 if block_type == "basic" else BottleneckV2
+        base_name = block.__name__  # before wrapping: explicit names keep
+        # the param tree identical with/without remat (checkpoints
+        # interchange; linen.remat's auto-prefix would rename every block)
+        if self.remat:
+            block = linen.remat(block, static_argnums=(2,))
 
         x = linen.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                        use_bias=False, dtype=self.dtype)(x)
@@ -152,12 +166,15 @@ class ResNet(linen.Module):
 
         expansion = 1 if block_type == "basic" else 4
         in_features = 64
+        blk_idx = 0
         for stage, (nblk, f) in enumerate(zip(stages, _FILTERS)):
             for i in range(nblk):
                 strides = (2, 2) if (i == 0 and stage > 0) else (1, 1)
                 down = (i == 0) and (strides != (1, 1) or
                                      in_features != f * expansion)
-                x = block(f, strides, down, self.dtype)(x, training)
+                x = block(f, strides, down, self.dtype,
+                          name=f"{base_name}_{blk_idx}")(x, training)
+                blk_idx += 1
                 in_features = f * expansion
 
         if self.version == 2:
@@ -173,19 +190,26 @@ class CifarResNet(linen.Module):
     depth: int = 20
     num_classes: int = 10
     dtype: Any = jnp.float32
+    remat: bool = False  # per-block memory mirror (see ResNet.remat)
 
     @linen.compact
     def __call__(self, x, training: bool = True):
         assert (self.depth - 2) % 6 == 0, "CIFAR ResNet depth must be 6n+2"
         n = (self.depth - 2) // 6
+        block = linen.remat(BasicBlockV2, static_argnums=(2,)) \
+            if self.remat else BasicBlockV2
         x = linen.Conv(16, (3, 3), padding="SAME", use_bias=False,
                        dtype=self.dtype)(x)
         in_f = 16
+        blk_idx = 0
         for stage, f in enumerate([16, 32, 64]):
             for i in range(n):
                 strides = (2, 2) if (i == 0 and stage > 0) else (1, 1)
                 down = (i == 0) and (strides != (1, 1) or in_f != f)
-                x = BasicBlockV2(f, strides, down, self.dtype)(x, training)
+                # explicit names: param tree identical with/without remat
+                x = block(f, strides, down, self.dtype,
+                          name=f"BasicBlockV2_{blk_idx}")(x, training)
+                blk_idx += 1
                 in_f = f
         x = _bn(training, self.dtype)(x)
         x = jax.nn.relu(x)

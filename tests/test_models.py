@@ -137,3 +137,37 @@ def test_bf16_dtype_flows_through():
 def test_unknown_model_raises():
     with pytest.raises(ValueError, match="unknown model"):
         models.create("resnext9000")
+
+
+def test_resnet_per_block_remat_equivalence():
+    """models.create(..., remat=True) (per-block memory mirror,
+    MXNET_BACKWARD_DO_MIRROR analog) must be a numerical no-op: same
+    outputs AND same grads, only the backward's memory schedule differs
+    (memory effect is TPU-only; XLA CPU folds the recompute away —
+    tools/memcost.py documents this)."""
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu import models
+    from dt_tpu.ops import losses
+
+    x = jnp.asarray(np.random.RandomState(0)
+                    .uniform(-1, 1, (2, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray([1, 3])
+    outs = {}
+    for remat in (False, True):
+        m = models.create("resnet20_cifar", num_classes=4, remat=remat)
+        v = m.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+
+        def loss(p):
+            out, _ = m.apply({"params": p,
+                              "batch_stats": v["batch_stats"]},
+                             x, training=True, mutable=["batch_stats"])
+            return losses.softmax_cross_entropy(out, y)
+        l, g = jax.value_and_grad(loss)(v["params"])
+        flat, _ = jax.flatten_util.ravel_pytree(g)
+        outs[remat] = (float(l), np.asarray(flat))
+    assert outs[False][0] == outs[True][0]
+    np.testing.assert_allclose(outs[False][1], outs[True][1],
+                               rtol=1e-6, atol=1e-6)

@@ -28,4 +28,6 @@ DT_BENCH_MODEL=alexnet DT_BENCH_BATCH=512 \
   timeout 1200 python bench.py --run || true
 echo "[watchdog $(date +%T)] profiling resnet152 step" >&2
 timeout 1800 python tools/profile_step.py || true
+echo "[watchdog $(date +%T)] memcost on TPU (remat rows need the chip)" >&2
+timeout 900 python tools/memcost.py || true
 echo "[watchdog $(date +%T)] all done" >&2

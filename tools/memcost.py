@@ -1,0 +1,101 @@
+"""Memory-cost evidence: remat + grad_accum HBM savings from XLA's own
+buffer assignment.
+
+Reference: ``example/memcost`` (tables of MXNET_BACKWARD_DO_MIRROR /
+inplace savings measured by the reference's memory planner).  TPU-first
+analog: compile the REAL ``Module`` train step with each memory knob and
+read ``compiled.memory_analysis()`` — XLA's buffer assignment is the
+ground truth for what the step will hold in HBM (temp = activations +
+workspaces; the quantity remat and microbatching exist to shrink).
+
+Writes ``MEMCOST_r04.json`` and prints one row per config.
+
+Run: ``DT_FORCE_CPU=1 python tools/memcost.py`` (the buffer assignment
+is computed by the same XLA pipeline on any backend; absolute bytes
+differ on TPU but the RATIOS hold).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def measure(net, batch, size, remat, grad_accum):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu import data, models
+    from dt_tpu.training import Module
+
+    # remat is the MODEL-level per-block knob (models.create(...,
+    # remat=True)); Module(remat=True)'s whole-loss checkpoint is
+    # memory-neutral by construction (one segment) — this tool is what
+    # exposed that, so it measures the knob that works
+    mod = Module(models.create(net, num_classes=10, remat=remat),
+                 optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                 grad_accum=grad_accum)
+    x = np.zeros((batch, size, size, 3), np.float32)
+    y = np.zeros((batch,), np.int32)
+    mod.init_params(x[:1])
+    mod._build_steps()
+    rng = jax.random.PRNGKey(0)
+    lowered = mod._train_step.lower(mod.state, jnp.asarray(x),
+                                    jnp.asarray(y), rng)
+    m = lowered.compile().memory_analysis()
+    return {
+        "config": f"remat={int(remat)} grad_accum={grad_accum}",
+        "temp_mb": round(m.temp_size_in_bytes / 2**20, 2),
+        "peak_mb": round(m.peak_memory_in_bytes / 2**20, 2),
+        "args_mb": round(m.argument_size_in_bytes / 2**20, 2),
+        "output_mb": round(m.output_size_in_bytes / 2**20, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet20_cifar")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=32)
+    args = ap.parse_args()
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+
+    rows = []
+    for remat, accum in ((False, 1), (True, 1), (False, 4), (True, 4)):
+        r = measure(args.model, args.batch, args.image_size, remat, accum)
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+
+    base = rows[0]["temp_mb"]
+    summary = {
+        "what": "XLA buffer-assignment memory for the real Module train "
+                "step under the memory knobs (reference example/memcost "
+                "analog; temp = activations+workspace, the remat target)",
+        "model": args.model, "batch": args.batch,
+        "image_size": args.image_size,
+        "backend_note": (
+            "grad_accum ratios are backend-independent (the scan "
+            "structurally shrinks live activations).  The remat rows are "
+            "ONLY meaningful on a TPU backend: XLA CPU folds jax.checkpoint "
+            "recomputation away entirely (verified: identical HLO flops "
+            "and temp bytes with/without remat on CPU), so run this tool "
+            "on the chip for the remat column"),
+        "rows": rows,
+        "temp_savings": {
+            r["config"]: round(base / max(r["temp_mb"], 1e-9), 2)
+            for r in rows},
+    }
+    with open(os.path.join(REPO, "MEMCOST_r04.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps({"out": "MEMCOST_r04.json",
+                      "temp_savings": summary["temp_savings"]}))
+
+
+if __name__ == "__main__":
+    main()
