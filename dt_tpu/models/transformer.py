@@ -126,6 +126,14 @@ class TransformerLM(linen.Module):
     moe_experts: int = 0
     moe_axis: str = "model"
     dtype: Any = jnp.float32
+    # Per-LAYER rematerialization: each decoder block's activations are
+    # recomputed in backward instead of stored — at long context this is
+    # the difference between O(layers * S * d) and O(S * d) live
+    # activation HBM (the reference's memory mirror; composes with
+    # ring/ulysses sequence parallelism and grad_accum).  Stable
+    # `block{i}` names keep checkpoints interchangeable.  Memory effect
+    # is TPU-real; XLA CPU folds recompute away (tools/memcost.py).
+    remat: bool = False
 
     @linen.compact
     def __call__(self, tokens, training: bool = True):
@@ -136,11 +144,13 @@ class TransformerLM(linen.Module):
         pos = self.param("pos_embed", linen.initializers.normal(0.02),
                          (self.max_len, self.embed_dim), self.dtype)
         x = x + pos[None, :s]
+        block_cls = linen.remat(DecoderBlock, static_argnums=(2,)) \
+            if self.remat else DecoderBlock
         for i in range(self.num_layers):
-            x = DecoderBlock(self.num_heads, 4, self.seq_parallel, self.mesh,
-                             self.axis_name, self.dropout,
-                             self.moe_experts, self.moe_axis,
-                             self.dtype, name=f"block{i}")(x, training)
+            x = block_cls(self.num_heads, 4, self.seq_parallel, self.mesh,
+                          self.axis_name, self.dropout,
+                          self.moe_experts, self.moe_axis,
+                          self.dtype, name=f"block{i}")(x, training)
         x = linen.LayerNorm(dtype=self.dtype)(x)
         return linen.Dense(self.vocab_size, use_bias=False,
                            dtype=self.dtype, name="lm_head")(x)

@@ -171,3 +171,34 @@ def test_resnet_per_block_remat_equivalence():
     assert outs[False][0] == outs[True][0]
     np.testing.assert_allclose(outs[False][1], outs[True][1],
                                rtol=1e-6, atol=1e-6)
+
+
+def test_transformer_per_layer_remat_equivalence():
+    """TransformerLM(remat=True): per-decoder-block memory mirror is a
+    numerical no-op with an identical param tree (stable block{i}
+    names)."""
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu import models
+    from dt_tpu.ops import losses
+
+    x = jnp.asarray(np.random.RandomState(0).randint(0, 50, (2, 16)))
+    outs = {}
+    for remat in (False, True):
+        m = models.create("transformer_lm", vocab_size=50, num_layers=2,
+                          embed_dim=32, num_heads=4, max_len=16,
+                          remat=remat)
+        v = m.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+
+        def loss(p):
+            lg = m.apply({"params": p}, x, training=False)
+            return losses.softmax_cross_entropy(lg.reshape(-1, 50),
+                                                x.reshape(-1))
+        l, g = jax.value_and_grad(loss)(v["params"])
+        flat, _ = jax.flatten_util.ravel_pytree(g)
+        outs[remat] = (float(l), np.asarray(flat))
+    assert outs[False][0] == outs[True][0]
+    np.testing.assert_allclose(outs[False][1], outs[True][1], rtol=1e-6,
+                               atol=1e-7)
