@@ -39,6 +39,13 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     ap.add_argument("--load-epoch", type=int, default=None)
     ap.add_argument("--disp-batches", type=int, default=20)
     ap.add_argument("--benchmark", type=int, default=0)
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="microbatches per update (grad_req='add' "
+                         "analog; peak activation HBM ~1/N)")
+    ap.add_argument("--remat", type=int, default=0,
+                    help="per-block rematerialization (memory mirror, "
+                         "MXNET_BACKWARD_DO_MIRROR analog) for models "
+                         "that support it")
     ap.add_argument("--data-train", default=None, help=".rec file")
     ap.add_argument("--data-val", default=None, help=".rec file")
     ap.add_argument("--dtype", default="float32",
@@ -96,8 +103,11 @@ def make_module(args, steps_per_epoch: int, kv=None):
     from dt_tpu import models
     from dt_tpu.training import Module
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    kwargs = {}
+    if getattr(args, "remat", 0):
+        kwargs["remat"] = True  # resnets/transformer support per-block
     model = models.create(args.network, num_classes=args.num_classes,
-                          dtype=dtype)
+                          dtype=dtype, **kwargs)
     sched = make_scheduler(args, steps_per_epoch)
     mod = Module(model, optimizer=args.optimizer,
                  optimizer_params={"learning_rate": sched,
@@ -106,7 +116,8 @@ def make_module(args, steps_per_epoch: int, kv=None):
                                    "multi_precision":
                                        args.dtype == "bfloat16"},
                  kvstore=kv if kv is not None else args.kv_store,
-                 seed=args.seed)
+                 seed=args.seed,
+                 grad_accum=getattr(args, "grad_accum", 1))
     return mod
 
 
