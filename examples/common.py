@@ -106,8 +106,16 @@ def make_module(args, steps_per_epoch: int, kv=None):
     kwargs = {}
     if getattr(args, "remat", 0):
         kwargs["remat"] = True  # resnets/transformer support per-block
-    model = models.create(args.network, num_classes=args.num_classes,
-                          dtype=dtype, **kwargs)
+    try:
+        model = models.create(args.network, num_classes=args.num_classes,
+                              dtype=dtype, **kwargs)
+    except TypeError:
+        if "remat" in kwargs:
+            raise SystemExit(
+                f"--remat is not supported by '{args.network}' (per-block "
+                f"rematerialization exists for the resnet families and "
+                f"transformer_lm)")
+        raise
     sched = make_scheduler(args, steps_per_epoch)
     mod = Module(model, optimizer=args.optimizer,
                  optimizer_params={"learning_rate": sched,

@@ -28,7 +28,7 @@ def measure(net, batch, size, remat, grad_accum):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from dt_tpu import data, models
+    from dt_tpu import models
     from dt_tpu.training import Module
 
     # remat is the MODEL-level per-block knob (models.create(...,
