@@ -122,10 +122,13 @@ class Module:
         # set): elastic world rebuilds re-hit cached programs instead of
         # paying full recompiles (SURVEY §7 mesh-resize mitigation).
         config_lib.enable_compilation_cache()
-        # Rematerialization: recompute activations in the backward pass
-        # instead of storing them — the reference's memory mirror
-        # (MXNET_BACKWARD_DO_MIRROR, SURVEY §5.6; BASELINE row 'Inception-v3
-        # w/ memory mirror'), as jax.checkpoint around the forward.
+        # Whole-loss jax.checkpoint.  NOTE (r4, tools/memcost.py): a
+        # SINGLE checkpoint segment is memory-neutral — the recomputed
+        # forward is all live at once — so the real memory mirror
+        # (MXNET_BACKWARD_DO_MIRROR, SURVEY §5.6) is the PER-BLOCK remat
+        # in the models: ``models.create(..., remat=True)`` (resnets,
+        # transformer_lm).  This flag is kept for composition experiments
+        # and API stability; prefer the model-level knob.
         self.remat = remat
         # ZeRO-1: shard optimizer state (momentum/Adam moments/fp32 masters)
         # over the 'data' mesh axis.  This is the TPU-native analog of the
