@@ -124,10 +124,12 @@ _ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
 
 
 def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    shape = np.shape(arr)  # before ascontiguousarray: it promotes 0-d
+    # arrays to (1,), which would bake a phantom dim into the file
     arr = np.ascontiguousarray(arr)
     if arr.dtype not in _NP_TO_ONNX:
         arr = arr.astype(np.float32)
-    out = b"".join(_int_field(1, d) for d in arr.shape)
+    out = b"".join(_int_field(1, d) for d in shape)
     out += _int_field(2, _NP_TO_ONNX[arr.dtype])
     out += _str_field(8, name)
     out += _len_delim(9, arr.tobytes())  # raw_data
