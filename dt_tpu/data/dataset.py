@@ -136,7 +136,14 @@ class DataLoader(DataIter):
     many forked worker processes (the reference's multiprocessing pool,
     ``gluon/data/dataloader.py:26-75``); ``last_batch`` in
     {'keep','discard'}.  ``prefetch`` (default ``2 * num_workers``) is the
-    number of batches kept in flight."""
+    number of batches kept in flight.
+
+    Fork-safety: workers are forked at *construction* time.  Construct
+    ``num_workers > 0`` loaders BEFORE the first JAX backend touch — a
+    fork while XLA runtime threads are live can deadlock the children
+    (same constraint as the reference's fork-based worker pool).  Call
+    :meth:`close` (or use the loader as a context manager) when done;
+    ``__del__`` is only a best-effort fallback."""
 
     def __init__(self, dataset: Dataset, batch_size: int,
                  shuffle: bool = False, sampler: Optional[Sampler] = None,
@@ -177,6 +184,13 @@ class DataLoader(DataIter):
         """Shut down worker processes (no-op for the in-process path)."""
         if hasattr(self._it, "close"):
             self._it.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class _LoaderIter(DataIter):

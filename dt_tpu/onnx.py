@@ -136,6 +136,20 @@ def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
     return out
 
 
+def _unpack_varints(blob: bytes) -> List[int]:
+    """Decode a packed-repeated varint blob (wire type 2).
+
+    proto3 serializers (the official ``onnx`` package included) emit
+    repeated scalar fields packed by default; our own emitter writes them
+    unpacked.  Importers must accept both.
+    """
+    r = _Reader(blob)
+    out: List[int] = []
+    while r.pos < len(blob):
+        out.append(_signed(r._read_varint()))
+    return out
+
+
 def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
     dims: List[int] = []
     dtype = _DT_FLOAT
@@ -144,8 +158,11 @@ def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
     float_data: List[float] = []
     int_data: List[int] = []
     for field, val in _Reader(buf):
-        if field == 1:
-            dims.append(_signed(val))
+        if field == 1:  # dims: unpacked varints OR a packed varint blob
+            if isinstance(val, bytes):
+                dims.extend(_unpack_varints(val))
+            else:
+                dims.append(_signed(val))
         elif field == 2:
             dtype = val
         elif field == 8:
@@ -157,9 +174,7 @@ def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
                 if isinstance(val, bytes) else float_data.append(val)
         elif field in (5, 7):  # int32_data / int64_data (packed varints)
             if isinstance(val, bytes):
-                r = _Reader(val)
-                while r.pos < len(val):
-                    int_data.append(_signed(r._read_varint()))
+                int_data.extend(_unpack_varints(val))
             else:
                 int_data.append(_signed(val))
     np_dt = _ONNX_TO_NP.get(dtype, np.dtype(np.float32))
@@ -190,12 +205,18 @@ def _attr(name: str, value) -> bytes:
         out += _len_delim(5, _tensor_proto(name + "_t", value)) \
             + _int_field(20, _AT_TENSOR)
     elif isinstance(value, (list, tuple)) and value \
-            and isinstance(value[0], float):
-        out += b"".join(_tag(7, 5) + struct.pack("<f", v) for v in value)
+            and isinstance(value[0], (float, np.floating)):
+        out += b"".join(_tag(7, 5) + struct.pack("<f", float(v))
+                        for v in value)
         out += _int_field(20, _AT_FLOATS)
-    else:  # list of ints (possibly empty)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, (bool, int, np.integer)) for v in value):
         out += b"".join(_tag(8, 0) + _varint(int(v)) for v in value)
         out += _int_field(20, _AT_INTS)
+    else:
+        raise TypeError(
+            f"attribute {name!r}: unsupported value {value!r} "
+            f"({type(value).__name__})")
     return out
 
 
@@ -215,10 +236,16 @@ def _parse_attr(buf: bytes):
             s = val.decode()
         elif field == 5:
             t = _parse_tensor(val)[1]
-        elif field == 7:
-            floats.append(struct.unpack("<f", val)[0])
-        elif field == 8:
-            ints.append(_signed(val))
+        elif field == 7:  # floats: unpacked fixed32 OR packed blob
+            if isinstance(val, bytes) and len(val) != 4:
+                floats.extend(struct.unpack(f"<{len(val) // 4}f", val))
+            else:
+                floats.append(struct.unpack("<f", val)[0])
+        elif field == 8:  # ints: unpacked varints OR packed blob
+            if isinstance(val, bytes):
+                ints.extend(_unpack_varints(val))
+            else:
+                ints.append(_signed(val))
     for v in (t, s):
         if v is not None:
             return name, v
@@ -476,7 +503,7 @@ def _export_eqn(g: _GraphBuilder, rec, names: Dict[Any, str]) -> None:
         if y == 2:
             out(g.add("Mul", [inp(0), inp(0)]))
         else:
-            p = g.const(np.asarray(float(y), np.float32))
+            p = g.const(np.asarray(float(y), rec["in_avals"][0].dtype))
             out(g.add("Pow", [inp(0), p]))
     elif prim == "reshape" or prim == "squeeze":
         shape = g.const(np.asarray(rec["out_avals"][0].shape, np.int64))

@@ -81,8 +81,15 @@ def do_checkpoint(prefix: str, period: int = 1, meta: Optional[dict] = None,
     closing the reference's dist-checkpoint gap).  ``async_save=True``
     overlaps serialization/IO with the next epoch's compute."""
     period = max(period, 1)
+    # a failed async write is re-raised from the NEXT invocation so a
+    # persistent IO failure stops the run like the sync path would,
+    # instead of silently leaving the user with no checkpoints at all
+    failed: list = []
 
     def _callback(epoch: int, state, metrics=None):
+        if failed:
+            raise RuntimeError(
+                "previous async checkpoint write failed") from failed[0]
         if (epoch + 1) % period == 0:
             out = ckpt_lib.save_checkpoint(prefix, epoch, state, meta,
                                            async_save=async_save)
@@ -90,12 +97,10 @@ def do_checkpoint(prefix: str, period: int = 1, meta: Optional[dict] = None,
                 def _report(f):
                     err = f.exception()
                     if err is not None:
-                        # surface the failure loudly: the sync path would
-                        # have aborted training; silently continuing
-                        # leaves the user with no checkpoints at all
                         logger.error(
                             "ASYNC CHECKPOINT WRITE FAILED (%s) — later "
                             "restores will miss this epoch", err)
+                        failed.append(err)
                     else:
                         logger.info("Saved checkpoint to \"%s\"",
                                     f.result())

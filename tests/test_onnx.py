@@ -233,6 +233,54 @@ def test_onnx_wire_codec_fuzz():
     assert node["attrs"]["pads"] == [0, 1, 0, 1]
 
 
+def test_onnx_packed_repeated_fields():
+    """Official proto3 serializers emit repeated scalars PACKED
+    (length-delimited blob) while our emitter writes them unpacked; the
+    importer must accept both or externally-produced ONNX files break
+    (round-4 advisor finding).  Hand-build packed encodings here."""
+    import struct as _struct
+    _tag, _varint = donnx._tag, donnx._varint
+    _len_delim = donnx._len_delim
+
+    # TensorProto with PACKED dims (field 1) + raw_data
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    packed_dims = b"".join(_varint(d) for d in arr.shape)
+    blob = (_len_delim(1, packed_dims)
+            + donnx._int_field(2, donnx._DT_FLOAT)
+            + donnx._str_field(8, "pt")
+            + _len_delim(9, arr.tobytes()))
+    name, got = donnx._parse_tensor(blob)
+    assert name == "pt" and got.shape == (3, 4)
+    np.testing.assert_array_equal(got, arr)
+
+    # AttributeProto with PACKED ints (field 8), e.g. perm/pads
+    vals = [0, 3, 1, 2]
+    packed_ints = b"".join(_varint(v) for v in vals)
+    blob = donnx._str_field(1, "perm") + _len_delim(8, packed_ints)
+    aname, aval = donnx._parse_attr(blob)
+    assert aname == "perm" and aval == vals
+
+    # AttributeProto with PACKED floats (field 7)
+    fvals = [0.5, -1.25, 3.0]
+    packed_floats = b"".join(_struct.pack("<f", v) for v in fvals)
+    blob = donnx._str_field(1, "scales") + _len_delim(7, packed_floats)
+    aname, aval = donnx._parse_attr(blob)
+    assert aname == "scales" and aval == pytest.approx(fvals)
+
+    # negative packed int64 (10-byte two's-complement varints)
+    packed_neg = b"".join(_varint(v & ((1 << 64) - 1)) for v in [-1, -7])
+    blob = donnx._str_field(1, "neg") + _len_delim(8, packed_neg)
+    aname, aval = donnx._parse_attr(blob)
+    assert aval == [-1, -7]
+
+    # emitter: np.floating list must take the floats branch, not ints
+    blob = donnx._attr("npf", [np.float32(0.5), np.float32(1.5)])
+    aname, aval = donnx._parse_attr(blob)
+    assert aval == pytest.approx([0.5, 1.5])
+    with pytest.raises(TypeError):
+        donnx._attr("bad", object())
+
+
 def test_onnx_parse_model_structure():
     """The emitted protobuf parses back with the expected graph pieces
     (guards the hand-rolled field numbers)."""
