@@ -25,3 +25,4 @@ end).  Semantics kept verbatim:
 
 from dt_tpu.elastic.scheduler import Scheduler as Scheduler
 from dt_tpu.elastic.client import WorkerClient as WorkerClient
+from dt_tpu.elastic.range_server import RangeServer as RangeServer

@@ -14,13 +14,25 @@ import os
 import socket
 import threading
 import time
-from typing import Dict, List, Optional
+import zlib
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from dt_tpu.elastic import protocol
 
 logger = logging.getLogger("dt_tpu.elastic")
+
+
+def _row_bounds(n: int, r: int) -> List[int]:
+    """Split points of ``np.array_split(arr, r, axis=0)`` for n rows: the
+    contiguous key-range → server partition (``kvstore_dist.h:547-589``
+    EncodeDefaultKey slices every big key across ALL servers)."""
+    q, rem = divmod(n, r)
+    bounds = [0]
+    for i in range(r):
+        bounds.append(bounds[-1] + q + (1 if i < rem else 0))
+    return bounds
 
 
 class WorkerRemoved(Exception):
@@ -41,7 +53,13 @@ class WorkerClient:
                           "is_new": is_new})
         self.rank: int = resp["rank"]
         self.workers: List[str] = resp["workers"]
+        # range-server fleet (sharded data plane): when non-empty, bulk
+        # data routes to these instead of the scheduler's embedded plane
+        self.servers: List[Tuple[str, int]] = [
+            tuple(s) for s in resp.get("servers", [])]
+        self._key_rows: Dict[str, int] = {}  # key -> total rows (sharding)
         self._ar_seq: Dict[str, int] = {}
+        self._announce_to_servers()
         # profiler sync starts AT the current command seq: a joiner must
         # not replay a long-finished profiling session's command history
         self._prof_seq = int(resp.get("profile_seq", 0))
@@ -56,16 +74,16 @@ class WorkerClient:
     def num_workers(self) -> int:
         return len(self.workers)
 
-    def _req(self, msg: dict, timeout: float = 600.0,
-             retries: int = 8) -> dict:
+    def _req_addr(self, addr, msg: dict, timeout: float = 600.0,
+                  retries: int = 8) -> dict:
         """Request with at-least-once retry — the Resender role
-        (``ps-lite/src/resender.h``).  Safe because the scheduler's
-        fault-injection drop happens before dispatch, and barrier/registry
-        handlers are idempotent for re-sent requests."""
+        (``ps-lite/src/resender.h``).  Safe because the server-side
+        fault-injection drop happens before dispatch, and every handler
+        is idempotent for re-sent requests."""
         delay = 0.2
         for attempt in range(retries):
             try:
-                resp = protocol.request(self.addr[0], self.addr[1], msg,
+                resp = protocol.request(addr[0], addr[1], msg,
                                         timeout=timeout)
                 break
             except (ConnectionError, socket.timeout, OSError):
@@ -76,6 +94,58 @@ class WorkerClient:
         if "error" in resp:
             raise RuntimeError(f"scheduler error: {resp['error']}")
         return resp
+
+    def _req(self, msg: dict, timeout: float = 600.0,
+             retries: int = 8) -> dict:
+        return self._req_addr(self.addr, msg, timeout, retries)
+
+    # -- sharded-plane routing (kvstore_dist.h:547-589) --------------------
+
+    def refresh_servers(self) -> List[Tuple[str, int]]:
+        """Re-fetch the range-server fleet from the scheduler (used when
+        the client registered before the servers did)."""
+        self.servers = [tuple(s) for s in
+                        self._req({"cmd": "servers"})["servers"]]
+        self._announce_to_servers()
+        return self.servers
+
+    def _announce_to_servers(self) -> None:
+        """Tell every range server this host (re)registered: the server
+        purges the host's retry-dedup entries so a restarted worker's
+        fresh sequence isn't swallowed by its pre-crash one (the
+        scheduler does the same purge in ``_register``)."""
+        for addr in self.servers:
+            self._req_addr(addr, {"cmd": "host_reset", "host": self.host})
+
+    def _partition_rows(self, n: int, ids, vals=None):
+        """Shared row-range → server partition for the sparse paths:
+        drop out-of-table ids, compute the ``_row_bounds`` split of ``n``
+        rows over the fleet, and assign each id its server index.
+        Returns ``(ids, vals, bounds, part)`` — all three sparse ops
+        (sync allreduce, async push, pull) must use the SAME rule or
+        rows land on the wrong server slice."""
+        ids = np.asarray(ids).ravel()
+        live = (ids >= 0) & (ids < n)
+        ids = ids[live]
+        if vals is not None:
+            vals = np.asarray(vals)[live]
+        bounds = _row_bounds(n, len(self.servers))
+        part = np.searchsorted(bounds[1:], ids, side="right")
+        return ids, vals, bounds, part
+
+    def _data_addr(self, key: str, route: Optional[int] = None):
+        """Target for one data-plane round: server ``route`` (or
+        ``crc32(key) % R`` when unrouted), falling back to the
+        scheduler's embedded plane when no servers registered.  The
+        mapping is a pure function of (key, fleet) so every worker sends
+        a given round to the same server — the reference's deterministic
+        key → server assignment."""
+        r = len(self.servers)
+        if r == 0:
+            return self.addr
+        if route is None:
+            route = zlib.crc32(key.encode())
+        return self.servers[route % r]
 
     def _heartbeat_loop(self, interval: float):
         while not self._stop.is_set():
@@ -151,18 +221,22 @@ class WorkerClient:
     def num_dead_nodes(self, timeout_s: float = 60.0) -> int:
         return self._req({"cmd": "num_dead", "timeout_s": timeout_s})["count"]
 
-    def allreduce(self, key: str, value) -> np.ndarray:
+    def allreduce(self, key: str, value, _route: Optional[int] = None
+                  ) -> np.ndarray:
         """Exact average across live workers (CPU-cluster data plane; on a
         TPU pod gradients ride ICI inside the jit step instead).  ``value``
         is an array, or a ``{"packed", "n", "threshold"}`` dict for
-        2-bit-compressed gradients (scheduler dequantizes before merging).
+        2-bit-compressed gradients (the server dequantizes before merging).
 
         Arrays larger than ``DT_AR_CHUNK_BYTES`` (default 4 MiB) are split
         into per-chunk rounds on subkeys ``key#c<i>`` — the reference
         splits big tensors across server key ranges for the same reason
         (``kvstore_dist.h:547-589`` EncodeDefaultKey): bounded message
-        size and scheduler peak memory of O(workers x chunk), not
-        O(workers x full gradient).
+        size and server peak memory of O(workers x chunk), not
+        O(workers x full gradient).  With a range-server fleet the chunks
+        round-robin across the R servers (chunk i → server (crc32(key)+i)
+        % R, identical on every worker) so R servers carry 1/R of the
+        bytes each and aggregate bandwidth scales with the fleet.
 
         Each call carries a per-host sequence number so an at-least-once
         retry of a lost RESPONSE is served the cached result instead of
@@ -172,29 +246,45 @@ class WorkerClient:
             chunk_bytes = int(os.environ.get("DT_AR_CHUNK_BYTES",
                                              str(4 << 20)))
             per = max(1, chunk_bytes // max(value.itemsize, 1))
+            nsrv = len(self.servers)
+            if nsrv > 1 and _route is None and value.nbytes > int(
+                    os.environ.get("DT_AR_SHARD_MIN_BYTES",
+                                   str(64 << 10))):
+                # with a server fleet, split every sizable tensor across
+                # ALL R servers (the reference's bigarray split,
+                # kvstore_dist.h:547-589) — not only past the 4 MiB
+                # funnel-protection bound.  Top level only (_route is
+                # None): a routed chunk must ship as-is, else each chunk
+                # re-splits recursively into an exploding round tree
+                per = min(per, -(-value.size // nsrv))
             # split on element count, not bytes: a single-element array is
             # never split again, so pathological chunk sizes below the
             # itemsize terminate instead of recursing on "#c0" forever
             if value.size > per:
                 from concurrent.futures import ThreadPoolExecutor
                 flat = value.ravel()
-                window = max(1, int(os.environ.get("DT_AR_WINDOW", "4")))
+                window = max(1, int(os.environ.get(
+                    "DT_AR_WINDOW", str(max(4, 2 * nsrv)))))
+                base = zlib.crc32(key.encode())
                 # a small in-flight window pipelines the per-chunk rounds
-                # (hides RTT + straggler skew) while keeping scheduler
+                # (hides RTT + straggler skew) while keeping per-server
                 # memory at O(workers x chunk x window); connections are
                 # per-request, so concurrent _req calls are safe
                 with ThreadPoolExecutor(max_workers=window) as pool:
                     futs = [
                         pool.submit(self.allreduce, f"{key}#c{i}",
-                                    flat[start:start + per])
+                                    flat[start:start + per],
+                                    (base + i) if nsrv else None)
                         for i, start in enumerate(
                             range(0, flat.size, per))]
                     parts = [f.result() for f in futs]
                 return np.concatenate(parts).reshape(value.shape)
         seq = self._ar_seq.get(key, 0)
         self._ar_seq[key] = seq + 1
-        out = self._req({"cmd": "allreduce", "host": self.host, "key": key,
-                         "seq": seq, "value": value})["value"]
+        out = self._req_addr(
+            self._data_addr(key, _route),
+            {"cmd": "allreduce", "host": self.host, "key": key,
+             "seq": seq, "value": value})["value"]
         if isinstance(out, dict) and "__error__" in out:
             raise RuntimeError(f"allreduce {key}: {out['__error__']}")
         return out
@@ -213,15 +303,51 @@ class WorkerClient:
         everywhere (a warning is logged)."""
         from dt_tpu.ops.sparse import RowSparse
         import jax.numpy as jnp
-        seq = self._ar_seq.get(key, 0)
-        self._ar_seq[key] = seq + 1
-        out = self._req({"cmd": "allreduce", "host": self.host, "key": key,
-                         "seq": seq,
-                         "value": {"ids": np.asarray(rs.indices),
-                                   "vals": np.asarray(rs.values),
-                                   "num_rows": rs.num_rows}})["value"]
-        if isinstance(out, dict) and "__error__" in out:
-            raise RuntimeError(f"allreduce_sparse {key}: {out['__error__']}")
+        nsrv = len(self.servers)
+        if nsrv > 1:
+            # partition the touched rows by the contiguous row-range →
+            # server map; each server merges its range concurrently and
+            # every worker contributes to EVERY server each round (empty
+            # partitions included) so rounds complete
+            from concurrent.futures import ThreadPoolExecutor
+            ids, vals, bounds, part = self._partition_rows(
+                rs.num_rows, rs.indices, rs.values)
+
+            def one(j):
+                sel = part == j
+                seq = self._ar_seq.get(f"{key}@s{j}", 0)
+                self._ar_seq[f"{key}@s{j}"] = seq + 1
+                return self._req_addr(
+                    self.servers[j],
+                    {"cmd": "allreduce", "host": self.host, "key": key,
+                     "seq": seq,
+                     "value": {"ids": ids[sel], "vals": vals[sel],
+                               "num_rows": rs.num_rows}})["value"]
+
+            with ThreadPoolExecutor(max_workers=nsrv) as pool:
+                outs = list(pool.map(one, range(nsrv)))
+            for o in outs:
+                if isinstance(o, dict) and "__error__" in o:
+                    raise RuntimeError(
+                        f"allreduce_sparse {key}: {o['__error__']}")
+            # ranges are disjoint and ascending: concatenation is the
+            # globally-sorted unique merge
+            out = {"ids": np.concatenate([o["ids"] for o in outs]),
+                   "vals": np.concatenate([o["vals"] for o in outs],
+                                          axis=0)}
+        else:
+            seq = self._ar_seq.get(key, 0)
+            self._ar_seq[key] = seq + 1
+            out = self._req_addr(
+                self._data_addr(key),
+                {"cmd": "allreduce", "host": self.host, "key": key,
+                 "seq": seq,
+                 "value": {"ids": np.asarray(rs.indices),
+                           "vals": np.asarray(rs.values),
+                           "num_rows": rs.num_rows}})["value"]
+            if isinstance(out, dict) and "__error__" in out:
+                raise RuntimeError(
+                    f"allreduce_sparse {key}: {out['__error__']}")
         merged = len(out["ids"])
         if capacity is None:
             capacity = 1 << max(merged - 1, 0).bit_length()
@@ -240,49 +366,149 @@ class WorkerClient:
     # -- dist_async data plane --------------------------------------------
 
     def set_optimizer(self, spec: Dict) -> None:
-        """Install the scheduler-side updater for ``dist_async`` pushes
+        """Install the server-side updater for ``dist_async`` pushes
         (the reference's optimizer-to-servers hand-off,
         ``python/mxnet/kvstore.py:451-498``).  ``spec`` is
-        ``{"name": "sgd"|"adagrad"|"adam", **scalar hyperparams}``."""
+        ``{"name": "sgd"|"adagrad"|"adam", **scalar hyperparams}``.
+        Broadcast to every range server (each holds its own slice's
+        updater slots) AND the scheduler's embedded plane."""
         self._req({"cmd": "set_optimizer", "spec": spec})
+        for addr in self.servers:
+            self._req_addr(addr, {"cmd": "set_optimizer", "spec": spec})
+
+    def _async_fanout(self, fn):
+        """Run ``fn(j, addr)`` per range server concurrently; ordered
+        results."""
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=len(self.servers)) as pool:
+            return list(pool.map(lambda j: fn(j, self.servers[j]),
+                                 range(len(self.servers))))
 
     def async_init(self, key: str, value) -> np.ndarray:
         """Init-or-get the master weights: the first writer seeds them,
         everyone receives the live server copy (joiners adopt trained
-        state, ``module.py:552-571``)."""
-        return np.asarray(self._req({"cmd": "async_init", "key": key,
-                                     "value": np.asarray(value)})["value"])
+        state, ``module.py:552-571``).  With a range-server fleet the
+        value is split into R contiguous row ranges, one per server —
+        the reference's key sharding (``kvstore_dist.h:547-589``), so
+        each server stores and updates 1/R of every tensor."""
+        value = np.asarray(value)
+        nsrv = len(self.servers)
+        if nsrv > 1 and value.ndim >= 1:
+            self._key_rows[key] = int(value.shape[0])
+            parts = np.array_split(value, nsrv, axis=0)
+            outs = self._async_fanout(
+                lambda j, addr: self._req_addr(
+                    addr, {"cmd": "async_init", "key": key,
+                           "value": parts[j]})["value"])
+            return np.concatenate([np.asarray(o) for o in outs], axis=0)
+        return np.asarray(self._req_addr(
+            self._data_addr(key),
+            {"cmd": "async_init", "key": key,
+             "value": value})["value"])
 
     def async_push(self, key: str, grad) -> np.ndarray:
         """Push a gradient, get back the post-update master weights —
-        one round trip, applied immediately, no cross-worker barrier
-        (``kvstore_dist_server.h:347`` ``!sync_mode_``).  Retries are
-        dedup'd by (host, key, seq) so a momentum update is never applied
-        twice."""
+        one round trip per server, applied immediately, no cross-worker
+        barrier (``kvstore_dist_server.h:347`` ``!sync_mode_``).  Retries
+        are dedup'd by (host, key, seq) so a momentum update is never
+        applied twice.  Sharded: each server updates its row range
+        concurrently; the concatenated result is elementwise identical
+        to the unsharded update (the server optimizers are elementwise)."""
+        grad = np.asarray(grad)
+        nsrv = len(self.servers)
+        if nsrv > 1 and grad.ndim >= 1:
+            parts = np.array_split(grad, nsrv, axis=0)
+
+            def one(j, addr):
+                seq = self._ar_seq.get(("async", key, j), 0)
+                self._ar_seq[("async", key, j)] = seq + 1
+                return self._req_addr(
+                    addr, {"cmd": "async_push", "host": self.host,
+                           "key": key, "seq": seq,
+                           "value": parts[j]})["value"]
+
+            outs = self._async_fanout(one)
+            return np.concatenate([np.asarray(o) for o in outs], axis=0)
         seq = self._ar_seq.get(("async", key), 0)
         self._ar_seq[("async", key)] = seq + 1
-        out = self._req({"cmd": "async_push", "host": self.host,
-                         "key": key, "seq": seq,
-                         "value": np.asarray(grad)})["value"]
+        out = self._req_addr(
+            self._data_addr(key),
+            {"cmd": "async_push", "host": self.host,
+             "key": key, "seq": seq, "value": grad})["value"]
         return np.asarray(out)
+
+    def _sparse_rows(self, key: str) -> int:
+        """Total rows of a sharded table: cached from async_init, else
+        discovered by summing the per-server slice sizes."""
+        n = self._key_rows.get(key)
+        if n is None:
+            outs = self._async_fanout(
+                lambda j, addr: self._req_addr(
+                    addr, {"cmd": "async_pull_rows", "key": key,
+                           "ids": np.empty((0,), np.int64)}))
+            n = sum(int(o["num_rows"]) for o in outs)
+            self._key_rows[key] = n
+        return n
 
     def async_push_sparse(self, key: str, ids, vals) -> dict:
         """Row-sparse async push: ship (ids, rows), the server applies a
         LAZY update to the touched rows and returns just their new values
         as ``{"ids", "vals"}`` — O(touched) both ways
-        (``kvstore_dist.h:690-748`` + sparse ``optimizer_op.cc``)."""
+        (``kvstore_dist.h:690-748`` + sparse ``optimizer_op.cc``).
+        Sharded: ids partition by the row-range → server map and are
+        rebased to each server's slice."""
+        ids = np.asarray(ids).ravel()
+        vals = np.asarray(vals)
+        nsrv = len(self.servers)
+        if nsrv > 1:
+            n = self._sparse_rows(key)
+            ids, vals, bounds, part = self._partition_rows(n, ids, vals)
+
+            def one(j, addr):
+                sel = part == j
+                seq = self._ar_seq.get(("async", key, j), 0)
+                self._ar_seq[("async", key, j)] = seq + 1
+                out = self._req_addr(
+                    addr, {"cmd": "async_push", "host": self.host,
+                           "key": key, "seq": seq,
+                           "value": {"ids": ids[sel] - bounds[j],
+                                     "vals": vals[sel]}})["value"]
+                return {"ids": np.asarray(out["ids"]) + bounds[j],
+                        "vals": np.asarray(out["vals"])}
+
+            outs = self._async_fanout(one)
+            return {"ids": np.concatenate([o["ids"] for o in outs]),
+                    "vals": np.concatenate([o["vals"] for o in outs],
+                                           axis=0)}
         seq = self._ar_seq.get(("async", key), 0)
         self._ar_seq[("async", key)] = seq + 1
-        return self._req({"cmd": "async_push", "host": self.host,
-                          "key": key, "seq": seq,
-                          "value": {"ids": np.asarray(ids),
-                                    "vals": np.asarray(vals)}})["value"]
+        return self._req_addr(
+            self._data_addr(key),
+            {"cmd": "async_push", "host": self.host,
+             "key": key, "seq": seq,
+             "value": {"ids": ids, "vals": vals}})["value"]
 
     def async_pull_rows(self, key: str, ids) -> dict:
         """Pull only the requested rows of the master table (the
         reference's RowSparsePull, ``kvstore_dist.h:317-376``)."""
-        return self._req({"cmd": "async_pull_rows", "key": key,
-                          "ids": np.asarray(ids)})
+        ids = np.asarray(ids).ravel()
+        nsrv = len(self.servers)
+        if nsrv > 1:
+            n = self._sparse_rows(key)
+            ids, _, bounds, part = self._partition_rows(n, ids)
+            outs = self._async_fanout(
+                lambda j, addr: self._req_addr(
+                    addr, {"cmd": "async_pull_rows", "key": key,
+                           "ids": ids[part == j] - bounds[j]}))
+            return {"ids": np.concatenate(
+                        [np.asarray(o["ids"]) + bounds[j]
+                         for j, o in enumerate(outs)]),
+                    "vals": np.concatenate(
+                        [np.asarray(o["vals"]) for o in outs], axis=0),
+                    "num_rows": n}
+        return self._req_addr(
+            self._data_addr(key),
+            {"cmd": "async_pull_rows", "key": key, "ids": ids})
 
     def close(self):
         self._stop.set()

@@ -1,0 +1,292 @@
+"""Shared data-plane state machine: exact-average allreduce rounds and the
+``dist_async`` master-weight store.
+
+Both the :class:`~dt_tpu.elastic.scheduler.Scheduler` (the single-funnel
+plane used when no range servers are launched) and each
+:class:`~dt_tpu.elastic.range_server.RangeServer` (the reference's
+key-range-sharded server fleet, ``src/kvstore/kvstore_dist.h:547-589``
+``EncodeDefaultKey``: every big key is split across ALL R servers so the
+aggregate push/pull bandwidth scales with R) embed one ``DataPlane``.
+
+Concurrency: allreduce state lives under its own condition variable;
+async state under its own lock.  The embedding server may call
+:meth:`complete_with` while holding its own membership lock — ``DataPlane``
+never calls back out, so the nesting is one-way and deadlock-free.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set
+
+import numpy as np
+
+
+class DataPlane:
+    """Allreduce + dist_async handlers, factored from the round-3 scheduler.
+
+    ``expected_fn()`` returns the host set whose contributions complete an
+    allreduce round (the scheduler reads its live registry; a range server
+    serves a membership cache refreshed from the scheduler).
+    """
+
+    def __init__(self, expected_fn: Callable[[], Set[str]],
+                 confirm_fn: Optional[Callable[[], Set[str]]] = None):
+        self.expected_fn = expected_fn
+        # called right before a round completes, for an AUTHORITATIVE
+        # membership recheck: a range server serves allreduce against a
+        # TTL-cached mirror, and completing a round off a stale cache
+        # would skip a just-registered worker whose contribution is in
+        # flight (permanent step skew).  The scheduler's embedded plane
+        # reads its live registry either way.
+        self.confirm_fn = confirm_fn or expected_fn
+        self._cv = threading.Condition()
+        # key -> {vals: {host: (seq, arr)}, gen, result, served: {host: (seq, result)}}
+        self._reduce: Dict[str, dict] = {}
+        self._async_lock = threading.Lock()
+        self._async_live: Set[str] = set()
+        self._async_store: Dict[str, np.ndarray] = {}
+        self._async_updater = None
+        self._async_served: Dict[tuple, tuple] = {}  # (host,key)->(seq,val)
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    #: commands this plane serves
+    CMDS = ("allreduce", "set_optimizer", "async_init", "async_push",
+            "async_pull_rows")
+
+    def dispatch(self, msg: dict) -> Optional[dict]:
+        cmd = msg.get("cmd")
+        if cmd == "allreduce":
+            return self.allreduce(msg["host"], msg["key"], msg["value"],
+                                  int(msg.get("seq", -1)))
+        if cmd == "set_optimizer":
+            return self.async_set_optimizer(msg["spec"])
+        if cmd == "async_init":
+            return self.async_init(msg["key"], msg["value"])
+        if cmd == "async_push":
+            return self.async_push(msg["host"], msg["key"], msg["value"],
+                                   int(msg.get("seq", -1)))
+        if cmd == "async_pull_rows":
+            return self.async_pull_rows(msg["key"], msg["ids"])
+        return None
+
+    # ------------------------------------------------------------------
+    # membership hooks (called by the embedding server)
+    # ------------------------------------------------------------------
+
+    def host_registered(self, host: str) -> None:
+        """A (re)registering worker starts a fresh push sequence — purge
+        its stale retry-dedup entries so its first request after a restart
+        isn't swallowed by an old (host, seq) key (a swallowed async_push
+        would silently drop a gradient and hand back pre-crash weights)."""
+        with self._async_lock:
+            self._async_live.add(host)
+            for key in [k for k in self._async_served if k[0] == host]:
+                del self._async_served[key]
+
+    def hosts_removed(self, hosts: Set[str]) -> None:
+        with self._async_lock:
+            self._async_live -= set(hosts)
+
+    def complete_with(self, live: Set[str], ordered=None) -> None:
+        """After membership shrank, finish any allreduce round now
+        satisfied by the survivors."""
+        with self._cv:
+            order = list(ordered) if ordered is not None else sorted(live)
+            for key, slot in self._reduce.items():
+                if slot["vals"] and live and set(slot["vals"]) >= live:
+                    contributors = [h for h in order if h in slot["vals"]]
+                    self._finish_round_locked(slot, contributors)
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # exact-average allreduce
+    # ------------------------------------------------------------------
+
+    def allreduce(self, host: str, key: str, value, seq: int = -1) -> dict:
+        """Average ``value`` across the expected host set (one round per
+        key-use, mirroring server-side merged/NumWorkers(),
+        ``kvstore_dist_server.h:345-379``).  A dict value
+        ``{"packed", "n", "threshold"}`` is a 2-bit-compressed gradient:
+        dequantize before merging, exactly like the server's
+        DataHandleCompressed (``kvstore_dist_server.h:606-673``).
+
+        ``seq`` makes retries idempotent: a re-sent (host, seq) whose
+        round already completed is served the cached result rather than
+        being folded into the next generation (at-least-once delivery
+        safety, the Resender's ACK-dedup role, ``ps-lite/src/resender.h``).
+        """
+        if isinstance(value, dict) and "packed" in value:
+            from dt_tpu.parallel.compression import np_dequantize_2bit
+            arr = np_dequantize_2bit(np.asarray(value["packed"]),
+                                     int(value["n"]),
+                                     float(value["threshold"]))
+        elif isinstance(value, dict) and "ids" in value:
+            # row-sparse contribution (ids, rows): the wire carries
+            # O(touched rows), not O(vocab) — the reference's row_sparse
+            # push path (kvstore_dist.h:690-748)
+            arr = ("rsp", np.asarray(value["ids"]),
+                   np.asarray(value["vals"]), int(value["num_rows"]))
+        else:
+            arr = np.asarray(value)
+        with self._cv:
+            slot = self._reduce.setdefault(
+                key, {"vals": {}, "gen": 0, "result": None, "served": {}})
+            served = slot["served"].get(host)
+            if seq >= 0 and served is not None and served[0] == seq:
+                return {"value": served[1]}  # retry of a completed round
+            gen = slot["gen"]
+            slot["vals"][host] = (seq, arr)
+            expected = self.expected_fn()
+            if expected and set(slot["vals"]) >= set(expected):
+                # authoritative recheck before finishing (see confirm_fn)
+                expected = self.confirm_fn()
+            if expected and set(slot["vals"]) >= set(expected):
+                contributors = [h for h in expected if h in slot["vals"]]
+                self._finish_round_locked(slot, contributors)
+                self._cv.notify_all()
+                return {"value": slot["result"]}
+            while slot["gen"] == gen:
+                if not self._cv.wait(timeout=300):
+                    raise TimeoutError(f"allreduce {key} stuck")
+            return {"value": slot["result"]}
+
+    def _finish_round_locked(self, slot: dict, contributors) -> None:
+        stacked = [slot["vals"][h][1] for h in contributors]
+        if any(isinstance(a, tuple) and a[0] == "rsp" for a in stacked):
+            slot["result"] = self._merge_sparse(stacked)
+        else:
+            slot["result"] = np.mean(stacked, axis=0)
+        for h, (h_seq, _) in slot["vals"].items():
+            slot["served"][h] = (h_seq, slot["result"])
+        slot["vals"] = {}
+        slot["gen"] += 1
+
+    @staticmethod
+    def _merge_sparse(stacked) -> dict:
+        """Merge row-sparse contributions: concat, sum duplicates, divide
+        by the worker count — elementwise identical to averaging the
+        dense-with-zeros equivalents (the server's merged/NumWorkers()
+        for row_sparse keys, ``kvstore_dist_server.h:345-379``).  Mixed
+        dense/sparse contributions are a caller bug: every waiter gets an
+        ``__error__`` result (raised client-side) instead of one handler
+        thread dying while the rest time out."""
+        if not all(isinstance(a, tuple) and a[0] == "rsp" for a in stacked):
+            return {"__error__": "mixed dense and row-sparse contributions "
+                                 "for one allreduce key"}
+        num_rows = stacked[0][3]
+        all_ids = np.concatenate([a[1] for a in stacked])
+        all_vals = np.concatenate([a[2] for a in stacked], axis=0)
+        live = all_ids < num_rows
+        all_ids, all_vals = all_ids[live], all_vals[live]
+        uniq, inv = np.unique(all_ids, return_inverse=True)
+        summed = np.zeros((len(uniq),) + all_vals.shape[1:],
+                          all_vals.dtype)
+        np.add.at(summed, inv, all_vals)
+        return {"ids": uniq.astype(np.int32),
+                "vals": summed / len(stacked), "num_rows": num_rows}
+
+    # ------------------------------------------------------------------
+    # dist_async parameter-server plane
+    # ------------------------------------------------------------------
+
+    def async_set_optimizer(self, spec: dict) -> dict:
+        """Install the server-side updater from a hyperparameter SPEC —
+        the reference pickled the whole optimizer object to the servers
+        (``python/mxnet/kvstore.py:451-498``); a spec carries the same
+        information without shipping code.  Idempotent for an identical
+        spec (every worker sends it); a DIFFERENT spec mid-run resets the
+        updater and its slots."""
+        from dt_tpu.elastic import server_optim
+        with self._async_lock:
+            if self._async_updater is not None and \
+                    self._async_updater.spec_input == \
+                    server_optim.spec_identity(spec):
+                return {}
+            try:
+                upd = server_optim.create(**dict(spec))
+            except (TypeError, ValueError) as e:
+                return {"error": f"set_optimizer: {e}"}
+            self._async_updater = upd
+            self._async_served.clear()
+        return {}
+
+    def async_init(self, key: str, value) -> dict:
+        """Init-or-get: the first writer seeds the master weights, later
+        inits return the live copy unchanged (the reference's once-per-key
+        ``kv.init`` + new-worker pull-from-servers,
+        ``kvstore_local.h:95-110`` / ``module.py:552-571``) — so every
+        worker inits unconditionally and joiners adopt trained state."""
+        with self._async_lock:
+            if key not in self._async_store:
+                self._async_store[key] = np.asarray(value)
+            return {"value": self._async_store[key]}
+
+    def async_push(self, host: str, key: str, value, seq: int = -1) -> dict:
+        """Apply one worker's gradient to the master weights IMMEDIATELY
+        and return them — the ``dist_async`` contract
+        (``kvstore_dist_server.h:347`` ``!sync_mode_``: no aggregation
+        wait, push order = application order).  (host, key, seq) dedup
+        makes at-least-once retries safe: re-applying a momentum update
+        twice would corrupt the trajectory, so a replay is served the
+        cached result instead."""
+        with self._async_lock:
+            served = self._async_served.get((host, key))
+            if seq >= 0 and served is not None and served[0] == seq:
+                return {"value": served[1]}
+            if seq >= 0 and served is not None and seq < served[0]:
+                # STALE duplicate (a delayed handler thread losing the race
+                # to its own retry): the client has already moved past this
+                # seq — applying it again would double-count the gradient.
+                # Serve the freshest weights; nobody consumes this reply.
+                return {"value": served[1]}
+            if self._async_updater is None:
+                return {"error": "async_push before set_optimizer"}
+            stored = self._async_store.get(key)
+            if stored is None:
+                return {"error": f"async_push: key {key!r} not initialized"}
+            if isinstance(value, dict) and "ids" in value:
+                # row-sparse push: lazy server-side update of the touched
+                # rows only; the response carries just those rows back
+                # (O(touched) both ways — kvstore_dist.h:690-748 +
+                # optimizer_op.cc sparse variants)
+                ids = np.asarray(value["ids"]).ravel()
+                try:
+                    new = self._async_updater.sparse(
+                        key, ids, np.asarray(value["vals"]), stored)
+                except ValueError as e:
+                    return {"error": f"async_push sparse: {e}"}
+                self._async_store[key] = new
+                keep = (ids >= 0) & (ids < new.shape[0])
+                uniq = np.unique(ids[keep])
+                resp = {"ids": uniq, "vals": new[uniq]}
+                self._async_served[(host, key)] = (seq, resp)
+                return {"value": resp}
+            new = self._async_updater(key, np.asarray(value), stored)
+            self._async_store[key] = new
+            self._async_served[(host, key)] = (seq, new)
+            if len(self._async_served) > 4 * max(len(self._async_live), 1):
+                # bound the cache by dropping DEPARTED hosts' entries only —
+                # evicting a live worker's entry would re-open the
+                # double-apply window this dedup exists to close (live
+                # entries are bounded: one per (host, key))
+                for k in [k for k in self._async_served
+                          if k[0] not in self._async_live]:
+                    del self._async_served[k]
+            return {"value": new}
+
+    def async_pull_rows(self, key: str, ids) -> dict:
+        with self._async_lock:
+            stored = self._async_store.get(key)
+            if stored is None:
+                return {"error":
+                        f"async_pull_rows: key {key!r} not initialized"}
+            ids = np.asarray(ids).ravel()
+            keep = (ids >= 0) & (ids < stored.shape[0])
+            # row_sparse_pull (kvstore_dist.h:317-376): only the
+            # requested live rows travel, never the whole table
+            return {"ids": ids[keep], "vals": stored[ids[keep]],
+                    "num_rows": int(stored.shape[0])}

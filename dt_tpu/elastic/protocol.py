@@ -78,6 +78,20 @@ def bind_interface() -> str:
     return os.environ.get("DT_ELASTIC_BIND", "0.0.0.0")
 
 
+def advertise_host() -> str:
+    """Address peers should dial to reach a server bound on this machine
+    (``DT_ELASTIC_ADVERTISE``; falls back to the bind interface when it
+    is a concrete address, else the machine hostname — the same contract
+    as ps-lite's ``DMLC_NODE_HOST``)."""
+    adv = os.environ.get("DT_ELASTIC_ADVERTISE")
+    if adv:
+        return adv
+    bind = bind_interface()
+    if bind not in ("0.0.0.0", "::"):
+        return bind
+    return socket.gethostname()
+
+
 def _mac(key: bytes, *parts: bytes) -> bytes:
     m = _hmac.new(key, digestmod=hashlib.sha256)
     for p in parts:

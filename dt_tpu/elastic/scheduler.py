@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Set
 import numpy as np
 
 from dt_tpu.elastic import protocol
+from dt_tpu.elastic.dataplane import DataPlane
 
 logger = logging.getLogger("dt_tpu.elastic")
 _drop_rng = random.Random(0xD207)  # deterministic fault injection
@@ -96,26 +97,21 @@ class Scheduler:
         # snapshot
         self._snapshot = None
         self._snapshot_lock = threading.Lock()
-        # allreduce state: key -> {host: array}; generation counting
-        self._reduce: Dict[str, dict] = {}
+        # the single-funnel data plane (allreduce rounds + dist_async
+        # store), shared machinery with RangeServer (dataplane.py).  When
+        # range servers register, workers route bulk data to THEM and this
+        # embedded plane goes idle (kvstore_dist.h:547-589 key sharding).
+        self._dp = DataPlane(expected_fn=lambda: list(self._workers))
+        # range-server registry: index -> (host, port); fixed after launch
+        # (the reference's server count is DMLC_NUM_SERVER, not elastic).
+        # Own lock: _server_list() is called from inside _register, which
+        # already holds the (non-reentrant) scheduler lock.
+        self._servers: Dict[int, tuple] = {}
+        self._servers_lock = threading.Lock()
         # remote profiler control (rank 0 drives all workers)
         self._profile_cmds: List[dict] = []
         self._profile_seq = 0
         self._profile_posted: Dict[tuple, int] = {}  # retry dedup
-        # dist_async parameter-server state: master weights + updater
-        # (kvstore_dist_server.h:347 !sync_mode_ — each push is applied
-        # immediately, no aggregation barrier)
-        self._async_lock = threading.Lock()
-        # mirror of the live-worker set for the async plane, guarded by
-        # _async_lock (NOT _lock): _async_push's dedup-cache eviction needs
-        # an up-to-date view without inverting the _lock -> _async_lock
-        # order, and a pre-snapshot under _lock would go stale by the time
-        # eviction runs (a just-registered host's fresh dedup entry must
-        # never be evicted as "departed")
-        self._async_live: Set[str] = set()
-        self._async_store: Dict[str, np.ndarray] = {}
-        self._async_updater = None
-        self._async_served: Dict[tuple, tuple] = {}  # (host,key)->(seq,val)
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -226,6 +222,17 @@ class Scheduler:
                         self._profile_posted.pop(
                             next(iter(self._profile_posted)))
                 return {"seq": self._profile_seq}
+        if cmd in DataPlane.CMDS:
+            return self._dp.dispatch(msg)
+        if cmd == "register_server":
+            with self._servers_lock:
+                self._servers[int(msg["index"])] = (msg["host"],
+                                                    int(msg["port"]))
+            logger.info("range server %d registered at %s:%d",
+                        int(msg["index"]), msg["host"], int(msg["port"]))
+            return {}
+        if cmd == "servers":
+            return {"servers": self._server_list()}
         if cmd == "mc_barrier":
             return self._mc_barrier(msg["host"], int(msg["epoch"]),
                                     msg.get("info") or {})
@@ -241,29 +248,6 @@ class Scheduler:
                 return {"blob": self._snapshot}
         if cmd == "num_dead":
             return {"count": self._num_dead(float(msg.get("timeout_s", 60)))}
-        if cmd == "allreduce":
-            return self._allreduce(msg["host"], msg["key"], msg["value"],
-                                   int(msg.get("seq", -1)))
-        if cmd == "set_optimizer":
-            return self._async_set_optimizer(msg["spec"])
-        if cmd == "async_init":
-            return self._async_init(msg["key"], msg["value"])
-        if cmd == "async_push":
-            return self._async_push(msg["host"], msg["key"], msg["value"],
-                                    int(msg.get("seq", -1)))
-        if cmd == "async_pull_rows":
-            with self._async_lock:
-                stored = self._async_store.get(msg["key"])
-                if stored is None:
-                    return {"error":
-                            f"async_pull_rows: key {msg['key']!r} not "
-                            "initialized"}
-                ids = np.asarray(msg["ids"]).ravel()
-                keep = (ids >= 0) & (ids < stored.shape[0])
-                # row_sparse_pull (kvstore_dist.h:317-376): only the
-                # requested live rows travel, never the whole table
-                return {"ids": ids[keep], "vals": stored[ids[keep]],
-                        "num_rows": int(stored.shape[0])}
         if cmd == "membership":
             with self._lock:
                 return {"workers": list(self._workers)}
@@ -294,16 +278,14 @@ class Scheduler:
             # a gradient and hand back pre-crash weights)
             for key in [k for k in self._profile_posted if k[0] == host]:
                 del self._profile_posted[key]
-            with self._async_lock:
-                self._async_live.add(host)
-                for key in [k for k in self._async_served if k[0] == host]:
-                    del self._async_served[key]
+            self._dp.host_registered(host)
             self._cv.notify_all()
             # profile_seq: joiners sync PAST the buffered command history
             # (don't replay a long-finished profiling session on new hosts)
             return {"rank": self._workers.index(host),
                     "workers": list(self._workers),
-                    "profile_seq": self._profile_seq}
+                    "profile_seq": self._profile_seq,
+                    "servers": self._server_list()}
 
     def wait_for_workers(self, n: Optional[int] = None, timeout: float = 120):
         """Block until n workers registered (rendezvous;
@@ -348,8 +330,7 @@ class Scheduler:
                     self._removed_hosts.add(h)
                     self._base.discard(h)
                     self._append_log("REMOVED", h)
-                with self._async_lock:
-                    self._async_live -= set(dead)
+                self._dp.hosts_removed(set(dead))
                 self._rewrite_host_file(dead)
                 self._complete_pending_locked()
                 self._cv.notify_all()
@@ -388,15 +369,8 @@ class Scheduler:
         if self._plain_arrived and live and self._plain_arrived >= live:
             self._plain_arrived = set()
             self._plain_gen += 1
-        # pending allreduce rounds
-        for key, slot in self._reduce.items():
-            if slot["vals"] and live and set(slot["vals"]) >= live:
-                stacked = [slot["vals"][h][1] for h in self._workers]
-                slot["result"] = np.mean(stacked, axis=0)
-                for h, (h_seq, _) in slot["vals"].items():
-                    slot["served"][h] = (h_seq, slot["result"])
-                slot["vals"] = {}
-                slot["gen"] += 1
+        # pending allreduce rounds finish with the survivors
+        self._dp.complete_with(live, ordered=self._workers)
 
     # ------------------------------------------------------------------
     # membership-change barrier (the heart — SURVEY.md §3.3)
@@ -471,8 +445,7 @@ class Scheduler:
             self._workers = [w for w in self._workers if w not in removable]
             self._removed_hosts |= removable
             self._registered -= removable
-            with self._async_lock:
-                self._async_live -= removable
+            self._dp.hosts_removed(removable)
             for h in removed:
                 self._append_log("REMOVED", h)
         else:
@@ -527,169 +500,27 @@ class Scheduler:
                     raise TimeoutError("barrier stuck")
             return {}
 
-    def _allreduce(self, host: str, key: str, value, seq: int = -1) -> dict:
-        """Average ``value`` across all live workers (one round per key-use,
-        mirroring server-side merged/NumWorkers(),
-        ``kvstore_dist_server.h:345-379``).  A dict value
-        ``{"packed", "n", "threshold"}`` is a 2-bit-compressed gradient:
-        dequantize before merging, exactly like the server's
-        DataHandleCompressed (``kvstore_dist_server.h:606-673``).
-
-        ``seq`` makes retries idempotent: a re-sent (host, seq) whose round
-        already completed is served the cached result rather than being
-        folded into the next generation (at-least-once delivery safety,
-        the Resender's ACK-dedup role, ``ps-lite/src/resender.h``)."""
-        if isinstance(value, dict) and "packed" in value:
-            from dt_tpu.parallel.compression import np_dequantize_2bit
-            arr = np_dequantize_2bit(np.asarray(value["packed"]),
-                                     int(value["n"]),
-                                     float(value["threshold"]))
-        elif isinstance(value, dict) and "ids" in value:
-            # row-sparse contribution (ids, rows): the wire carries
-            # O(touched rows), not O(vocab) — the reference's row_sparse
-            # push path (kvstore_dist.h:690-748)
-            arr = ("rsp", np.asarray(value["ids"]),
-                   np.asarray(value["vals"]), int(value["num_rows"]))
-        else:
-            arr = np.asarray(value)
-        with self._cv:
-            slot = self._reduce.setdefault(
-                key, {"vals": {}, "gen": 0, "result": None, "served": {}})
-            served = slot["served"].get(host)
-            if seq >= 0 and served is not None and served[0] == seq:
-                return {"value": served[1]}  # retry of a completed round
-            gen = slot["gen"]
-            slot["vals"][host] = (seq, arr)
-            if set(slot["vals"]) >= set(self._workers):
-                stacked = [slot["vals"][h][1] for h in self._workers]
-                if any(isinstance(a, tuple) and a[0] == "rsp"
-                       for a in stacked):
-                    slot["result"] = self._merge_sparse(stacked)
-                else:
-                    slot["result"] = np.mean(stacked, axis=0)
-                for h, (h_seq, _) in slot["vals"].items():
-                    slot["served"][h] = (h_seq, slot["result"])
-                slot["vals"] = {}
-                slot["gen"] += 1
-                self._cv.notify_all()
-                return {"value": slot["result"]}
-            while slot["gen"] == gen:
-                if not self._cv.wait(timeout=300):
-                    raise TimeoutError(f"allreduce {key} stuck")
-            return {"value": slot["result"]}
-
-    @staticmethod
-    def _merge_sparse(stacked) -> dict:
-        """Merge row-sparse contributions: concat, sum duplicates, divide
-        by the worker count — elementwise identical to averaging the
-        dense-with-zeros equivalents (the server's merged/NumWorkers()
-        for row_sparse keys, ``kvstore_dist_server.h:345-379``).  Mixed
-        dense/sparse contributions are a caller bug: every waiter gets an
-        ``__error__`` result (raised client-side) instead of one handler
-        thread dying while the rest time out."""
-        if not all(isinstance(a, tuple) and a[0] == "rsp" for a in stacked):
-            return {"__error__": "mixed dense and row-sparse contributions "
-                                 "for one allreduce key"}
-        num_rows = stacked[0][3]
-        all_ids = np.concatenate([a[1] for a in stacked])
-        all_vals = np.concatenate([a[2] for a in stacked], axis=0)
-        live = all_ids < num_rows
-        all_ids, all_vals = all_ids[live], all_vals[live]
-        uniq, inv = np.unique(all_ids, return_inverse=True)
-        summed = np.zeros((len(uniq),) + all_vals.shape[1:],
-                          all_vals.dtype)
-        np.add.at(summed, inv, all_vals)
-        return {"ids": uniq.astype(np.int32),
-                "vals": summed / len(stacked), "num_rows": num_rows}
-
     # ------------------------------------------------------------------
-    # dist_async parameter-server plane
+    # range-server registry + data-plane introspection
     # ------------------------------------------------------------------
 
-    def _async_set_optimizer(self, spec: dict) -> dict:
-        """Install the server-side updater from a hyperparameter SPEC —
-        the reference pickled the whole optimizer object to the servers
-        (``python/mxnet/kvstore.py:451-498``); a spec carries the same
-        information without shipping code.  Idempotent for an identical
-        spec (every worker sends it); a DIFFERENT spec mid-run resets the
-        updater and its slots."""
-        from dt_tpu.elastic import server_optim
-        with self._async_lock:
-            if self._async_updater is not None and \
-                    self._async_updater.spec_input == \
-                    server_optim.spec_identity(spec):
-                return {}
-            try:
-                upd = server_optim.create(**dict(spec))
-            except (TypeError, ValueError) as e:
-                return {"error": f"set_optimizer: {e}"}
-            self._async_updater = upd
-            self._async_served.clear()
-        return {}
+    def _server_list(self) -> list:
+        """[[host, port], ...] ordered by server index — the worker's
+        key-range → server assignment table (kvstore_dist.h:547-589)."""
+        with self._servers_lock:
+            return [list(self._servers[i])
+                    for i in sorted(self._servers)]
 
-    def _async_init(self, key: str, value) -> dict:
-        """Init-or-get: the first writer seeds the master weights, later
-        inits return the live copy unchanged (the reference's once-per-key
-        ``kv.init`` + new-worker pull-from-servers,
-        ``kvstore_local.h:95-110`` / ``module.py:552-571``) — so every
-        worker inits unconditionally and joiners adopt trained state."""
-        with self._async_lock:
-            if key not in self._async_store:
-                self._async_store[key] = np.asarray(value)
-            return {"value": self._async_store[key]}
+    @property
+    def _reduce(self):
+        """Embedded plane's allreduce slots (tests introspect these)."""
+        return self._dp._reduce
 
-    def _async_push(self, host: str, key: str, value, seq: int = -1) -> dict:
-        """Apply one worker's gradient to the master weights IMMEDIATELY
-        and return them — the ``dist_async`` contract
-        (``kvstore_dist_server.h:347`` ``!sync_mode_``: no aggregation
-        wait, push order = application order).  (host, key, seq) dedup
-        makes at-least-once retries safe: re-applying a momentum update
-        twice would corrupt the trajectory, so a replay is served the
-        cached result instead."""
-        with self._async_lock:
-            served = self._async_served.get((host, key))
-            if seq >= 0 and served is not None and served[0] == seq:
-                return {"value": served[1]}
-            if seq >= 0 and served is not None and seq < served[0]:
-                # STALE duplicate (a delayed handler thread losing the race
-                # to its own retry): the client has already moved past this
-                # seq — applying it again would double-count the gradient.
-                # Serve the freshest weights; nobody consumes this reply.
-                return {"value": served[1]}
-            if self._async_updater is None:
-                return {"error": "async_push before set_optimizer"}
-            stored = self._async_store.get(key)
-            if stored is None:
-                return {"error": f"async_push: key {key!r} not initialized"}
-            if isinstance(value, dict) and "ids" in value:
-                # row-sparse push: lazy server-side update of the touched
-                # rows only; the response carries just those rows back
-                # (O(touched) both ways — kvstore_dist.h:690-748 +
-                # optimizer_op.cc sparse variants)
-                ids = np.asarray(value["ids"]).ravel()
-                try:
-                    new = self._async_updater.sparse(
-                        key, ids, np.asarray(value["vals"]), stored)
-                except ValueError as e:
-                    return {"error": f"async_push sparse: {e}"}
-                self._async_store[key] = new
-                keep = (ids >= 0) & (ids < new.shape[0])
-                uniq = np.unique(ids[keep])
-                resp = {"ids": uniq, "vals": new[uniq]}
-                self._async_served[(host, key)] = (seq, resp)
-                return {"value": resp}
-            new = self._async_updater(key, np.asarray(value), stored)
-            self._async_store[key] = new
-            self._async_served[(host, key)] = (seq, new)
-            if len(self._async_served) > 4 * max(len(self._async_live), 1):
-                # bound the cache by dropping DEPARTED hosts' entries only —
-                # evicting a live worker's entry would re-open the
-                # double-apply window this dedup exists to close (live
-                # entries are bounded: one per (host, key))
-                for k in [k for k in self._async_served
-                          if k[0] not in self._async_live]:
-                    del self._async_served[k]
-            return {"value": new}
+    @property
+    def _async_store(self):
+        """Embedded plane's dist_async master weights (test hook)."""
+        return self._dp._async_store
+
 
 
 def _read_hosts(path: str) -> List[str]:

@@ -34,6 +34,7 @@ import logging
 import os
 import subprocess
 import sys
+import time
 from typing import List, Optional
 
 logger = logging.getLogger("dt_tpu.launcher")
@@ -79,6 +80,20 @@ def _worker_env(base: dict, scheduler_port: int, worker_id: str,
     return env
 
 
+def _await_servers(sched, n_servers: int, timeout: float = 60.0) -> None:
+    """Block until the range-server fleet registered — workers must see
+    the full server list at registration or they fall back to the
+    scheduler funnel (the reference likewise waits for DMLC_NUM_SERVER
+    ADD_NODEs before releasing workers, ``van.cc:95-185``)."""
+    deadline = time.time() + timeout
+    while len(sched._server_list()) < n_servers:
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"only {len(sched._server_list())}/{n_servers} range "
+                "servers registered")
+        time.sleep(0.1)
+
+
 def _reap_all(procs: dict) -> dict:
     """Wait for every proc, re-snapshotting until stable: the scheduler's
     launch thread may still be inserting elastic joiners while base
@@ -94,8 +109,11 @@ def _reap_all(procs: dict) -> dict:
 
 def launch_local(num_workers: int, command: List[str],
                  hostfile: Optional[str] = None, elastic: bool = False,
-                 scheduler_port: int = 0):
-    """Fork scheduler + N local workers; returns worker exit codes."""
+                 scheduler_port: int = 0, num_servers: int = 0):
+    """Fork scheduler + optional range-server fleet + N local workers;
+    returns worker exit codes.  ``num_servers`` is the DMLC_NUM_SERVER
+    analog: >0 starts that many ``RangeServer`` processes and the data
+    plane shards across them (``kvstore_dist.h:547-589``)."""
     from dt_tpu.elastic import Scheduler
     from dt_tpu.elastic import protocol
 
@@ -110,6 +128,7 @@ def launch_local(num_workers: int, command: List[str],
             hosts = listed[:num_workers] + hosts[len(listed):]
 
     procs = {}
+    server_procs = {}
     secret_env = {"DT_ELASTIC_SECRET": secret} if secret else {}
 
     def launch_new(host: str, epoch: int):
@@ -122,9 +141,26 @@ def launch_local(num_workers: int, command: List[str],
 
     sched = Scheduler(host_worker_file=hostfile, initial_workers=hosts,
                       launch_callback=launch_new if elastic else None)
-    logger.info("scheduler on :%d; starting %d workers", sched.port,
-                num_workers)
+    logger.info("scheduler on :%d; starting %d servers + %d workers",
+                sched.port, num_servers, num_workers)
     try:
+        for i in range(num_servers):
+            env = dict(os.environ)
+            env.update(secret_env)
+            env["DMLC_ROLE"] = "server"
+            # local fleet: advertise loopback, not the machine hostname —
+            # a container without a self-hostname /etc/hosts entry would
+            # otherwise register an unresolvable address
+            env.setdefault("DT_ELASTIC_ADVERTISE", "127.0.0.1")
+            server_procs[f"server-{i}"] = subprocess.Popen(
+                [sys.executable, "-m", "dt_tpu.elastic.range_server",
+                 "--scheduler-host", "127.0.0.1",
+                 "--scheduler-port", str(sched.port),
+                 "--index", str(i)], env=env)
+        if num_servers:
+            # fleet must be registered before workers register, or the
+            # workers' server list comes back empty (funnel fallback)
+            _await_servers(sched, num_servers)
         for h in hosts:
             procs[h] = subprocess.Popen(
                 command, env=_worker_env(os.environ, sched.port, h, hostfile,
@@ -135,7 +171,7 @@ def launch_local(num_workers: int, command: List[str],
     finally:
         sched.close()
         protocol.set_secret(None)
-        for p in procs.values():
+        for p in list(procs.values()) + list(server_procs.values()):
             if p.poll() is None:
                 p.terminate()
 
@@ -193,7 +229,7 @@ def launch_ssh(num_workers: int, command: List[str], hostfile: str,
                elastic: bool = False, scheduler_port: int = 0,
                ssh_cmd: str = "ssh -o StrictHostKeyChecking=no",
                root_uri: Optional[str] = None,
-               workdir: Optional[str] = None):
+               workdir: Optional[str] = None, num_servers: int = 0):
     """Scheduler in this process, one worker per hostfile line over ssh;
     returns worker exit codes keyed by host.
 
@@ -237,7 +273,22 @@ def launch_ssh(num_workers: int, command: List[str], hostfile: str,
                       port=scheduler_port)
     logger.info("scheduler on %s:%d; ssh-starting %d workers", uri,
                 sched.port, num_workers)
+    server_procs = {}
     try:
+        # range servers ride the same host pool round-robin (reference
+        # launch.py co-schedules servers and workers on the host list)
+        for i in range(num_servers):
+            shost = hosts[i % len(hosts)]
+            env = env_for(shost, {"DMLC_ROLE": "server"})
+            server_procs[f"server-{i}"] = _ssh_popen(
+                shost,
+                [sys.executable, "-m", "dt_tpu.elastic.range_server",
+                 "--scheduler-host", uri,
+                 "--scheduler-port", str(sched.port),
+                 "--index", str(i)],
+                env, ssh_cmd, wd, secret=secret)
+        if num_servers:
+            _await_servers(sched, num_servers)
         for h in hosts:
             procs[h] = _ssh_popen(h, command, env_for(h), ssh_cmd, wd,
                                   secret=secret)
@@ -245,7 +296,7 @@ def launch_ssh(num_workers: int, command: List[str], hostfile: str,
     finally:
         sched.close()
         protocol.set_secret(None)
-        for p in procs.values():
+        for p in list(procs.values()) + list(server_procs.values()):
             if p.poll() is None:
                 p.terminate()
 
@@ -254,6 +305,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="dt_tpu job launcher (reference tools/launch.py surface)")
     ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="range-server fleet size (DMLC_NUM_SERVER "
+                         "analog); 0 = scheduler-embedded data plane")
     ap.add_argument("-H", "--hostfile", default=None,
                     help="host_worker file (elastic membership source)")
     ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
@@ -278,10 +332,11 @@ def main(argv=None) -> int:
             ap.error("ssh launcher requires -H hostfile")
         rcs = launch_ssh(args.num_workers, args.command, args.hostfile,
                          elastic, args.scheduler_port, args.ssh_cmd,
-                         args.root_uri)
+                         args.root_uri, num_servers=args.num_servers)
     else:
         rcs = launch_local(args.num_workers, args.command, args.hostfile,
-                           elastic, args.scheduler_port)
+                           elastic, args.scheduler_port,
+                           num_servers=args.num_servers)
     bad = {h: rc for h, rc in rcs.items() if rc != 0}
     if bad:
         logger.error("workers failed: %s", bad)

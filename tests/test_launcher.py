@@ -32,6 +32,33 @@ def test_launch_local_runs_workers(tmp_path):
     assert got == ["0/2", "1/2"]
 
 
+def test_launch_local_with_range_servers(tmp_path):
+    """--num-servers starts a RangeServer fleet before the workers; the
+    workers discover it at registration and an allreduce round shards
+    across the servers (HMAC-authenticated end to end)."""
+    script = tmp_path / "trainee.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, %r)
+        os.environ.pop("XLA_FLAGS", None)
+        import numpy as np
+        from dt_tpu.elastic.client import auto_client
+        c = auto_client()
+        assert len(c.servers) == 2, f"expected 2 servers, got {c.servers}"
+        got = c.allreduce("g", np.full(4, float(c.rank), np.float32))
+        np.testing.assert_allclose(got, np.full(4, 0.5, np.float32))
+        out = os.path.join(%r, os.environ["DT_WORKER_ID"] + ".ok")
+        open(out, "w").write("ok")
+        c.close()
+    """ % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           str(tmp_path))))
+    rcs = launch_local(2, [sys.executable, str(script)], elastic=True,
+                       num_servers=2)
+    assert all(rc == 0 for rc in rcs.values()), rcs
+    for i in range(2):
+        assert (tmp_path / f"worker-{i}.ok").exists()
+
+
 def test_launch_local_authenticated_by_default(tmp_path, monkeypatch):
     """The launcher auto-generates DT_ELASTIC_SECRET (judge round-2 item 8):
     workers see it in the env, the register round-trip is HMAC-framed, and
