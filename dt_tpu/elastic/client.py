@@ -488,6 +488,26 @@ class WorkerClient:
              "key": key, "seq": seq,
              "value": {"ids": ids, "vals": vals}})["value"]
 
+    def async_stats(self) -> dict:
+        """Aggregate dist_async staleness metrics: max over the fleet,
+        push-weighted mean (each server measures its own slice's pushes;
+        a worker's lag is the same on every slice, so the aggregate is
+        the per-push staleness distribution, not a double count)."""
+        if self.servers:
+            outs = self._async_fanout(
+                lambda j, addr: self._req_addr(addr,
+                                               {"cmd": "async_stats"}))
+        else:
+            outs = [self._req({"cmd": "async_stats"})]
+        n = sum(o["measured_pushes"] for o in outs)
+        return {
+            "max_staleness": max(o["max_staleness"] for o in outs),
+            "mean_staleness": (sum(o["mean_staleness"] *
+                                   o["measured_pushes"] for o in outs) / n)
+            if n else 0.0,
+            "measured_pushes": n,
+        }
+
     def async_pull_rows(self, key: str, ids) -> dict:
         """Pull only the requested rows of the master table (the
         reference's RowSparsePull, ``kvstore_dist.h:317-376``)."""

