@@ -44,15 +44,24 @@ class WorkerRemoved(Exception):
 class WorkerClient:
     def __init__(self, scheduler_host: str, scheduler_port: int,
                  host: Optional[str] = None, is_new: Optional[bool] = None,
-                 heartbeat_interval_s: float = 1.0):
+                 heartbeat_interval_s: float = 1.0,
+                 is_recovery: Optional[bool] = None):
         self.addr = (scheduler_host, scheduler_port)
         self.host = host or f"{socket.gethostname()}:{os.getpid()}"
         if is_new is None:
             is_new = os.environ.get("NEW_WORKER", "") in ("1", "true")
+        if is_recovery is None:
+            # a restarted worker re-entering under its old identity
+            # (van.cc:187-218 is_recovery); set by the restart wrapper
+            is_recovery = os.environ.get("DT_RECOVERY", "") in ("1", "true")
         resp = self._req({"cmd": "register", "host": self.host,
-                          "is_new": is_new})
+                          "is_new": is_new, "is_recovery": is_recovery})
         self.rank: int = resp["rank"]
         self.workers: List[str] = resp["workers"]
+        # recovery re-entry: rank -1 until the next membership barrier
+        # re-admits this host; resume_epoch is where to rejoin
+        self.recovery_pending: bool = bool(resp.get("recovery_pending"))
+        self.resume_epoch: int = int(resp.get("resume_epoch", 0))
         # range-server fleet (sharded data plane): when non-empty, bulk
         # data routes to these instead of the scheduler's embedded plane
         self.servers: List[Tuple[str, int]] = [
@@ -206,6 +215,38 @@ class WorkerClient:
             raise WorkerRemoved(self.host)
         self.workers = resp["workers"]
         self.rank = resp["rank"]
+        if self.recovery_pending and self.rank >= 0:
+            self.recovery_pending = False  # re-admitted as ourselves
+
+    def wait_rejoin(self, timeout_s: float = 600.0) -> int:
+        """Recovery re-entry (``van.cc:187-218``): park at the next
+        membership barrier until this host is re-admitted AS ITSELF, then
+        return the epoch whose batches start now — the caller bootstraps
+        from the snapshot (published at the previous epoch's end, i.e.
+        exactly the survivors' current params) and resumes fit at that
+        epoch in lockstep.  The scheduler bumps our stale ``resume_epoch``
+        to its live barrier, so re-sending is safe."""
+        deadline = time.time() + timeout_s
+        while self.recovery_pending:
+            if time.time() > deadline:
+                raise TimeoutError("recovery re-admission timed out")
+            try:
+                resp = self._req({"cmd": "mc_barrier", "host": self.host,
+                                  "epoch": self.resume_epoch,
+                                  "info": {"RECOVERY": 1}})
+            except RuntimeError:
+                # barrier window timed out server-side (survivors mid-
+                # epoch): park again at the next one
+                continue
+            if resp.get("you_are_removed"):
+                raise WorkerRemoved(self.host)
+            if resp.get("rank", -1) >= 0:
+                self.workers = resp["workers"]
+                self.rank = resp["rank"]
+                self.recovery_pending = False
+                return int(resp["epoch"])
+            # a removal won this barrier; recovery stays queued
+        return self.resume_epoch
 
     def barrier(self) -> None:
         seq = self._ar_seq.get("__barrier__", 0)

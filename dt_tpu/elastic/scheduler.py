@@ -67,7 +67,16 @@ class Scheduler:
             initial_workers = _read_hosts(host_worker_file)
         self._workers: List[str] = list(initial_workers or [])
         self._base: Set[str] = set(self._workers)
+        # launch-time base membership, immutable: eviction removes a
+        # crashed base worker from _base (it must be evictable), but a
+        # RECOVERED one gets its base protection back from this record
+        self._base0: Set[str] = set(self._workers)
         self._registered: Set[str] = set()
+        # crashed-and-evicted hosts that re-registered under their old
+        # identity (van.cc:187-218 is_recovery): re-admitted at the next
+        # membership barrier, not mid-epoch (sync rounds in flight must
+        # not change their expected contributor set)
+        self._pending_recovery: Set[str] = set()
         # Seed heartbeats at startup so a worker that never comes up ages
         # out and is counted dead, instead of defaulting to "alive forever".
         now = time.time()
@@ -190,7 +199,8 @@ class Scheduler:
     def _dispatch(self, msg: dict) -> dict:
         cmd = msg.get("cmd")
         if cmd == "register":
-            return self._register(msg["host"], bool(msg.get("is_new")))
+            return self._register(msg["host"], bool(msg.get("is_new")),
+                                  bool(msg.get("is_recovery")))
         if cmd == "heartbeat":
             with self._lock:
                 self._heartbeats[msg["host"]] = time.time()
@@ -260,11 +270,33 @@ class Scheduler:
     # registration / heartbeat
     # ------------------------------------------------------------------
 
-    def _register(self, host: str, is_new: bool) -> dict:
+    def _register(self, host: str, is_new: bool,
+                  is_recovery: bool = False) -> dict:
         with self._cv:
             if host in self._removed_hosts:
-                # sender-validation drop of removed hosts (van.cc:571-574)
-                return {"error": "host was removed from the job"}
+                if not is_recovery:
+                    # sender-validation drop of removed hosts
+                    # (van.cc:571-574)
+                    return {"error": "host was removed from the job"}
+                # identity reissue (van.cc:187-218 is_recovery=true): a
+                # crashed-then-evicted worker restarts under its OLD id.
+                # Queue it for re-admission at the next membership
+                # barrier — NOT mid-epoch: collectives in flight must
+                # keep their contributor set — and let it bootstrap from
+                # the snapshot meanwhile.  Its dedup caches are purged
+                # (fresh sequences after restart).
+                self._pending_recovery.add(host)
+                self._registered.add(host)
+                self._heartbeats[host] = time.time()
+                self._dp.host_registered(host)
+                self._cv.notify_all()
+                logger.info("recovery registration from %s: pending "
+                            "re-admission at the next barrier", host)
+                return {"rank": -1, "workers": list(self._workers),
+                        "recovery_pending": True,
+                        "resume_epoch": self._last_completed_epoch + 1,
+                        "profile_seq": self._profile_seq,
+                        "servers": self._server_list()}
             if host not in self._workers:
                 if not is_new:
                     self._base.add(host)  # launch-time workers are base
@@ -352,6 +384,18 @@ class Scheduler:
                 f.write("\n".join(kept) + ("\n" if kept else ""))
             os.replace(tmp, self.host_worker_file)
 
+    def _add_to_host_file(self, host: str) -> None:
+        """Re-list a RECOVERED host in host_worker — eviction removed it,
+        and without repair the very next barrier diff would re-remove the
+        recovered worker.  Caller holds the lock."""
+        if not self.host_worker_file or \
+                not os.path.exists(self.host_worker_file):
+            return
+        listed = _read_hosts(self.host_worker_file)
+        if host not in listed:
+            with open(self.host_worker_file, "a") as f:
+                f.write(host + "\n")
+
     def _complete_pending_locked(self):
         """After membership shrank, finish any collective now satisfied by
         the survivors.  Caller holds the lock."""
@@ -378,6 +422,12 @@ class Scheduler:
 
     def _mc_barrier(self, host: str, epoch: int, info: dict) -> dict:
         with self._cv:
+            if host in self._pending_recovery:
+                # a recovering host parks at the NEXT barrier whatever
+                # epoch it thinks it resumes at (its resume_epoch goes
+                # stale while it bootstraps; van.cc:187-218 skips the
+                # init barriers the same way)
+                epoch = max(epoch, self._last_completed_epoch + 1)
             if epoch <= self._last_completed_epoch:
                 # late arrival (a worker added during this epoch's barrier):
                 # the change was already applied — return the result
@@ -440,7 +490,10 @@ class Scheduler:
                            "(README.md:54-61)", sorted(blocked))
         removed: List[str] = []
         added: List[str] = []
+        recovered: List[str] = []
         if removable:
+            # removals win; a pending recovery stays queued for the next
+            # barrier (one change direction per barrier — the invariant)
             removed = sorted(removable)
             self._workers = [w for w in self._workers if w not in removable]
             self._removed_hosts |= removable
@@ -449,7 +502,24 @@ class Scheduler:
             for h in removed:
                 self._append_log("REMOVED", h)
         else:
-            to_add = sorted(desired - current)
+            # identity reissue first (van.cc:187-218): evicted-but-
+            # restarted hosts come back AS THEMSELVES — base protection
+            # restored, host file repaired, audit line RECOVERED (not
+            # ADDED: operators must see crash re-entries distinctly).
+            # Only hosts that ARRIVED at this barrier re-enter: they then
+            # start the epoch in lockstep with the survivors (exact
+            # sync); a still-bootstrapping host stays pending.
+            for h in sorted(self._pending_recovery & self._barrier_arrived):
+                self._pending_recovery.discard(h)
+                self._removed_hosts.discard(h)
+                if h not in self._workers:
+                    self._workers.append(h)
+                if h in self._base0:
+                    self._base.add(h)
+                recovered.append(h)
+                self._append_log("RECOVERED", h)
+                self._add_to_host_file(h)
+            to_add = sorted(desired - set(self._workers))
             for h in to_add:
                 if h in self._removed_hosts:
                     self._removed_hosts.discard(h)  # re-adding is allowed
@@ -462,11 +532,12 @@ class Scheduler:
                     # BEFORE epoch's batches; elastic_training.cc:26-62)
                     threading.Thread(target=self._launch_callback,
                                      args=(h, epoch), daemon=True).start()
-        if removed or added:
+        if removed or added or recovered:
             logger.info("Epoch[%d] membership change: removed=%s added=%s "
-                        "-> %s", epoch, removed, added, self._workers)
+                        "recovered=%s -> %s", epoch, removed, added,
+                        recovered, self._workers)
         return {"workers": list(self._workers), "removed": removed,
-                "added": added, "epoch": epoch}
+                "added": added, "recovered": recovered, "epoch": epoch}
 
     def _append_log(self, action: str, host: str):
         """``SEQ ADDED|REMOVED IP TIME`` (``elastic_training.cc:108-126``)."""

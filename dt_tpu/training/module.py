@@ -476,6 +476,20 @@ class Module:
         if is_new_worker and env_begin_epoch >= 0:
             begin_epoch = env_begin_epoch
 
+        # --- crash re-entry under the old identity (DT_RECOVERY=1;
+        # ps-lite van.cc:187-218 is_recovery): park until the next
+        # membership barrier re-admits us, then bootstrap from the
+        # snapshot (= survivors' params at that barrier) and resume the
+        # exact epoch whose batches start now — lockstep restored.
+        ctrl = getattr(self.kv, "_controller", None)
+        if ctrl is not None and getattr(ctrl, "recovery_pending", False):
+            begin_epoch = ctrl.wait_rejoin()
+            first = _peek_batch(train_data)
+            self.init_params(first.data, initialize_from_kvstore=True)
+            self._train_step = None  # state replaced: rebuild compiled fns
+            logger.info("recovered worker re-admitted; resuming at "
+                        "epoch %d", begin_epoch)
+
         if batch_end_callback is not None and not isinstance(
                 batch_end_callback, (list, tuple)):
             batch_end_callback = [batch_end_callback]

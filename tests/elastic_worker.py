@@ -86,8 +86,23 @@ def main():
     args = ap.parse_args()
 
     x, y = make_dataset()
+    # crash-recovery tests pre-warm the restarted process (jax import,
+    # dataset build) and gate registration on a marker file so the
+    # re-entry window isn't dominated by interpreter startup
+    wait_file = os.environ.get("DT_WAIT_FILE")
+    if wait_file:
+        import time as _time
+        while not os.path.exists(wait_file):
+            _time.sleep(0.05)
     ctrl = WorkerClient("127.0.0.1", args.scheduler_port, host=args.host,
                         heartbeat_interval_s=args.heartbeat)
+    # crash re-entry under the old identity (DT_RECOVERY=1): park until
+    # the next barrier re-admits us BEFORE building the rank-sharded
+    # iterator (rank is -1 while pending), then bootstrap from the
+    # snapshot and resume at the barrier's epoch in lockstep
+    begin_epoch = 0
+    if ctrl.recovery_pending:
+        begin_epoch = ctrl.wait_rejoin()
     kv = kvstore_lib.create("tpu_sync")
     kv.set_controller(ctrl)
 
@@ -118,7 +133,8 @@ def main():
     mod.sync_mode = "host"
 
     bootstrap_step = None
-    if os.environ.get("NEW_WORKER") == "1":
+    if os.environ.get("NEW_WORKER") == "1" or \
+            os.environ.get("DT_RECOVERY") == "1":
         first = x[:args.global_batch // kv.num_workers]
         mod.init_params(first, initialize_from_kvstore=True)
         bootstrap_step = int(mod.state.step)
@@ -134,7 +150,7 @@ def main():
                              "acc"))
         acc_curve.append((epoch, float(acc["accuracy"])))
 
-    mod.fit(train, num_epoch=args.num_epoch,
+    mod.fit(train, num_epoch=args.num_epoch, begin_epoch=begin_epoch,
             elastic_data_iterator=eit,
             epoch_end_callback=record_val)
 

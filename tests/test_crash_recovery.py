@@ -78,3 +78,73 @@ def test_sigkill_worker_is_evicted_and_job_completes(tmp_path):
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
+
+
+def test_crashed_worker_reenters_under_old_identity(tmp_path):
+    """Identity reissue (ps-lite ``van.cc:187-218`` ``is_recovery``): a
+    SIGKILLed worker is evicted, restarts under its OLD host name with
+    ``DT_RECOVERY=1``, is re-admitted at the next membership barrier AS
+    ITSELF (audit line RECOVERED, not ADDED), bootstraps from the
+    snapshot, and the job finishes with ALL THREE workers in exact sync."""
+    hw = str(tmp_path / "host_worker")
+    _write_hosts(hw, ["w0", "w1", "w2"])
+    outs = {h: str(tmp_path / f"{h}.json") for h in ("w0", "w1", "w2")}
+    go_file = str(tmp_path / "go_recover")
+    sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=2.0)
+    procs = {}
+    restarted = None
+    try:
+        num_epoch = 60
+        for h in ("w0", "w1", "w2"):
+            procs[h] = _spawn(sched.port, h, outs[h], num_epoch)
+        deadline = time.time() + 120
+        while sched._last_completed_epoch < 2:
+            assert time.time() < deadline, "training never started"
+            time.sleep(0.1)
+        procs["w2"].kill()
+
+        # pre-warm the replacement process NOW (it parks on go_file);
+        # registration must wait until the eviction landed, or it would
+        # take the ordinary quick-restart path instead of recovery
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["ELASTIC_TRAINING_ENABLED"] = "1"
+        env["DT_RECOVERY"] = "1"
+        env["DT_WAIT_FILE"] = go_file
+        restarted = subprocess.Popen(
+            [sys.executable, WORKER, "--scheduler-port", str(sched.port),
+             "--host", "w2", "--num-epoch", str(num_epoch),
+             "--out", outs["w2"], "--heartbeat", "0.2"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+        deadline = time.time() + 60
+        while "w2" not in sched._removed_hosts:
+            assert time.time() < deadline, "eviction never happened"
+            time.sleep(0.1)
+        open(go_file, "w").close()  # release the recovery registration
+
+        rcs = {}
+        for h in ("w0", "w1"):
+            rcs[h] = procs[h].wait(timeout=300)
+        rcs["w2"] = restarted.wait(timeout=300)
+        for h, rc in rcs.items():
+            p = restarted if h == "w2" else procs[h]
+            assert rc == 0, f"{h} rc={rc}:\n{p.stdout.read().decode()[-3000:]}"
+
+        results = {h: json.load(open(outs[h])) for h in ("w0", "w1", "w2")}
+        # exact sync across ALL THREE, and the job ended as a 3-worker job
+        assert len({r["param_hash"] for r in results.values()}) == 1, results
+        assert len({r["final_step"] for r in results.values()}) == 1
+        assert all(r["num_workers_at_end"] == 3 for r in results.values())
+        # audit trail: REMOVED then RECOVERED (not ADDED) for w2
+        log = open(hw + "_log").read()
+        assert "REMOVED w2" in log and "RECOVERED w2" in log
+        assert "ADDED w2" not in log
+        # host_worker repaired: w2 listed again
+        hosts = [ln.strip() for ln in open(hw) if ln.strip()]
+        assert sorted(hosts) == ["w0", "w1", "w2"]
+    finally:
+        sched.close()
+        for p in list(procs.values()) + ([restarted] if restarted else []):
+            if p.poll() is None:
+                p.kill()
