@@ -197,11 +197,30 @@ def main():
     size = int(os.environ.get("DT_BENCH_IMAGE", "224"))
     tiers = ([os.environ["DT_BENCH_MODEL"]]
              if os.environ.get("DT_BENCH_MODEL")
-             else ["resnet18", "resnet152"])
+             else ["resnet18", "transformer_lm", "resnet152"])
+    # the single reported line is the highest-priority COMPLETED tier
+    # (the reference's headline is the ResNet-152 row); other completed
+    # tiers ride along under "other_tiers" so the LM tokens/sec number
+    # survives even when the CNN row is the headline
+    priority = ["resnet152", "inception_v3", "alexnet", "resnet50",
+                "resnet18", "transformer_lm"]
+    completed = {}
     line = None
     for net in tiers:
-        result = measure_tier(net, batch, size)
-        line = json.dumps(result)
+        if net == "transformer_lm":
+            result = measure_tier_lm()
+        else:
+            result = measure_tier(net, batch, size)
+        completed[net] = result
+        head = next((completed[n] for n in priority if n in completed),
+                    result)
+        others = {n: {k: r[k] for k in ("metric", "value", "unit", "mfu",
+                                        "step_ms")
+                      if k in r}
+                  for n, r in completed.items()
+                  if r is not head}
+        line = json.dumps(dict(head, **({"other_tiers": others}
+                                        if others else {})))
         path = os.environ.get("DT_BENCH_RESULT_FILE")
         if path:
             tmp = path + ".tmp"
@@ -218,7 +237,7 @@ def main():
         jsonl = os.environ.get("DT_BENCH_JSONL")
         if jsonl is None and result.get("backend") == "tpu":
             jsonl = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "BENCH_local_r04.jsonl")
+                                 "BENCH_local_r05.jsonl")
         if jsonl:
             with open(jsonl, "a") as f:
                 f.write(json.dumps(
@@ -312,9 +331,14 @@ def measure_tier(net, batch, size):
 
     step = jax.jit(train_step, donate_argnums=(0,))
 
-    # warmup / compile
+    # AOT compile: cost_analysis must read the program BEFORE the first
+    # donating call deletes the input buffers, and AOT avoids lowering
+    # twice
     phase("compiling train step")
     t_compile = time.perf_counter()
+    compiled = step.lower(state, x, y).compile()
+    step_flops = _compiled_flops(compiled)
+    step = compiled
     state, loss = step(state, x, y)
     jax.block_until_ready((state, loss))
     t_compile = time.perf_counter() - t_compile
@@ -351,7 +375,15 @@ def measure_tier(net, batch, size):
         fwd_flops, baseline = 0.0, None  # config != calibration: no claims
     if calib_batch is not None and batch != calib_batch:
         baseline = None  # the reference row is batch-specific
-    flops_per_img = 3 * fwd_flops
+    # FLOPs: the COMPILER's count of the whole train step is primary
+    # (survives model edits — VERDICT r4 next 10); the hand-calibrated
+    # table (3x fwd heuristic) is the fallback and cross-check
+    if step_flops:
+        flops_per_img = step_flops / batch
+        flops_source = "compiler"
+    else:
+        flops_per_img = 3 * fwd_flops
+        flops_source = "table"
     model_tflops = imgs_per_sec * flops_per_img / 1e12
     kind = jax.devices()[0].device_kind
     peak = _peak_tflops(kind)
@@ -367,15 +399,125 @@ def measure_tier(net, batch, size):
         "step_ms_queued": round(queued * 1e3, 2),
         "step_ms_synced": round(synced * 1e3, 2),
         "compile_s": round(t_compile, 1),
-        "model_tflops_per_sec": round(model_tflops, 2) if fwd_flops
+        "model_tflops_per_sec": round(model_tflops, 2) if flops_per_img
         else None,
+        "flops_source": flops_source,
         "device_kind": kind,
-        # MFU from the model's algorithmic FLOPs (conv FLOPs only, so the
-        # true utilization is slightly higher) vs the chip's published
-        # bf16 peak; null when not computable (unknown chip, or the run's
-        # size differs from the FLOP calibration)
-        "mfu": round(model_tflops / peak, 3) if peak and fwd_flops
+        # MFU vs the chip's published bf16 peak; null when not computable
+        "mfu": round(model_tflops / peak, 3) if peak and flops_per_img
         else None,
+        "backend": jax.default_backend(),
+    }
+
+
+def _compiled_flops(compiled):
+    """Whole-train-step FLOPs from XLA's own cost model
+    (``Compiled.cost_analysis()``); None when the backend doesn't report
+    it."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = ca.get("flops", 0.0)
+        return float(f) or None
+    except Exception:
+        return None
+
+
+def measure_tier_lm():
+    """Transformer-LM tokens/sec tier (VERDICT r4 next 9): the
+    long-context stack gets a number next to the CNN tiers.  bf16
+    GPT-small-ish config (512 dim x 6 layers, seq 2048); attention
+    defaults to the Pallas flash kernel on TPU (``DT_BENCH_LM_ATTN``
+    overrides; plain attention on CPU smoke where interpret-mode Pallas
+    would dominate).  No reference baseline exists — the reference's LM
+    ceiling was RNNs (SURVEY §5.7) — so ``vs_baseline`` is 0."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu import models, optim
+    from dt_tpu.ops import losses
+    from dt_tpu.training.train_state import TrainState
+
+    def phase(msg):
+        print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+              flush=True)
+
+    batch = int(os.environ.get("DT_BENCH_LM_BATCH", "8"))
+    seq = int(os.environ.get("DT_BENCH_LM_SEQ", "2048"))
+    vocab = int(os.environ.get("DT_BENCH_LM_VOCAB", "8192"))
+    attn = os.environ.get("DT_BENCH_LM_ATTN")
+    if attn is None:
+        attn = "flash" if jax.default_backend() not in ("cpu",) else "none"
+    attn = None if attn in ("none", "") else attn
+    model = models.TransformerLM(
+        vocab_size=vocab, embed_dim=512, num_layers=6, num_heads=8,
+        max_len=seq, seq_parallel=attn, dtype=jnp.bfloat16)
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, vocab, (batch, seq)), jnp.int32)
+
+    phase(f"compiling LM init (seq {seq}, attn {attn or 'full'})")
+    variables = jax.jit(
+        lambda k: model.init({"params": k}, toks, training=False))(
+        jax.random.PRNGKey(0))
+    jax.block_until_ready(variables)
+    tx = optim.create("sgd", learning_rate=0.1, momentum=0.9)
+    state = TrainState.create(model.apply, variables["params"], tx, {})
+
+    def train_step(state, toks):
+        def loss_of(params):
+            logits = model.apply({"params": params}, toks, training=True)
+            return losses.softmax_cross_entropy(
+                logits[:, :-1].reshape(-1, vocab),
+                toks[:, 1:].reshape(-1))
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        return state.apply_gradients(grads), loss
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    phase("compiling LM train step")
+    t_compile = time.perf_counter()
+    compiled = step.lower(state, toks).compile()
+    step_flops = _compiled_flops(compiled)
+    state, loss = compiled(state, toks)
+    jax.block_until_ready((state, loss))
+    t_compile = time.perf_counter() - t_compile
+    phase(f"LM step compiled in {t_compile:.0f}s; measuring")
+
+    iters = int(os.environ.get("DT_BENCH_ITERS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = compiled(state, toks)
+    jax.block_until_ready((state, loss))
+    queued = (time.perf_counter() - t0) / iters
+    sync_iters = min(iters, 10)
+    t0 = time.perf_counter()
+    for _ in range(sync_iters):
+        state, loss = compiled(state, toks)
+        jax.block_until_ready((state, loss))
+    synced = (time.perf_counter() - t0) / sync_iters
+    dt_step = min(queued, synced)
+
+    tokens_per_sec = batch * seq / dt_step
+    model_tflops = (tokens_per_sec * step_flops / (batch * seq) / 1e12
+                    if step_flops else None)
+    kind = jax.devices()[0].device_kind
+    peak = _peak_tflops(kind)
+    return {
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,  # beyond reference: no LM row in its table
+        "seq_len": seq, "batch": batch, "attention": attn or "full",
+        "step_ms": round(dt_step * 1e3, 2),
+        "step_ms_queued": round(queued * 1e3, 2),
+        "step_ms_synced": round(synced * 1e3, 2),
+        "compile_s": round(t_compile, 1),
+        "model_tflops_per_sec": round(model_tflops, 2)
+        if model_tflops else None,
+        "flops_source": "compiler" if step_flops else None,
+        "device_kind": kind,
+        "mfu": round(model_tflops / peak, 3)
+        if peak and model_tflops else None,
         "backend": jax.default_backend(),
     }
 

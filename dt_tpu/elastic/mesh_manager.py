@@ -112,9 +112,34 @@ class MeshManager:
             snapshot_state(state)  # result unused; the collective matters
         self.teardown()
 
-    def teardown(self):
+    def teardown(self, lost_coordinator: bool = False):
+        """Leave the world.  ``lost_coordinator=True`` is the crash path:
+        the rank-0 host died, so the orderly ``jax.distributed.shutdown``
+        handshake (which talks to the coordinator) is skipped and only
+        the local client state is dropped — survivors then re-form a new
+        world from a host-RAM snapshot with a new coordinator (the
+        ps-lite scheduler was a single point of failure the same way;
+        SURVEY §5.3)."""
         if self._initialized:
-            jax.distributed.shutdown()
+            if not lost_coordinator:
+                jax.distributed.shutdown()
+            else:
+                # drop the local client/service WITHOUT the coordinator
+                # round-trip (client.shutdown() handshakes with the dead
+                # rank 0 and blocks); jax.distributed.initialize refuses
+                # to run twice unless this state is cleared
+                from jax._src import distributed as _jdist
+                st = _jdist.global_state
+                if st.preemption_sync_manager is not None:
+                    st.preemption_sync_manager = None
+                st.client = None
+                if st.service is not None:
+                    try:
+                        st.service.shutdown()
+                    except Exception:  # best effort: world is dead anyway
+                        pass
+                    st.service = None
+                st.coordinator_address = None
             # the XLA client caches the old world's device topology; drop
             # it so the next initialize() builds a client for the NEW world
             # (without this, jax.devices() keeps showing removed hosts'

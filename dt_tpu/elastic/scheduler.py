@@ -77,6 +77,12 @@ class Scheduler:
         # membership barrier, not mid-epoch (sync rounds in flight must
         # not change their expected contributor set)
         self._pending_recovery: Set[str] = set()
+        # host -> epoch it was re-admitted at: a wait_rejoin retry whose
+        # admitting RESPONSE was lost must be served the SAME result (its
+        # resume_epoch is stale and the pending-recovery bump no longer
+        # applies once admitted); cleared when the host reaches a later
+        # barrier through the normal fit loop
+        self._recovered_at: Dict[str, int] = {}
         # Seed heartbeats at startup so a worker that never comes up ages
         # out and is counted dead, instead of defaulting to "alive forever".
         now = time.time()
@@ -289,6 +295,9 @@ class Scheduler:
                 self._registered.add(host)
                 self._heartbeats[host] = time.time()
                 self._dp.host_registered(host)
+                for key in [k for k in self._profile_posted
+                            if k[0] == host]:
+                    del self._profile_posted[key]
                 self._cv.notify_all()
                 logger.info("recovery registration from %s: pending "
                             "re-admission at the next barrier", host)
@@ -428,6 +437,15 @@ class Scheduler:
                 # stale while it bootstraps; van.cc:187-218 skips the
                 # init barriers the same way)
                 epoch = max(epoch, self._last_completed_epoch + 1)
+            admitted = self._recovered_at.get(host)
+            if admitted is not None:
+                if epoch <= admitted:
+                    # at-least-once retry of the admitting barrier (its
+                    # response was lost): serve the SAME result
+                    return self._result_for(host,
+                                            self._barrier_result[admitted])
+                # the host moved past its re-admission normally
+                del self._recovered_at[host]
             if epoch <= self._last_completed_epoch:
                 # late arrival (a worker added during this epoch's barrier):
                 # the change was already applied — return the result
@@ -517,6 +535,7 @@ class Scheduler:
                 if h in self._base0:
                     self._base.add(h)
                 recovered.append(h)
+                self._recovered_at[h] = epoch
                 self._append_log("RECOVERED", h)
                 self._add_to_host_file(h)
             to_add = sorted(desired - set(self._workers))

@@ -30,6 +30,8 @@ from dt_tpu.models.inception_resnet_v2 import (
 from dt_tpu.models.resnext import ResNeXt as ResNeXt
 from dt_tpu.models.lstm_lm import LSTMLanguageModel as LSTMLanguageModel
 from dt_tpu.models.transformer import TransformerLM as TransformerLM
+from dt_tpu.models.transformer import (
+    PipelinedTransformerLM as PipelinedTransformerLM)
 from dt_tpu.models.ssd import (SSD as SSD, ssd_loss as ssd_loss,
                                ssd_detect as ssd_detect)
 from dt_tpu.models.rcnn import (FasterRCNNMini as FasterRCNNMini,
@@ -83,6 +85,8 @@ def _setup_registry():
     register("squeezenet", lambda **kw: SqueezeNet(**kw))
     register("lstm_lm", lambda **kw: LSTMLanguageModel(**kw))
     register("transformer_lm", lambda **kw: TransformerLM(**kw))
+    register("transformer_lm_pipelined",
+             lambda **kw: PipelinedTransformerLM(**kw))
     register("ssd", lambda **kw: SSD(**kw))
     register("faster_rcnn", lambda **kw: FasterRCNNMini(**kw))
 

@@ -186,11 +186,22 @@ class ResNet(linen.Module):
 
 class CifarResNet(linen.Module):
     """6n+2 CIFAR ResNet (20/56/110), v2 pre-activation like the reference's
-    ``train_cifar10.py`` default (BASELINE config #1)."""
+    ``train_cifar10.py`` default (BASELINE config #1).
+
+    ``stochastic_depth``: death rate of the DEEPEST residual block
+    (reference ``example/stochastic-depth/sd_cifar10.py``/``sd_module.py``
+    — Huang et al. 2016): block l's death probability ramps linearly to
+    this value; at train time an identity-shortcut block is skipped with
+    that probability (one Bernoulli per block per batch, via the
+    ``dropout`` rng stream inside jit — TPU-native, where the reference
+    sampled outside the graph and re-bound modules), at eval its
+    residual is scaled by the survival probability.  Downsampling blocks
+    always run (their shortcut changes shape)."""
     depth: int = 20
     num_classes: int = 10
     dtype: Any = jnp.float32
     remat: bool = False  # per-block memory mirror (see ResNet.remat)
+    stochastic_depth: float = 0.0
 
     @linen.compact
     def __call__(self, x, training: bool = True):
@@ -202,13 +213,27 @@ class CifarResNet(linen.Module):
                        dtype=self.dtype)(x)
         in_f = 16
         blk_idx = 0
+        total = 3 * n
         for stage, f in enumerate([16, 32, 64]):
             for i in range(n):
                 strides = (2, 2) if (i == 0 and stage > 0) else (1, 1)
                 down = (i == 0) and (strides != (1, 1) or in_f != f)
                 # explicit names: param tree identical with/without remat
-                x = block(f, strides, down, self.dtype,
+                y = block(f, strides, down, self.dtype,
                           name=f"BasicBlockV2_{blk_idx}")(x, training)
+                if self.stochastic_depth > 0 and not down:
+                    # y == x + F(x) for identity-shortcut blocks, so
+                    # (y - x) recovers the residual branch
+                    p_death = self.stochastic_depth * (blk_idx + 1) / total
+                    if training:
+                        keep = jax.random.bernoulli(
+                            self.make_rng("dropout"), 1.0 - p_death)
+                        x = x + jnp.where(keep, y - x, 0.0).astype(x.dtype)
+                    else:
+                        x = x + ((1.0 - p_death)
+                                 * (y - x)).astype(x.dtype)
+                else:
+                    x = y
                 blk_idx += 1
                 in_f = f
         x = _bn(training, self.dtype)(x)

@@ -63,6 +63,37 @@ def hinge_loss(pred: Array, label: Array, margin: float = 1.0) -> Array:
     return jnp.mean(jnp.maximum(0.0, margin - pred.astype(jnp.float32) * label))
 
 
+def nce_loss(hidden: Array, label_embeds: Array,
+             label_weight: Array) -> Array:
+    """Noise-contrastive estimation / sampled-softmax loss.
+
+    Reference ``example/nce-loss/nce.py:27-35`` (``nce_loss``): the
+    hidden vector is scored against the embeddings of (1 true + K
+    sampled noise) labels by dot product and trained as K+1 binary
+    logistic classifications — true label target 1, noise targets 0 —
+    approximating the full-vocab softmax at O(K) cost.
+
+    ``hidden``: (B, D); ``label_embeds``: (B, K+1, D);
+    ``label_weight``: (B, K+1) targets in {0, 1}.  Mean BCE-with-logits
+    over all B x (K+1) pairs (the reference's LogisticRegressionOutput).
+    """
+    pred = jnp.sum(hidden[:, None, :].astype(jnp.float32)
+                   * label_embeds.astype(jnp.float32), axis=-1)
+    t = label_weight.astype(jnp.float32)
+    # numerically-stable BCE with logits
+    return jnp.mean(jnp.maximum(pred, 0.0) - pred * t
+                    + jnp.log1p(jnp.exp(-jnp.abs(pred))))
+
+
+def nce_loss_from_ids(hidden: Array, embed_table: Array, label_ids: Array,
+                      label_weight: Array) -> Array:
+    """`nce_loss` with the label embeddings gathered from a (V, D) table
+    (the reference's shared ``embed_weight``, ``nce.py:28-31``);
+    ``label_ids``: (B, K+1) int — column 0 the true label, the rest
+    sampled noise."""
+    return nce_loss(hidden, embed_table[label_ids], label_weight)
+
+
 def kl_divergence(logp_pred: Array, p_label: Array) -> Array:
     """Reference: gluon KLDivLoss (inputs are log-probs, probs).  Like the
     reference (``python/mxnet/gluon/loss.py`` KLDivLoss: mean over all

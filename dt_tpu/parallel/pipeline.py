@@ -56,13 +56,20 @@ def _pipeline_sharded(stacked_params, x, *, stage_fn, num_micro, axis_name):
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                    stacked_params: Any, x: jax.Array, mesh: Mesh,
-                   axis_name: str = "pipe") -> jax.Array:
+                   axis_name: str = "pipe",
+                   batch_axis: str = None) -> jax.Array:
     """Run ``x`` (microbatches: (M, mb, ...)) through S pipeline stages.
 
     ``stacked_params``: pytree whose leaves have leading dim S (stage-
     stacked; shard it over ``axis_name``).  ``stage_fn(params_i, h) -> h``
     is one stage's forward.  Returns (M, mb, ...) — the last stage's
     outputs.  Differentiable; use inside a jitted loss.
+
+    ``batch_axis``: optional DATA-parallel mesh axis the microbatch dim
+    is sharded over — dp x pp composition: each (pipe, data) device
+    coordinate runs its stage on its batch shard, ppermute rides the
+    pipe axis only, and GSPMD averages gradients over the data axis as
+    usual.
     """
     num_micro = x.shape[0]
     n = mesh.shape[axis_name]
@@ -75,12 +82,16 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
             f"device)")
     pspec = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_params)
+    rest = (None,) * (x.ndim - 2)
+    xspec = P(None, batch_axis, *rest) if batch_axis else P()
+    yspec = P(axis_name, None, batch_axis, *rest) if batch_axis \
+        else P(axis_name)
     fn = jax.shard_map(
         functools.partial(_pipeline_sharded, stage_fn=stage_fn,
                           num_micro=num_micro, axis_name=axis_name),
         mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(axis_name),
+        in_specs=(pspec, xspec),
+        out_specs=yspec,
         check_vma=False)
     ys = fn(stacked_params, x)          # (S, T, mb, ...)
     # the last stage's outputs, offset by its fill latency (S-1 ticks)

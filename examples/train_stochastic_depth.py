@@ -1,0 +1,103 @@
+"""Stochastic-depth ResNet training.
+
+Reference: ``example/stochastic-depth/`` (``sd_module.py`` +
+``sd_cifar10.py``, Huang et al. 2016): residual blocks are randomly
+skipped during training with a death rate ramping linearly with depth,
+regularizing very deep nets and cutting expected train cost; at test
+time every block runs with its residual scaled by the survival
+probability.  The reference sampled the survivors OUTSIDE the graph and
+re-bound one mx.mod.Module per pattern; TPU-native, the Bernoulli draws
+ride the ``dropout`` rng stream INSIDE the compiled step (one jit,
+no re-binding).
+
+Self-check: a depth-20 CIFAR-style ResNet with death rate 0.5 trains a
+synthetic shape-classification task to high accuracy, train-mode
+forwards differ across rng draws (blocks really drop), and eval-mode is
+deterministic with the blended residuals.
+
+    DT_FORCE_CPU=1 python examples/train_stochastic_depth.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_shapes(n, rng):
+    """3-class task: vertical bar / horizontal bar / centered square on a
+    noisy 16x16 canvas."""
+    import numpy as np
+    x = rng.normal(0, 0.3, (n, 16, 16, 3)).astype(np.float32)
+    y = rng.randint(0, 3, n).astype(np.int32)
+    for i in range(n):
+        c = 4 + rng.randint(8)
+        if y[i] == 0:
+            x[i, 2:14, c] += 2.0
+        elif y[i] == 1:
+            x[i, c, 2:14] += 2.0
+        else:
+            x[i, 5:11, 5:11] += 2.0
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("--death-rate", type=float, default=0.5)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu import data, models
+    from dt_tpu.training import Module
+
+    rng = np.random.RandomState(args.seed)
+    x, y = make_shapes(args.num_examples, rng)
+    xv, yv = make_shapes(256, np.random.RandomState(777))
+
+    model = models.create("resnet20_cifar", num_classes=3,
+                          stochastic_depth=args.death_rate)
+    mod = Module(model, optimizer="sgd",
+                 optimizer_params={"learning_rate": args.lr,
+                                   "momentum": 0.9},
+                 seed=args.seed)
+    mod.fit(data.NDArrayIter(x, y, batch_size=args.batch_size,
+                             shuffle=True, seed=1),
+            num_epoch=args.epochs)
+
+    acc = dict(mod.score(data.NDArrayIter(xv, yv, batch_size=128), "acc"))
+    print(f"val acc {acc['accuracy']:.3f}", flush=True)
+
+    # mechanism checks: train-mode stochastic (different rng -> different
+    # logits: blocks really drop), eval-mode deterministic
+    vars_ = {"params": mod.state.params,
+             "batch_stats": mod.state.batch_stats}
+    xb = jnp.asarray(xv[:8])
+    t1 = model.apply(vars_, xb, training=True,
+                     rngs={"dropout": jax.random.PRNGKey(1)},
+                     mutable=["batch_stats"])[0]
+    t2 = model.apply(vars_, xb, training=True,
+                     rngs={"dropout": jax.random.PRNGKey(2)},
+                     mutable=["batch_stats"])[0]
+    assert float(jnp.abs(t1 - t2).max()) > 1e-6, \
+        "train-mode forwards identical: stochastic depth inactive"
+    e1 = model.apply(vars_, xb, training=False)
+    e2 = model.apply(vars_, xb, training=False)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+    assert acc["accuracy"] > 0.9, f"failed to train: {acc}"
+    print(f"OK stochastic depth: death_rate {args.death_rate}, "
+          f"val acc {acc['accuracy']:.3f}")
+
+
+if __name__ == "__main__":
+    main()

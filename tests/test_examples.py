@@ -214,3 +214,13 @@ def test_neural_style_smoke():
     content than the style image is."""
     r = _run("neural_style.py", "--steps", "250", timeout=420)
     assert "x down)" in r.stdout
+
+
+def test_train_nce_lm_smoke():
+    _run("train_nce_lm.py", "--vocab", "128", "--embed", "32",
+         "--epochs", "10", "--pairs", "4096")
+
+
+def test_train_stochastic_depth_smoke():
+    _run("train_stochastic_depth.py", "--num-examples", "512",
+         "--epochs", "4", "--depth", "14", timeout=420)

@@ -110,3 +110,60 @@ def test_two_process_multidevice_zero_dp_and_shrink(tmp_path):
     e2 = np.load(tmp_path / "mdparams_epoch2_r0.npy")
     assert np.abs(e2 - a).max() > 1e-6
     assert "8-device ZeRO DP" in outs[0] and "4-device world" in outs[0]
+
+
+def test_four_process_full_elastic_lifecycle(tmp_path):
+    """4 processes x 2 devices with ZeRO-1 + FSDP, driven through the
+    full elastic lifecycle in ONE job: remove (rank 3 departs) -> add (a
+    new process bootstraps from the host snapshot) -> coordinator kill
+    (rank 0 exits without the shutdown handshake; survivors re-form
+    under a new coordinator).  VERDICT r4 next 6; reference analog ran a
+    7-worker local tracker (ci/docker/runtime_functions.sh:907-915)."""
+    ports = [str(_free_port()) for _ in range(4)]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own (2 devices/process)
+    env["PYTHONPATH"] = os.path.dirname(_HERE)
+    procs = {
+        wid: subprocess.Popen(
+            [sys.executable, os.path.join(_HERE, "jaxdist_worker_4p.py"),
+             str(tmp_path), str(wid)] + ports,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for wid in (0, 1, 2, 3, 4)
+    }
+    outs = {}
+    try:
+        for wid, p in procs.items():
+            outs[wid], _ = p.communicate(timeout=540)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+    for wid, p in procs.items():
+        assert p.returncode == 0, \
+            f"w{wid} failed:\n{outs.get(wid, '')[-5000:]}"
+
+    def load(tag, wid):
+        return np.load(tmp_path / f"p4_{tag}_w{wid}.npy")
+
+    # epoch 1: all four initial ranks identical (8-device FSDP DP)
+    e1 = [load("epoch1", w) for w in (0, 1, 2, 3)]
+    for b in e1[1:]:
+        np.testing.assert_array_equal(e1[0], b, "epoch1 diverged")
+    # epoch 2: the three survivors identical
+    e2 = [load("epoch2", w) for w in (0, 1, 2)]
+    for b in e2[1:]:
+        np.testing.assert_array_equal(e2[0], b, "epoch2 diverged")
+    # epoch 3: survivors + joiner identical (snapshot bootstrap worked)
+    e3 = [load("epoch3", w) for w in (0, 1, 2, 4)]
+    for b in e3[1:]:
+        np.testing.assert_array_equal(e3[0], b, "epoch3 diverged")
+    # epoch 4: post-coordinator-kill world identical and still training
+    e4 = [load("epoch4", w) for w in (1, 2, 4)]
+    for b in e4[1:]:
+        np.testing.assert_array_equal(e4[0], b, "epoch4 diverged")
+    for a, b in ((e1[0], e2[0]), (e2[0], e3[0]), (e3[0], e4[0])):
+        assert np.abs(b - a).max() > 1e-6, "params stopped moving"
+    assert "joiner: bootstrapped from snapshot" in outs[4]
+    assert "coordinator dying" in outs[0]
+    assert "new coordinator" in outs[1]

@@ -128,3 +128,64 @@ def test_fit_metric_pipelining_counts_all_batches():
     mod = Module(models.create("mlp", num_classes=2, hidden=(4,)))
     m = mod.fit(train, num_epoch=1, eval_metric="acc")
     assert m.num_inst == 48  # 3 batches x 16, none skipped
+
+
+def test_nce_loss_numpy_oracle():
+    """nce_loss == mean BCE-with-logits over the K+1 dot-product scores
+    (reference example/nce-loss/nce.py LogisticRegressionOutput path)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from dt_tpu.ops import losses
+
+    rng = np.random.RandomState(0)
+    B, K, D, V = 4, 3, 8, 20
+    hidden = rng.normal(size=(B, D)).astype(np.float32)
+    table = rng.normal(size=(V, D)).astype(np.float32)
+    ids = rng.randint(0, V, (B, K + 1))
+    w = np.zeros((B, K + 1), np.float32)
+    w[:, 0] = 1.0
+
+    got = float(losses.nce_loss_from_ids(
+        jnp.asarray(hidden), jnp.asarray(table), jnp.asarray(ids),
+        jnp.asarray(w)))
+    # numpy oracle
+    scores = np.einsum("bd,bkd->bk", hidden, table[ids])
+    p = 1.0 / (1.0 + np.exp(-scores))
+    bce = -(w * np.log(p) + (1 - w) * np.log(1 - p))
+    np.testing.assert_allclose(got, bce.mean(), rtol=1e-5)
+
+
+def test_stochastic_depth_expected_value_and_determinism():
+    """Eval-mode stochastic-depth residuals are blended by the survival
+    probability; death_rate=0 is exactly the plain network."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu import models
+
+    x = jnp.asarray(np.random.RandomState(0).normal(
+        size=(2, 16, 16, 3)).astype(np.float32))
+    plain = models.create("resnet20_cifar", num_classes=4)
+    sd0 = models.create("resnet20_cifar", num_classes=4,
+                        stochastic_depth=0.0)
+    v = plain.init({"params": jax.random.PRNGKey(0)}, x, training=False)
+    np.testing.assert_array_equal(
+        np.asarray(plain.apply(v, x, training=False)),
+        np.asarray(sd0.apply(v, x, training=False)))
+
+    sd = models.create("resnet20_cifar", num_classes=4,
+                       stochastic_depth=0.8)
+    out1 = sd.apply(v, x, training=False)
+    out2 = sd.apply(v, x, training=False)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    # blending changes eval output vs the plain net
+    assert float(jnp.abs(out1 - plain.apply(v, x, training=False)).max()) \
+        > 1e-6
+    # train mode: different rng draws drop different blocks
+    t1 = sd.apply(v, x, training=True,
+                  rngs={"dropout": jax.random.PRNGKey(1)},
+                  mutable=["batch_stats"])[0]
+    t2 = sd.apply(v, x, training=True,
+                  rngs={"dropout": jax.random.PRNGKey(2)},
+                  mutable=["batch_stats"])[0]
+    assert float(jnp.abs(t1 - t2).max()) > 1e-6

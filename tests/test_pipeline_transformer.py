@@ -1,0 +1,117 @@
+"""Pipeline parallelism on a REAL model (VERDICT r4 next 4).
+
+``PipelinedTransformerLM`` folds the decoder blocks into stage-stacked
+params streamed through the GPipe schedule (``parallel/pipeline.py``)
+and duck-types the flax surface, so ``training.Module.fit`` drives it
+unchanged.  Reference capability: ``example/model-parallel/`` manual
+``group2ctx`` placement + ``src/operator/cross_device_copy.cc``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dt_tpu import data, models
+from dt_tpu.parallel import mesh as mesh_lib
+
+V, D, L, H, S = 64, 32, 4, 4, 16  # vocab, dim, layers, heads, seq
+
+
+def _toks(b=8, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randint(0, V, (b, S)))
+
+
+def _mk(mesh, batch_axis=None, stages=2, micro=4):
+    return models.PipelinedTransformerLM(
+        vocab_size=V, embed_dim=D, num_layers=L, num_heads=H, max_len=S,
+        num_stages=stages, num_micro=micro, mesh=mesh,
+        batch_axis=batch_axis)
+
+
+def _remap_to_plain(pvars, stages=2):
+    """Stage-stacked params -> the plain TransformerLM param tree
+    (stage j, layer i  ->  block{j*lps+i}); the two models must be the
+    same function."""
+    outer = pvars["params"]["outer"]
+    stacked = pvars["params"]["stages"]
+    lps = L // stages
+    plain = {"embed": outer["embed"], "pos_embed": outer["pos_embed"],
+             "LayerNorm_0": outer["ln_f"], "lm_head": outer["lm_head"]}
+    for j in range(stages):
+        stage_j = jax.tree_util.tree_map(lambda p, j=j: p[j], stacked)
+        for i in range(lps):
+            plain[f"block{j * lps + i}"] = stage_j[f"layer{i}"]
+    return {"params": plain}
+
+
+def test_pipelined_lm_matches_plain_transformer():
+    """Pipelined forward (2 stages over the pipe axis, 4 microbatches)
+    == the plain TransformerLM with identical weights."""
+    mesh = mesh_lib.make_mesh(data=1, model=2,
+                              axis_names=("data", "pipe"))
+    model = _mk(mesh)
+    toks = _toks()
+    pvars = model.init({"params": jax.random.PRNGKey(0)}, toks)
+    got = model.apply(pvars, toks, training=False)
+
+    plain = models.TransformerLM(vocab_size=V, embed_dim=D, num_layers=L,
+                                 num_heads=H, max_len=S)
+    want = plain.apply(_remap_to_plain(pvars), toks, training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    # single-device oracle path (mesh=None) is also the same function
+    seq = _mk(None)
+    want2 = seq.apply(pvars, toks, training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want2),
+                               rtol=2e-4, atol=2e-4)
+
+
+def _lm_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def _fit(model, mesh, steps=6, batch=8):
+    from dt_tpu.training import Module
+    rng = np.random.RandomState(3)
+    x = rng.randint(0, V, (batch * steps, S)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)  # next-token targets
+    mod = Module(model, loss_fn=_lm_loss, optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                 mesh=mesh, seed=5)
+    losses = []
+    mod.fit(data.NDArrayIter(x, y, batch_size=batch), num_epoch=1,
+            batch_end_callback=lambda p: losses.append(None))
+    flat, _ = jax.flatten_util.ravel_pytree(
+        jax.device_get(mod.state.params))
+    return np.asarray(flat), mod
+
+
+def test_pipelined_lm_module_fit_dp_x_pp_equals_single_device():
+    """Module.fit drives the pipelined LM over a dp x pp mesh (2 data x
+    2 pipe devices) and lands on the SAME weights as the single-device
+    sequential path — loss-equality for the real-model pipeline."""
+    mesh = mesh_lib.make_mesh(data=2, model=2,
+                              axis_names=("data", "pipe"))
+    w_pp, _ = _fit(_mk(mesh, batch_axis="data"), mesh)
+    w_1d, _ = _fit(_mk(None), None)
+    np.testing.assert_allclose(w_pp, w_1d, rtol=1e-4, atol=1e-5)
+    assert np.abs(w_pp).sum() > 0  # training moved the weights at all
+
+
+def test_pipelined_lm_stage_mismatch_raises():
+    with pytest.raises(ValueError, match="divide"):
+        _mk(None, stages=3)
+
+
+def test_pipelined_lm_microbatch_divisibility():
+    mesh = mesh_lib.make_mesh(data=1, model=2,
+                              axis_names=("data", "pipe"))
+    model = _mk(mesh, micro=3)
+    toks = _toks(b=8)
+    pvars = model.init({"params": jax.random.PRNGKey(0)}, toks)
+    with pytest.raises(ValueError, match="num_micro"):
+        model.apply(pvars, toks)
