@@ -113,13 +113,18 @@ class MeshManager:
         self.teardown()
 
     def teardown(self, lost_coordinator: bool = False):
-        """Leave the world.  ``lost_coordinator=True`` is the crash path:
-        the rank-0 host died, so the orderly ``jax.distributed.shutdown``
-        handshake (which talks to the coordinator) is skipped and only
-        the local client state is dropped — survivors then re-form a new
-        world from a host-RAM snapshot with a new coordinator (the
-        ps-lite scheduler was a single point of failure the same way;
-        SURVEY §5.3)."""
+        """Leave the world.  ``lost_coordinator=True`` skips the orderly
+        ``jax.distributed.shutdown`` handshake (it talks to the — dead —
+        rank-0 host) and only drops local client state.
+
+        Scope note (tests/jaxdist_worker_4p.py): jax's coordination
+        service FATALLY terminates attached peers once it detects the
+        leader's death, so this flag only helps in the narrow window
+        before detection.  The robust coordinator-loss recovery is the
+        restart path: survivor processes restart and re-form a smaller
+        world from the epoch-end host snapshot under a new coordinator
+        (the ps-lite scheduler was a single point of failure the same
+        way; SURVEY §5.3)."""
         if self._initialized:
             if not lost_coordinator:
                 jax.distributed.shutdown()

@@ -11,9 +11,16 @@ one job:
   phase 2  REMOVE: rank 3 departs; survivors rebuild to 3p x 2d
   phase 3  ADD: a brand-new process joins (bootstraps from the host
            snapshot); world back to 4p x 2d
-  phase 4  COORDINATOR KILL: rank 0 exits WITHOUT the shutdown
-           handshake; survivors re-form 3p x 2d with a NEW coordinator
-           from the epoch-end host snapshot
+  phase 4  COORDINATOR KILL: rank 0 dies WITHOUT the shutdown
+           handshake.  jax's coordination service then FATALLY
+           terminates attached peers by design (client.h "Terminating
+           process because the JAX distributed service detected fatal
+           errors"), so in-process survival is impossible — the real
+           recovery path is the one the framework documents: survivor
+           processes RESTART and re-form a 3p x 2d world under a NEW
+           coordinator from the epoch-end host snapshot.  Here each
+           survivor spawns its restarted self (``--phase4-child``)
+           before the old world collapses.
 
 After every multi-process epoch all live ranks must hold identical
 params (gathered via the snapshot collective), proving the collectives
@@ -22,6 +29,8 @@ really crossed process boundaries at each world size.
 
 import os
 import pickle
+import signal
+import subprocess
 import sys
 import time
 
@@ -68,18 +77,44 @@ def main():
     snap_path = os.path.join(out_dir, "snap_epoch2.pkl")
     join_marker = os.path.join(out_dir, "join_ready")
 
+    def enter_world_from_blob(blob, num_processes, process_id, port):
+        """Join a (re)formed world and restore training state from a
+        plain-dict host snapshot.  EVERY process of the new world —
+        survivors and joiners alike — must run THIS EXACT sequence with
+        bit-identical values: replicated multihost ``device_put`` pairs
+        calls up across processes and asserts value equality, so a
+        survivor restoring a differently-structured pytree than the
+        joiner trips jax's consistency check (this test's first
+        failure)."""
+        import jax.numpy as jnp
+        mesh = mm.initialize(num_processes=num_processes,
+                             process_id=process_id,
+                             coordinator_address=f"127.0.0.1:{port}")
+        mod = make_module(mesh)
+        # fresh state provides the TrainState skeleton (apply_fn/tx are
+        # process-local closures, deliberately NOT in the snapshot);
+        # identical deterministic sample on every process
+        rng0 = np.random.RandomState(7)
+        mod.init_params(rng0.uniform(-1, 1, (6, 6, 6, 1))
+                        .astype(np.float32))
+        rep = restore_state(blob, mesh)
+        mod.state = mod.state.replace(
+            step=jnp.asarray(rep["step"]), params=rep["params"],
+            batch_stats=rep["batch_stats"], opt_state=rep["opt_state"])
+        return mesh, mod
+
     if wid == 4:
         # ---- the JOINER: parks until the survivors published the
         # epoch-2 snapshot, then enters world 3 as process 3 ----------
+        deadline = time.time() + 300
         while not os.path.exists(join_marker):
+            if time.time() > deadline:
+                raise SystemExit("joiner: join_marker never appeared")
             time.sleep(0.05)
         with open(snap_path, "rb") as f:
-            host_state = pickle.load(f)
-        mesh = mm.initialize(num_processes=4, process_id=3,
-                             coordinator_address=f"127.0.0.1:{p3}")
+            blob = pickle.load(f)
+        mesh, mod = enter_world_from_blob(blob, 4, 3, p3)
         assert jax.process_count() == 4 and len(jax.devices()) == 8
-        mod = make_module(mesh)
-        mod.state = restore_state(host_state, mesh)
         print("joiner: bootstrapped from snapshot, in 4p world", flush=True)
     else:
         # ---- phase 1: 4 processes x 2 devices, ZeRO+FSDP ------------
@@ -112,51 +147,130 @@ def main():
         mod.state = state
         fit_one_epoch(mod, num_parts=3, part_index=wid)
         host2 = snapshot_state(mod.state)  # full state: the join snapshot
-        dump("epoch2", host2["params"] if isinstance(host2, dict)
-             else host2.params)
+        dump("epoch2", host2.params)
+        # plain-dict snapshot: a TrainState carries apply_fn/tx closures
+        # that pickle rejects mid-write (a truncated file deadlocked this
+        # test's first version); all survivors hold host2 bit-identically
+        # (snapshot_state allgathers), so every process's blob equals the
+        # pickled one
+        blob = {"step": host2.step, "params": host2.params,
+                "batch_stats": host2.batch_stats,
+                "opt_state": host2.opt_state}
         if wid == 0:
-            with open(snap_path, "wb") as f:
-                pickle.dump(host2, f)
+            tmp = snap_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(blob, f)
+            os.replace(tmp, snap_path)
             open(join_marker, "w").close()
         print(f"w{wid}: epoch2 done (3p world)", flush=True)
 
         # ---- phase 3: ADD the joiner back to 4p ---------------------
-        mesh, state = mm.rebuild(mod.state, num_processes=4,
-                                 process_id=wid,
-                                 coordinator_address=f"127.0.0.1:{p3}")
+        # survivors re-enter through the SAME blob-restore sequence the
+        # joiner uses (see enter_world_from_blob's consistency note)
+        mm.teardown()
+        mesh, mod = enter_world_from_blob(blob, 4, wid, p3)
         assert jax.process_count() == 4 and len(jax.devices()) == 8
-        mod = make_module(mesh)
-        mod.state = state
 
     # ---- phase 3 epoch: everyone (w0,w1,w2,joiner) ------------------
     fit_one_epoch(mod, num_parts=4,
                   part_index=3 if wid == 4 else wid)
     host3 = snapshot_state(mod.state)  # collective; doubles as the
-    dump("epoch3", host3["params"] if isinstance(host3, dict)
-         else host3.params)            # epoch-end host snapshot
+    dump("epoch3", host3.params)       # epoch-end host snapshot
     print(f"w{wid}: epoch3 done (4p world incl. joiner)", flush=True)
 
     # ---- phase 4: COORDINATOR KILL ----------------------------------
-    if wid == 0:
-        time.sleep(2.0)  # let peers drain the gather before we vanish
-        print("w0: coordinator dying without handshake", flush=True)
+    # The old world ends DISORDERLY: no process calls
+    # jax.distributed.shutdown (the leader is "crashing", and jax's
+    # coordination service would fatally terminate attached survivors
+    # the moment it notices — in-process survival is not possible by
+    # design).  Recovery = the documented restart path: each survivor
+    # spawns its restarted self, which re-forms a 3-process world under
+    # a NEW coordinator (w1) from the epoch-3 host snapshot.
+    if wid != 0:
+        blob3 = {"step": host3.step, "params": host3.params,
+                 "batch_stats": host3.batch_stats,
+                 "opt_state": host3.opt_state}
+        if wid == 1:  # the new leader publishes the snapshot
+            tmp = os.path.join(out_dir, "snap_epoch3.pkl.tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump(blob3, f)
+            os.replace(tmp, os.path.join(out_dir, "snap_epoch3.pkl"))
+        # restarted self (inherits stdout so its prints reach the test)
+        subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                          out_dir, str(wid), p1, p2, p3, p4,
+                          "--phase4-child"])
+        # exit hard: skip atexit's distributed shutdown (it would
+        # handshake with a dying leader) — this IS the crash ending
+        print(f"w{wid}: old world ends; restarted self spawned",
+              flush=True)
+        sys.stdout.flush()
         os._exit(0)
-    # survivors: drop the dead world WITHOUT the shutdown handshake,
-    # re-form a 3-process world under a NEW coordinator (w1), restore
-    # from the epoch-3 host snapshot
-    time.sleep(3.0)  # ensure w0 is gone (crash, not race)
-    mm.teardown(lost_coordinator=True)
+    time.sleep(1.0)  # let the siblings' exits land first (determinism)
+    print("w0: coordinator dying without handshake", flush=True)
+    os._exit(0)
+
+
+def phase4_child():
+    """A RESTARTED survivor: fresh process, no inherited jax state.
+    Re-forms the post-crash 3-process world under the new coordinator
+    and resumes from the epoch-3 snapshot."""
+    out_dir = sys.argv[1]
+    wid = int(sys.argv[2])
+    p4 = sys.argv[6]
+    signal.alarm(420)  # a missing peer must not hang the pytest pipe
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import numpy as np
+
+    from dt_tpu import data, models
+    from dt_tpu.elastic.mesh_manager import MeshManager, restore_state
+    from dt_tpu.elastic.mesh_manager import snapshot_state
+    from dt_tpu.training import Module
+
+    snap = os.path.join(out_dir, "snap_epoch3.pkl")
+    deadline = time.time() + 60
+    while not os.path.exists(snap):
+        if time.time() > deadline:
+            raise SystemExit("phase4 child: snapshot never appeared")
+        time.sleep(0.05)
+    with open(snap, "rb") as f:
+        blob = pickle.load(f)
+
     new_pid = {1: 0, 2: 1, 4: 2}[wid]
+    mm = MeshManager()
     mesh = mm.initialize(num_processes=3, process_id=new_pid,
                          coordinator_address=f"127.0.0.1:{p4}")
     assert jax.process_count() == 3 and len(jax.devices()) == 6
-    mod = make_module(mesh)
-    mod.state = restore_state(host3, mesh)
-    fit_one_epoch(mod, num_parts=3, part_index=new_pid)
+    mod = Module(models.create("mlp", num_classes=4, hidden=(32,)),
+                 optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                 mesh=mesh, shard_opt_state=True, shard_params=True)
+    rng0 = np.random.RandomState(7)
+    mod.init_params(rng0.uniform(-1, 1, (6, 6, 6, 1)).astype(np.float32))
+    import jax.numpy as jnp
+    rep = restore_state(blob, mesh)
+    mod.state = mod.state.replace(
+        step=jnp.asarray(rep["step"]), params=rep["params"],
+        batch_stats=rep["batch_stats"], opt_state=rep["opt_state"])
+
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-1, 1, (48, 6, 6, 1)).astype(np.float32)
+    y = rng.randint(0, 4, 48).astype(np.int32)
+    it = data.NDArrayIter(x, y, batch_size=24 // 3, num_parts=3,
+                          part_index=new_pid)
+    mod.fit(it, num_epoch=1)
     host4 = snapshot_state(mod.state.params)
-    dump("epoch4", host4)
+    flat, _ = jax.flatten_util.ravel_pytree(host4)
+    np.save(os.path.join(out_dir, f"p4_epoch4_w{wid}.npy"),
+            np.asarray(flat))
     print(f"w{wid}: epoch4 done (new coordinator, 3p world)", flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--phase4-child" in sys.argv:
+        phase4_child()
+    else:
+        main()
