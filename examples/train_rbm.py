@@ -1,0 +1,130 @@
+"""Restricted Boltzmann Machine with CD-k — the reference's
+``example/restricted-boltzmann-machine`` family.
+
+Reference: ``example/restricted-boltzmann-machine/binary_rbm.py``
+(Bernoulli-Bernoulli RBM trained by contrastive divergence): visible
+units v, hidden units h, energy E = -v'Wh - b'v - c'h; CD-k estimates
+the gradient as <v h'>_data - <v h'>_model with k Gibbs steps.
+TPU-native shape: the whole CD-k chain is a ``lax.fori_loop`` of
+matmul + Bernoulli sampling inside ONE jit step (the reference ran the
+chain as an MXNet custom operator); sampling uses ``jax.random``
+stateless keys.
+
+Self-check: free energy of held-out real digits must end up well below
+that of noise images (the RBM learned the data manifold), and the
+one-step reconstruction error must drop substantially from its initial
+value.
+
+    DT_FORCE_CPU=1 python examples/train_rbm.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--cd-k", type=int, default=1)
+    ap.add_argument("--epochs", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    X = (d.data / 16.0 > 0.5).astype(np.float32)  # binarized 8x8 digits
+    rng = np.random.RandomState(args.seed)
+    order = rng.permutation(len(X))
+    n_val = len(X) // 5
+    Xv, Xt = X[order[:n_val]], X[order[n_val:]]
+    V, H = 64, args.hidden
+
+    params = {
+        "W": jnp.asarray(rng.normal(0, 0.01, (V, H)), jnp.float32),
+        "b": jnp.zeros((V,)),  # visible bias
+        "c": jnp.zeros((H,)),  # hidden bias
+    }
+
+    def p_h(p, v):
+        return jax.nn.sigmoid(v @ p["W"] + p["c"])
+
+    def p_v(p, h):
+        return jax.nn.sigmoid(h @ p["W"].T + p["b"])
+
+    @jax.jit
+    def cd_step(p, v0, key):
+        """One CD-k update: positive phase from data, negative phase
+        from a k-step Gibbs chain (binary_rbm.py semantics)."""
+        ph0 = p_h(p, v0)
+
+        def gibbs(i, carry):
+            vk, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            hk = jax.random.bernoulli(k1, p_h(p, vk)).astype(jnp.float32)
+            vk = jax.random.bernoulli(k2, p_v(p, hk)).astype(jnp.float32)
+            return vk, key
+
+        vk, key = lax.fori_loop(0, args.cd_k, gibbs, (v0, key))
+        phk = p_h(p, vk)
+        n = v0.shape[0]
+        dW = (v0.T @ ph0 - vk.T @ phk) / n
+        db = jnp.mean(v0 - vk, axis=0)
+        dc = jnp.mean(ph0 - phk, axis=0)
+        new = {"W": p["W"] + args.lr * dW, "b": p["b"] + args.lr * db,
+               "c": p["c"] + args.lr * dc}
+        recon = jnp.mean((v0 - p_v(p, ph0)) ** 2)
+        return new, recon
+
+    @jax.jit
+    def free_energy(p, v):
+        """F(v) = -b'v - sum_j softplus(c_j + (vW)_j) — lower = more
+        probable under the model."""
+        return -(v @ p["b"]) - jnp.sum(
+            jax.nn.softplus(v @ p["W"] + p["c"]), axis=-1)
+
+    @jax.jit
+    def recon_mse(p, v):
+        return jnp.mean((v - p_v(p, p_h(p, v))) ** 2)
+
+    key = jax.random.PRNGKey(args.seed)
+    steps = len(Xt) // args.batch_size
+    recon_init = float(recon_mse(params, jnp.asarray(Xv)))
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xt))
+        tot = 0.0
+        for s in range(steps):
+            idx = perm[s * args.batch_size:(s + 1) * args.batch_size]
+            key, sub = jax.random.split(key)
+            params, recon = cd_step(params, jnp.asarray(Xt[idx]), sub)
+            tot += float(recon)
+        if epoch % 5 == 0 or epoch == args.epochs - 1:
+            print(f"epoch {epoch}: recon mse {tot / steps:.4f}",
+                  flush=True)
+    recon_final = float(recon_mse(params, jnp.asarray(Xv)))
+
+    noise = (rng.rand(len(Xv), V) > 0.5).astype(np.float32)
+    fe_data = float(jnp.mean(free_energy(params, jnp.asarray(Xv))))
+    fe_noise = float(jnp.mean(free_energy(params, jnp.asarray(noise))))
+    print(f"free energy: data {fe_data:.1f} vs noise {fe_noise:.1f}; "
+          f"held-out recon {recon_init:.4f} -> {recon_final:.4f}")
+    assert fe_data < fe_noise - 5.0, \
+        "RBM did not separate data from noise"
+    assert recon_final < 0.6 * recon_init, \
+        f"reconstruction never improved ({recon_init} -> {recon_final})"
+    print("OK rbm: CD-k learned the digit manifold")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""SVM-output classifier — the reference's ``example/svm_mnist`` family.
+
+Reference: ``example/svm_mnist/svm_mnist.py`` + ``src/operator/
+svm_output.cc`` (SVMOutput): an MLP whose top layer trains with the
+multiclass L1 hinge loss (one-vs-all: the true class's score is pushed
+above +1, every other class below -1) instead of softmax cross-entropy.
+Data: sklearn digits (the real image data available in this zero-egress
+container; the reference used MNIST).  Self-checks a validation-accuracy
+gate.
+
+    DT_FORCE_CPU=1 python examples/train_svm.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--margin", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from dt_tpu.config import maybe_force_cpu
+    maybe_force_cpu()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from sklearn.datasets import load_digits
+    from dt_tpu import optim
+    from dt_tpu.ops import losses
+
+    d = load_digits()
+    X = (d.data / 16.0).astype(np.float32)
+    y = d.target.astype(np.int32)
+    rng = np.random.RandomState(args.seed)
+    order = rng.permutation(len(X))
+    n_val = len(X) // 5
+    Xv, yv = X[order[:n_val]], y[order[:n_val]]
+    Xt, yt = X[order[n_val:]], y[order[n_val:]]
+    C = 10
+
+    params = {
+        "w1": jnp.asarray(rng.normal(0, 0.1, (64, args.hidden)),
+                          jnp.float32),
+        "b1": jnp.zeros((args.hidden,)),
+        "w2": jnp.asarray(rng.normal(0, 0.1, (args.hidden, C)),
+                          jnp.float32),
+        "b2": jnp.zeros((C,)),
+    }
+
+    def scores(p, x):
+        h = jax.nn.relu(x @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    def loss_fn(p, x, labels):
+        s = scores(p, x)
+        # SVMOutput one-vs-all targets: +1 for the true class, -1 rest
+        t = 2.0 * jax.nn.one_hot(labels, C) - 1.0
+        return losses.hinge_loss(s, t, margin=args.margin)
+
+    tx = optim.create("sgd", learning_rate=args.lr, momentum=0.9)
+    st = tx.init(params)
+
+    @jax.jit
+    def step(p, st, x, labels):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, labels)
+        u, st = tx.update(g, st, p)
+        return optax.apply_updates(p, u), st, loss
+
+    @jax.jit
+    def acc_of(p, x, labels):
+        return jnp.mean(jnp.argmax(scores(p, x), -1) == labels)
+
+    steps = len(Xt) // args.batch_size
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xt))
+        tot = 0.0
+        for s in range(steps):
+            idx = perm[s * args.batch_size:(s + 1) * args.batch_size]
+            params, st, loss = step(params, st, jnp.asarray(Xt[idx]),
+                                    jnp.asarray(yt[idx]))
+            tot += float(loss)
+        va = float(acc_of(params, jnp.asarray(Xv), jnp.asarray(yv)))
+        print(f"epoch {epoch}: hinge {tot / steps:.4f} val acc {va:.3f}",
+              flush=True)
+    assert va > 0.9, f"SVM head failed to train (val acc {va:.3f})"
+    print(f"OK svm: val acc {va:.3f} (L1 hinge, margin {args.margin})")
+
+
+if __name__ == "__main__":
+    main()

@@ -224,3 +224,20 @@ def test_train_nce_lm_smoke():
 def test_train_stochastic_depth_smoke():
     _run("train_stochastic_depth.py", "--num-examples", "512",
          "--epochs", "4", "--depth", "14", timeout=420)
+
+
+def test_train_svm_smoke():
+    _run("train_svm.py", "--epochs", "8")
+
+
+def test_cnn_visualization_smoke():
+    _run("cnn_visualization.py", "--num-examples", "512", "--epochs", "4",
+         timeout=420)
+
+
+def test_train_dsd_smoke():
+    _run("train_dsd.py", "--epochs-per-phase", "4", timeout=420)
+
+
+def test_train_rbm_smoke():
+    _run("train_rbm.py", "--epochs", "12")
