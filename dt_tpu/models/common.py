@@ -32,6 +32,9 @@ class FusedBatchNorm(linen.Module):
     momentum: float = BN_MOMENTUM
     epsilon: float = BN_EPS
     dtype: Dtype = jnp.float32
+    #: run the Pallas fused TRAIN kernel too (r5: stats + normalize as
+    #: two VMEM passes with a custom VJP) instead of plain jnp
+    fused_train: bool = True
 
     @linen.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
@@ -49,6 +52,14 @@ class FusedBatchNorm(linen.Module):
             return fused_bn_inference(x, scale, bias, ra_mean.value,
                                       ra_var.value,
                                       eps=self.epsilon).astype(self.dtype)
+        if self.fused_train and not self.is_initializing():
+            from dt_tpu.ops.pallas.kernels import fused_bn_train
+            y, new_mean, new_var = fused_bn_train(
+                x, scale, bias, ra_mean.value, ra_var.value,
+                self.momentum, self.epsilon)
+            ra_mean.value = new_mean
+            ra_var.value = new_var
+            return y.astype(self.dtype)
         axes = tuple(range(x.ndim - 1))
         mean = jnp.mean(x.astype(jnp.float32), axis=axes)
         var = jnp.var(x.astype(jnp.float32), axis=axes)

@@ -140,6 +140,32 @@ def main():
                                            iters=args.iters), 3),
             })
 
+            # TRAIN-mode fused BN (r5: VERDICT r4 weak 3) — fwd + bwd
+            def train_loss(fn):
+                def loss(x, g, b):
+                    y, _, _ = fn(x, g, b)
+                    return jnp.sum(y * y)
+                return jax.jit(jax.value_and_grad(loss,
+                                                  argnums=(0, 1, 2)))
+
+            oracle_tr = train_loss(
+                lambda x, g, b, m=mean, v=var: nn.batch_norm(
+                    x, g, b, m, v, training=True))
+            pallas_tr = train_loss(
+                lambda x, g, b, m=mean, v=var: kernels.fused_bn_train(
+                    x, g, b, m, v, 0.9, 1e-5))
+            emit({
+                "kernel": "fused_bn_train_fwd_bwd",
+                "shape": f"{N}x{HW}x{HW}x{C} {dt.__name__}",
+                "parity_max_abs_err": _err(
+                    oracle_tr(xb, gamma, beta),
+                    pallas_tr(xb, gamma, beta)),
+                "oracle_ms": round(_timeit(oracle_tr, xb, gamma, beta,
+                                           iters=args.iters), 3),
+                "pallas_ms": round(_timeit(pallas_tr, xb, gamma, beta,
+                                           iters=args.iters), 3),
+            })
+
     # ---- 2-bit gradient quantize (1M/16M/64M sweep) ---------------------
     if wanted("quantize_2bit"):
         q_sizes = [1 << 14] if args.small else \
