@@ -50,7 +50,7 @@ def test_sigkill_worker_is_evicted_and_job_completes(tmp_path):
             procs[h] = _spawn(sched.port, h, outs[h], num_epoch)
         # wait until training is underway, then SIGKILL w2 (no cleanup,
         # no goodbye — the crash case)
-        deadline = time.time() + 120
+        deadline = time.time() + 300  # 1-core box: 3x jax-import under load
         while sched._last_completed_epoch < 2:
             assert time.time() < deadline, "training never started"
             time.sleep(0.1)
@@ -97,7 +97,7 @@ def test_crashed_worker_reenters_under_old_identity(tmp_path):
         num_epoch = 60
         for h in ("w0", "w1", "w2"):
             procs[h] = _spawn(sched.port, h, outs[h], num_epoch)
-        deadline = time.time() + 120
+        deadline = time.time() + 300  # 1-core box: 3x jax-import under load
         while sched._last_completed_epoch < 2:
             assert time.time() < deadline, "training never started"
             time.sleep(0.1)
