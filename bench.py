@@ -41,22 +41,30 @@ BASELINE_IMGS_PER_SEC = 20.08  # reference ResNet-152 1-GPU img/s, batch 32
 
 
 def _emit_failure(err):
-    # attach the round's wedge evidence: the watchdog retries the
-    # preflight all round (tools/bench_watchdog.sh) — its attempt count
-    # and window document that the zero is an environment outage, not an
-    # unexercised bench
+    # attach the round's outage evidence: the UN-KILLED probe loop
+    # (tools/tpu_probe.py, round-5 strategy) logs every attempt's start
+    # and clean failure — the attempt count and window document that a
+    # zero is an environment outage, not an unexercised bench (and,
+    # unlike round 4's kill-based watchdog, cannot itself re-wedge the
+    # tunnel)
     extra = {}
     try:
         log = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "bench_watchdog.err")
+                           "tpu_probe.log")
         with open(log) as f:
-            lines = [ln for ln in f if "preflight attempt" in ln]
-        if lines:
-            extra["watchdog_preflight_attempts"] = len(lines)
-            def ts(ln):  # "[watchdog HH:MM:SS] ..." -> "HH:MM:SS"
-                return ln.split("]")[0][len("[watchdog "):]
-            extra["watchdog_first_attempt"] = ts(lines[0])
-            extra["watchdog_last_attempt"] = ts(lines[-1])
+            lines = f.readlines()
+        starts = [ln for ln in lines if "start pid=" in ln]
+        fails = [ln for ln in lines
+                 if "Unable to initialize backend" in ln]
+        if starts:
+            def ts(ln):  # "[probe HH:MM:SS] ..." -> "HH:MM:SS"
+                return ln.split("]")[0][len("[probe "):]
+            extra["probe_attempts"] = len(starts)
+            extra["probe_clean_failures"] = len(fails)
+            extra["probe_first_attempt"] = ts(starts[0])
+            extra["probe_last_attempt"] = ts(starts[-1])
+            if fails:
+                extra["probe_last_error"] = fails[-1].strip()[-160:]
     except OSError:
         pass
     print(json.dumps({
