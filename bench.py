@@ -229,10 +229,17 @@ def main():
     completed = {}
     line = None
     for net in tiers:
-        if net == "transformer_lm":
-            result = measure_tier_lm()
-        else:
-            result = measure_tier(net, batch, size)
+        try:
+            if net == "transformer_lm":
+                result = measure_tier_lm()
+            else:
+                result = measure_tier(net, batch, size)
+        except Exception as e:  # noqa: BLE001 - a failing tier must not
+            # abort the ladder before the HEADLINE tier (resnet152, the
+            # BASELINE row) gets its chance
+            print(f"# tier {net} FAILED: {e!r}", file=sys.stderr,
+                  flush=True)
+            continue
         completed[net] = result
         head = next((completed[n] for n in priority if n in completed),
                     result)

@@ -94,15 +94,26 @@ class DataPlane:
         """A (re)registering worker starts a fresh push sequence — purge
         its stale retry-dedup entries so its first request after a restart
         isn't swallowed by an old (host, seq) key (a swallowed async_push
-        would silently drop a gradient and hand back pre-crash weights)."""
+        would silently drop a gradient and hand back pre-crash weights).
+        Its staleness basis resets too: it re-bases on the LIVE weights
+        via async_init, so counting its downtime's updates as lag would
+        fabricate a phantom max_staleness."""
         with self._async_lock:
             self._async_live.add(host)
             for key in [k for k in self._async_served if k[0] == host]:
                 del self._async_served[key]
+            for key in [k for k in self._async_last_seen
+                        if k[0] == host]:
+                del self._async_last_seen[key]
 
     def hosts_removed(self, hosts: Set[str]) -> None:
         with self._async_lock:
             self._async_live -= set(hosts)
+            # departed hosts' staleness bases would otherwise leak one
+            # entry per (host, key) forever on a churning cluster
+            for key in [k for k in self._async_last_seen
+                        if k[0] in hosts]:
+                del self._async_last_seen[key]
 
     def complete_with(self, live: Set[str], ordered=None) -> None:
         """After membership shrank, finish any allreduce round now

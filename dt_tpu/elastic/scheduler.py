@@ -279,17 +279,32 @@ class Scheduler:
     def _register(self, host: str, is_new: bool,
                   is_recovery: bool = False) -> dict:
         with self._cv:
+            if host in self._removed_hosts and not is_recovery:
+                # sender-validation drop of removed hosts
+                # (van.cc:571-574)
+                return {"error": "host was removed from the job"}
+            if is_recovery and host in self._workers:
+                # QUICK restart: the old incarnation crashed but hasn't
+                # been evicted yet.  Its process is gone, so treat this
+                # exactly like an eviction (drop from the live set,
+                # finish survivor-satisfied collectives) and fall through
+                # to the pending-recovery queue — otherwise the restarted
+                # worker would park at the barrier while survivors wait
+                # forever on the dead incarnation's contributions.
+                self._workers.remove(host)
+                self._registered.discard(host)
+                self._base.discard(host)
+                self._removed_hosts.add(host)
+                self._dp.hosts_removed({host})
+                self._append_log("REMOVED", host)
+                self._complete_pending_locked()
             if host in self._removed_hosts:
-                if not is_recovery:
-                    # sender-validation drop of removed hosts
-                    # (van.cc:571-574)
-                    return {"error": "host was removed from the job"}
                 # identity reissue (van.cc:187-218 is_recovery=true): a
-                # crashed-then-evicted worker restarts under its OLD id.
-                # Queue it for re-admission at the next membership
-                # barrier — NOT mid-epoch: collectives in flight must
-                # keep their contributor set — and let it bootstrap from
-                # the snapshot meanwhile.  Its dedup caches are purged
+                # crashed worker restarts under its OLD id.  Queue it for
+                # re-admission at the next membership barrier — NOT
+                # mid-epoch: collectives in flight must keep their
+                # contributor set — and let it bootstrap from the
+                # snapshot meanwhile.  Its dedup caches are purged
                 # (fresh sequences after restart).
                 self._pending_recovery.add(host)
                 self._registered.add(host)

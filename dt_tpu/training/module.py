@@ -512,6 +512,19 @@ class Module:
         rng = jax.random.PRNGKey(self.seed + 17)
         num_workers = self.kv.num_workers
 
+        def membership_sig():
+            # the reshard trigger compares the member LIST + own rank,
+            # not the count: a mid-epoch eviction followed by a recovery
+            # admission at the next barrier leaves the count unchanged
+            # while ranks shift (r5 review finding) — a count comparison
+            # would skip the rebuild and double-/un-process data shards
+            ctrl = self.kv._controller
+            if ctrl is not None:
+                return (tuple(ctrl.workers), ctrl.rank)
+            return (self.kv.num_workers, self.kv.rank)
+
+        members = membership_sig()
+
         # --- dist_async: master weights live on the scheduler ---
         is_async = self.kv.type == "dist_async"
         if is_async:
@@ -553,10 +566,11 @@ class Module:
                     logger.info("Epoch[%d] this worker was removed from the "
                                 "job; stopping", epoch)
                     return eval_metric
-                if self.kv.num_workers != num_workers:
+                if membership_sig() != members:
                     logger.info(
-                        "Epoch[%d] membership changed: %d -> %d workers",
-                        epoch, num_workers, self.kv.num_workers)
+                        "Epoch[%d] membership changed: %s -> %s",
+                        epoch, members, membership_sig())
+                    members = membership_sig()
                     num_workers = self.kv.num_workers
                     if self.mesh_manager is not None:
                         # rebuild the distributed world + mesh, reshard the

@@ -148,3 +148,71 @@ def test_crashed_worker_reenters_under_old_identity(tmp_path):
         for p in list(procs.values()) + ([restarted] if restarted else []):
             if p.poll() is None:
                 p.kill()
+
+
+def test_quick_restart_recovery_before_eviction(tmp_path):
+    """A worker that crashes and restarts with DT_RECOVERY=1 BEFORE the
+    eviction window expires must still take the recovery path: the dead
+    incarnation is dropped from the live set immediately (survivors'
+    pending collectives complete), and the restarted one re-enters at
+    the next barrier as itself (r5 review finding: the quick restart
+    previously re-registered via the normal path and silently trained
+    fresh params from epoch 0)."""
+    import threading
+
+    import numpy as np
+
+    from dt_tpu.elastic import WorkerClient
+
+    hw = str(tmp_path / "host_worker")
+    _write_hosts(hw, ["a", "b"])
+    sched = Scheduler(host_worker_file=hw)  # NO auto-eviction
+    ca = cb2 = None
+    try:
+        ca = WorkerClient("127.0.0.1", sched.port, host="a",
+                          heartbeat_interval_s=0.2)
+        cb = WorkerClient("127.0.0.1", sched.port, host="b",
+                          heartbeat_interval_s=0.2)
+        cb.close()  # b "crashes" (stops heartbeating; not evicted yet)
+
+        # a parks in a round that expects b
+        res = {}
+
+        def ar():
+            res["v"] = ca.allreduce("g", np.ones(4, np.float32))
+
+        t = threading.Thread(target=ar)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()  # genuinely waiting on the dead incarnation
+
+        # quick restart under the old identity
+        cb2 = WorkerClient("127.0.0.1", sched.port, host="b",
+                           is_recovery=True, heartbeat_interval_s=0.2)
+        assert cb2.recovery_pending and cb2.rank == -1
+        # the dead incarnation was dropped: a's round completes solo
+        t.join(30)
+        assert not t.is_alive()
+        np.testing.assert_allclose(res["v"], np.ones(4))
+
+        # re-admission at the next barrier, in lockstep
+        rejoin = {}
+
+        def wait():
+            rejoin["epoch"] = cb2.wait_rejoin()
+
+        t2 = threading.Thread(target=wait)
+        t2.start()
+        ca.membership_change_barrier({"EPOCH_BEGIN": 0})
+        t2.join(30)
+        assert not t2.is_alive()
+        assert rejoin["epoch"] == 0
+        assert sorted(ca.workers) == ["a", "b"]
+        assert cb2.rank >= 0 and not cb2.recovery_pending
+        log = open(hw + "_log").read()
+        assert "REMOVED b" in log and "RECOVERED b" in log
+    finally:
+        for c in (ca, cb2):
+            if c is not None:
+                c.close()
+        sched.close()

@@ -227,7 +227,7 @@ def test_train_stochastic_depth_smoke():
 
 
 def test_train_svm_smoke():
-    _run("train_svm.py", "--epochs", "8")
+    _run("train_svm.py", timeout=420)
 
 
 def test_cnn_visualization_smoke():
@@ -236,7 +236,7 @@ def test_cnn_visualization_smoke():
 
 
 def test_train_dsd_smoke():
-    _run("train_dsd.py", "--epochs-per-phase", "4", timeout=420)
+    _run("train_dsd.py", timeout=420)
 
 
 def test_train_rbm_smoke():
