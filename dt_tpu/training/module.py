@@ -519,8 +519,11 @@ class Module:
             # while ranks shift (r5 review finding) — a count comparison
             # would skip the rebuild and double-/un-process data shards
             ctrl = self.kv._controller
-            if ctrl is not None:
-                return (tuple(ctrl.workers), ctrl.rank)
+            members_list = getattr(ctrl, "workers", None)
+            if members_list is not None:
+                return (tuple(members_list), ctrl.rank)
+            # duck-typed controllers without a member list fall back to
+            # the (count, rank) signal
             return (self.kv.num_workers, self.kv.rank)
 
         members = membership_sig()

@@ -38,6 +38,12 @@ def main():
     ap.add_argument("--tensor-parallel", type=int, default=1,
                     help="shard QKV/MLP weights over an N-way 'model' "
                          "mesh axis (devices must be divisible by N)")
+    ap.add_argument("--pipeline-parallel", type=int, default=0,
+                    help="run the decoder blocks as an N-stage GPipe "
+                         "pipeline over a 'pipe' mesh axis (dp x pp; "
+                         "exclusive with --seq-parallel/--tensor-parallel)")
+    ap.add_argument("--num-micro", type=int, default=4,
+                    help="pipeline microbatches (batch must divide)")
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     args = ap.parse_args()
