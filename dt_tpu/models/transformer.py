@@ -232,6 +232,13 @@ class PipelinedTransformerLM:
             if b % m:
                 raise ValueError(f"batch {b} must divide into "
                                  f"num_micro={m} microbatches")
+            if self.batch_axis:
+                dp = self.mesh.shape.get(self.batch_axis, 1)
+                if (b // m) % dp:
+                    raise ValueError(
+                        f"microbatch size {b // m} (batch {b} / "
+                        f"num_micro {m}) must divide by the "
+                        f"{self.batch_axis!r} axis ({dp} devices)")
             micro = x.reshape((m, b // m) + x.shape[1:])
             from dt_tpu.parallel.pipeline import pipeline_apply
             ys = pipeline_apply(self._stage_fn(), params["stages"], micro,

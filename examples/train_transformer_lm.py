@@ -61,7 +61,24 @@ def main():
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     tp = args.tensor_parallel
-    if tp > 1:
+    pp = args.pipeline_parallel
+    if pp > 1:
+        if tp > 1 or args.seq_parallel:
+            raise SystemExit("--pipeline-parallel is exclusive with "
+                             "--tensor-parallel/--seq-parallel here")
+        n_dev = len(jax.devices())
+        if n_dev % pp:
+            raise SystemExit(f"--pipeline-parallel {pp} does not divide "
+                             f"{n_dev} devices")
+        mesh = mesh_lib.make_mesh(data=n_dev // pp, model=pp,
+                                  axis_names=("data", "pipe"))
+        model = models.PipelinedTransformerLM(
+            vocab_size=args.vocab_size, embed_dim=args.embed_dim,
+            num_layers=args.num_layers, num_heads=args.num_heads,
+            max_len=args.seq_len, num_stages=pp,
+            num_micro=args.num_micro, mesh=mesh, batch_axis="data",
+            dtype=dtype)
+    elif tp > 1:
         n_dev = len(jax.devices())
         if n_dev % tp:
             raise SystemExit(f"--tensor-parallel {tp} does not divide "
@@ -69,16 +86,24 @@ def main():
         mesh = mesh_lib.make_mesh(data=n_dev // tp, model=tp)
     else:
         mesh = mesh_lib.make_mesh() if args.seq_parallel else None
-    model = models.TransformerLM(
-        vocab_size=args.vocab_size, embed_dim=args.embed_dim,
-        num_layers=args.num_layers, num_heads=args.num_heads,
-        max_len=args.seq_len, seq_parallel=args.seq_parallel, mesh=mesh,
-        axis_name="model" if tp > 1 else "data",
-        dtype=dtype)
+    if pp <= 1:
+        model = models.TransformerLM(
+            vocab_size=args.vocab_size, embed_dim=args.embed_dim,
+            num_layers=args.num_layers, num_heads=args.num_heads,
+            max_len=args.seq_len, seq_parallel=args.seq_parallel,
+            mesh=mesh, axis_name="model" if tp > 1 else "data",
+            dtype=dtype)
 
     rng = np.random.RandomState(0)
     toks = jnp.asarray(rng.randint(0, args.vocab_size,
                                    (args.batch_size, args.seq_len)))
+    if pp > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        dp = mesh.shape["data"]
+        if args.batch_size % dp or (args.batch_size % args.num_micro):
+            raise SystemExit(f"--batch-size {args.batch_size} must divide "
+                             f"by the data axis ({dp}) and --num-micro")
+        toks = jax.device_put(toks, NamedSharding(mesh, P("data", None)))
     if tp > 1:
         # Megatron + SP layout: batch data-parallel over 'data', weights
         # + sequence over 'model' — without this the data-axis replicas
@@ -130,8 +155,9 @@ def main():
     jax.block_until_ready(loss)
     dt = time.time() - t0
     tok_s = args.steps * args.batch_size * args.seq_len / dt
-    logging.info("seq_parallel=%s tp=%d loss %.3f | %.0f tokens/sec",
-                 args.seq_parallel, tp, float(loss), tok_s)
+    logging.info("seq_parallel=%s tp=%d pp=%d loss %.3f | %.0f tokens/sec",
+                 args.seq_parallel, tp, pp, float(loss), tok_s)
+    assert jnp.isfinite(loss), loss
 
 
 if __name__ == "__main__":
