@@ -241,3 +241,7 @@ def test_train_dsd_smoke():
 
 def test_train_rbm_smoke():
     _run("train_rbm.py", "--epochs", "12")
+
+
+def test_train_capsnet_smoke():
+    _run("train_capsnet.py", "--epochs", "12", timeout=420)
