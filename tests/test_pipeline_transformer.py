@@ -8,6 +8,7 @@ unchanged.  Reference capability: ``example/model-parallel/`` manual
 """
 
 import jax
+import jax.flatten_util  # noqa: F401
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -115,3 +116,28 @@ def test_pipelined_lm_microbatch_divisibility():
     pvars = model.init({"params": jax.random.PRNGKey(0)}, toks)
     with pytest.raises(ValueError, match="num_micro"):
         model.apply(pvars, toks)
+
+
+def test_pipelined_lm_remat_stages_grad_parity():
+    """remat_stages=True (activation recompute inside each pipeline
+    stage) must not change values or gradients."""
+    mesh = mesh_lib.make_mesh(data=1, model=2,
+                              axis_names=("data", "pipe"))
+    toks = _toks(b=4)
+    m0 = _mk(mesh)
+    m1 = models.PipelinedTransformerLM(
+        vocab_size=V, embed_dim=D, num_layers=L, num_heads=H, max_len=S,
+        num_stages=2, num_micro=2, mesh=mesh, remat_stages=True)
+    pvars = m0.init({"params": jax.random.PRNGKey(0)}, toks)
+
+    def loss(model, p):
+        logits = model.apply({"params": p}, toks)
+        return _lm_loss(logits[:, :-1], np.roll(np.asarray(toks), -1, 1)[:, :-1])
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(m0, p))(pvars["params"])
+    l1, g1 = jax.value_and_grad(lambda p: loss(m1, p))(pvars["params"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    f0, _ = jax.flatten_util.ravel_pytree(g0)
+    f1, _ = jax.flatten_util.ravel_pytree(g1)
+    np.testing.assert_allclose(np.asarray(f0), np.asarray(f1), rtol=1e-5,
+                               atol=1e-6)
