@@ -1,0 +1,62 @@
+"""Python half of the C predict ABI.
+
+Reference: ``src/c_api/c_predict_api.cc`` — a C surface
+(``MXPredCreate``/``MXPredSetInput``/``MXPredForward``/...) wrapping the
+full runtime so foreign hosts (C/C++ services, other languages) can
+serve models.  The dt_tpu equivalent keeps the same shape: the C
+library (``dt_tpu/native/predict_capi.cc``) embeds CPython and calls
+THIS module, which drives :class:`dt_tpu.predictor.Predictor` over
+self-contained ONNX artifacts (``dt_tpu.onnx``) — so a plain C host
+gets the bucketed jit serving pipeline, on whatever backend jax has.
+
+Data crosses the boundary as float32 bytes + shape tuples: no numpy
+C-API coupling in the C layer, and the wire is identical to what the
+reference's ``MXPredSetInput`` copied.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+_handles: Dict[int, object] = {}
+_next_id = [1]
+_last_error = [""]
+
+
+def load_onnx(path: str, max_batch: int = 256) -> int:
+    """Create a predictor from an ONNX artifact; handle > 0, or -1
+    (fetch :func:`last_error`)."""
+    try:
+        from dt_tpu.predictor import Predictor
+        p = Predictor.from_onnx(path, max_batch=max_batch)
+        h = _next_id[0]
+        _next_id[0] += 1
+        _handles[h] = p
+        return h
+    except Exception as e:  # noqa: BLE001 - crosses a C ABI
+        _last_error[0] = repr(e)
+        return -1
+
+
+def forward(h: int, data: bytes, shape: Tuple[int, ...]
+            ) -> Tuple[bytes, Tuple[int, ...]]:
+    """Run one batch: float32 bytes + shape in, float32 bytes + shape
+    out.  Empty bytes signal an error (fetch :func:`last_error`)."""
+    try:
+        p = _handles[h]
+        x = np.frombuffer(data, np.float32).reshape(shape)
+        y = np.asarray(p.predict(x), np.float32)
+        return y.tobytes(), tuple(int(s) for s in y.shape)
+    except Exception as e:  # noqa: BLE001 - crosses a C ABI
+        _last_error[0] = repr(e)
+        return b"", ()
+
+
+def last_error() -> str:
+    return _last_error[0]
+
+
+def free(h: int) -> None:
+    _handles.pop(h, None)

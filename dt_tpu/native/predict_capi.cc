@@ -1,0 +1,163 @@
+// C predict ABI — the reference's c_predict_api.cc role for dt_tpu.
+//
+// Reference: src/c_api/c_predict_api.cc (MXPredCreate / MXPredSetInput /
+// MXPredForward / MXPredGetOutput / MXPredFree): a plain-C surface over
+// the full runtime so foreign hosts can serve models.  Here the "full
+// runtime" is jax under CPython, so this library EMBEDS the interpreter
+// (initialized lazily, shared if the host already runs Python) and
+// drives dt_tpu.capi_bridge, which serves self-contained ONNX artifacts
+// through the bucketed jit Predictor.  All Python touches run under
+// PyGILState_Ensure, so the ABI is callable from any host thread.
+//
+// Surface:
+//   int  dt_predict_load_onnx(const char* path);          // handle>0 / -1
+//   int  dt_predict_forward(int h,
+//            const float* data, const long long* shape, int ndim,
+//            float* out, long long out_capacity,           // floats
+//            long long* out_shape, int* out_ndim);         // 0 ok / -1
+//   const char* dt_predict_last_error(void);
+//   void dt_predict_free(int h);
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_error;
+PyObject* g_bridge = nullptr;  // dt_tpu.capi_bridge, owned
+bool g_we_initialized = false;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      g_error = c != nullptr ? c : "<unprintable python error>";
+      Py_DECREF(s);
+    }
+  } else {
+    g_error = "<unknown python error>";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+// ensure the interpreter + bridge module; returns the GIL state the
+// caller must release.  nullptr bridge => error (g_error set).
+PyGILState_STATE ensure(bool* ok) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    // release the GIL the init call acquired; per-call code re-takes it
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  if (g_bridge == nullptr) {
+    g_bridge = PyImport_ImportModule("dt_tpu.capi_bridge");
+    if (g_bridge == nullptr) {
+      set_error_from_python();
+    }
+  }
+  *ok = g_bridge != nullptr;
+  return st;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* dt_predict_last_error(void) { return g_error.c_str(); }
+
+int dt_predict_load_onnx(const char* path) {
+  bool ok = false;
+  PyGILState_STATE st = ensure(&ok);
+  int handle = -1;
+  if (ok) {
+    PyObject* r = PyObject_CallMethod(g_bridge, "load_onnx", "s", path);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      handle = static_cast<int>(PyLong_AsLong(r));
+      Py_DECREF(r);
+      if (handle < 0) {
+        PyObject* e = PyObject_CallMethod(g_bridge, "last_error", nullptr);
+        if (e != nullptr) {
+          const char* c = PyUnicode_AsUTF8(e);
+          g_error = c != nullptr ? c : "";
+          Py_DECREF(e);
+        }
+      }
+    }
+  }
+  PyGILState_Release(st);
+  return handle;
+}
+
+int dt_predict_forward(int h, const float* data, const long long* shape,
+                       int ndim, float* out, long long out_capacity,
+                       long long* out_shape, int* out_ndim) {
+  bool ok = false;
+  PyGILState_STATE st = ensure(&ok);
+  int rc = -1;
+  if (ok) {
+    long long n = 1;
+    PyObject* pyshape = PyTuple_New(ndim);
+    for (int i = 0; i < ndim; ++i) {
+      n *= shape[i];
+      PyTuple_SET_ITEM(pyshape, i, PyLong_FromLongLong(shape[i]));
+    }
+    PyObject* r = PyObject_CallMethod(
+        g_bridge, "forward", "iy#O", h,
+        reinterpret_cast<const char*>(data),
+        static_cast<Py_ssize_t>(n * sizeof(float)), pyshape);
+    Py_DECREF(pyshape);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      PyObject* bytes = PyTuple_GetItem(r, 0);       // borrowed
+      PyObject* oshape = PyTuple_GetItem(r, 1);      // borrowed
+      Py_ssize_t nbytes = PyBytes_Size(bytes);
+      if (nbytes == 0) {
+        PyObject* e = PyObject_CallMethod(g_bridge, "last_error", nullptr);
+        if (e != nullptr) {
+          const char* c = PyUnicode_AsUTF8(e);
+          g_error = c != nullptr ? c : "";
+          Py_DECREF(e);
+        }
+      } else if (nbytes > out_capacity * static_cast<long long>(
+                     sizeof(float))) {
+        g_error = "output buffer too small";
+      } else {
+        std::memcpy(out, PyBytes_AsString(bytes),
+                    static_cast<size_t>(nbytes));
+        int on = static_cast<int>(PyTuple_Size(oshape));
+        *out_ndim = on;
+        for (int i = 0; i < on; ++i) {
+          out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(oshape, i));
+        }
+        rc = 0;
+      }
+      Py_DECREF(r);
+    }
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+void dt_predict_free(int h) {
+  bool ok = false;
+  PyGILState_STATE st = ensure(&ok);
+  if (ok) {
+    PyObject* r = PyObject_CallMethod(g_bridge, "free", "i", h);
+    Py_XDECREF(r);
+  }
+  PyGILState_Release(st);
+}
+
+}  // extern "C"

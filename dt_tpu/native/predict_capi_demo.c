@@ -1,0 +1,64 @@
+/* Pure-C host driving the dt_tpu C predict ABI — the role the
+ * reference's image-classification/predict-cpp demo played over
+ * c_predict_api.cc.  Usage:
+ *   predict_capi_demo <model.onnx> <d0> <d1> ... (input shape)
+ * Fills the input with a deterministic ramp, runs one forward, prints
+ * "OUT <shape...>" then every output float (one per line) — the test
+ * parses and compares against the in-Python predictor. */
+#include <stdio.h>
+#include <stdlib.h>
+
+extern int dt_predict_load_onnx(const char* path);
+extern int dt_predict_forward(int h, const float* data,
+                              const long long* shape, int ndim,
+                              float* out, long long out_capacity,
+                              long long* out_shape, int* out_ndim);
+extern const char* dt_predict_last_error(void);
+extern void dt_predict_free(int h);
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s model.onnx d0 [d1 ...]\n", argv[0]);
+    return 2;
+  }
+  int ndim = argc - 2;
+  long long shape[8];
+  long long n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    shape[i] = atoll(argv[2 + i]);
+    n *= shape[i];
+  }
+  float* input = (float*)malloc((size_t)n * sizeof(float));
+  for (long long i = 0; i < n; ++i) {
+    input[i] = (float)(i % 17) / 17.0f - 0.5f; /* deterministic ramp */
+  }
+
+  int h = dt_predict_load_onnx(argv[1]);
+  if (h < 0) {
+    fprintf(stderr, "load failed: %s\n", dt_predict_last_error());
+    return 1;
+  }
+  long long out_cap = 1 << 20;
+  float* out = (float*)malloc((size_t)out_cap * sizeof(float));
+  long long out_shape[8];
+  int out_ndim = 0;
+  if (dt_predict_forward(h, input, shape, ndim, out, out_cap, out_shape,
+                         &out_ndim) != 0) {
+    fprintf(stderr, "forward failed: %s\n", dt_predict_last_error());
+    return 1;
+  }
+  printf("OUT");
+  long long total = 1;
+  for (int i = 0; i < out_ndim; ++i) {
+    printf(" %lld", out_shape[i]);
+    total *= out_shape[i];
+  }
+  printf("\n");
+  for (long long i = 0; i < total; ++i) {
+    printf("%.6f\n", (double)out[i]);
+  }
+  dt_predict_free(h);
+  free(out);
+  free(input);
+  return 0;
+}
