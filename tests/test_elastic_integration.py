@@ -186,3 +186,67 @@ def test_elastic_accuracy_matches_static(tmp_path):
     deltas = [abs(ec[e] - sc[e]) for e in tail]
     assert max(deltas) <= 0.005 + 1e-9, (deltas, sc, ec)
     assert sum(deltas) / len(deltas) <= 0.002 + 1e-9, (deltas, sc, ec)
+
+
+def test_elastic_add_remove_cycle_over_sharded_plane(tmp_path):
+    """The full scripted add/remove cycle with the host-sync gradient
+    plane routed across a 2-server RangeServer fleet: exact sync, joiner
+    bootstrap, and the audit trail all hold when the funnel is sharded
+    (and the joiner discovers the fleet at registration mid-job)."""
+    from dt_tpu.elastic import RangeServer
+
+    hw = str(tmp_path / "host_worker")
+    _write_hosts(hw, ["w0", "w1"])
+    outs = {h: str(tmp_path / f"{h}.json") for h in ("w0", "w1", "w2")}
+    procs = {}
+    num_epoch = 6
+
+    def launch_new_worker(host, epoch):
+        procs[host] = _spawn(
+            sched.port, host, outs[host], num_epoch,
+            extra_env={"NEW_WORKER": "1", "EPOCH_BEGIN": str(epoch)})
+
+    def operator(epoch):
+        if epoch == 2:
+            _write_hosts(hw, ["w0", "w1", "w2"])
+        elif epoch == 4:
+            _write_hosts(hw, ["w0", "w1"])
+
+    sched = Scheduler(host_worker_file=hw,
+                      launch_callback=launch_new_worker,
+                      pre_change_hook=operator)
+    servers = [RangeServer("127.0.0.1", sched.port, i,
+                           advertise_host="127.0.0.1")
+               for i in range(2)]
+    try:
+        procs["w0"] = _spawn(sched.port, "w0", outs["w0"], num_epoch)
+        procs["w1"] = _spawn(sched.port, "w1", outs["w1"], num_epoch)
+        for h in ("w0", "w1"):
+            rc = procs[h].wait(timeout=240)
+            assert rc == 0, f"{h} rc={rc}:\n" \
+                f"{procs[h].stdout.read().decode()[-3000:]}"
+        assert "w2" in procs, "scheduler never launched w2"
+        rc = procs["w2"].wait(timeout=60)
+        assert rc == 0, f"w2 rc={rc}:\n" \
+            f"{procs['w2'].stdout.read().decode()[-3000:]}"
+
+        r0 = json.load(open(outs["w0"]))
+        r1 = json.load(open(outs["w1"]))
+        r2 = json.load(open(outs["w2"]))
+        del procs["w2"]
+        assert r0["final_step"] == r1["final_step"]
+        assert r0["param_hash"] == pytest.approx(r1["param_hash"],
+                                                 abs=1e-12)
+        assert r2["bootstrap_step"] is not None and \
+            r2["bootstrap_step"] > 0
+        # gradients really rode the fleet: both servers served rounds
+        with servers[0]._stats_lock, servers[1]._stats_lock:
+            reqs = [servers[0]._rounds, servers[1]._rounds]
+        assert all(r > 0 for r in reqs), reqs
+    finally:
+        sched.close()
+        for s in servers:
+            s.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
