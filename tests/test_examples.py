@@ -245,3 +245,11 @@ def test_train_rbm_smoke():
 
 def test_train_capsnet_smoke():
     _run("train_capsnet.py", "--epochs", "12", timeout=420)
+
+
+def test_train_ner_smoke():
+    _run("train_ner.py", timeout=420)
+
+
+def test_train_timeseries_smoke():
+    _run("train_timeseries.py", "--epochs", "8")
