@@ -253,3 +253,7 @@ def test_train_ner_smoke():
 
 def test_train_timeseries_smoke():
     _run("train_timeseries.py", "--epochs", "8")
+
+
+def test_train_rl_smoke():
+    _run("train_rl.py", timeout=420)
