@@ -287,3 +287,26 @@ def test_kvstore_dist_async_over_sharded_plane():
         np.testing.assert_allclose(new, w0 - 0.2)
     finally:
         _close(sched, servers, clients)
+
+
+def test_async_stats_aggregates_across_fleet():
+    """client.async_stats() merges per-server staleness: max over the
+    fleet, push-weighted mean (each server measures its own slice)."""
+    sched, servers, clients = _mk(n_workers=2, n_servers=2)
+    try:
+        c0, c1 = clients
+        c0.set_optimizer({"name": "sgd", "learning_rate": 0.1})
+        w = np.zeros(8, np.float32)
+        c0.async_init("w", w)
+        c1.async_init("w", w)
+        g = np.ones(8, np.float32)
+        c0.async_push("w", g)   # first pushes unmeasured
+        c1.async_push("w", g)
+        c1.async_push("w", g)   # lag 0
+        c0.async_push("w", g)   # lag 2 (both slices agree)
+        st = c0.async_stats()
+        assert st["max_staleness"] == 2, st
+        assert st["measured_pushes"] == 4, st  # 2 measured pushes x 2 slices
+        assert st["mean_staleness"] == pytest.approx(1.0), st
+    finally:
+        _close(sched, servers, clients)
