@@ -26,3 +26,8 @@ end).  Semantics kept verbatim:
 from dt_tpu.elastic.scheduler import Scheduler as Scheduler
 from dt_tpu.elastic.client import WorkerClient as WorkerClient
 from dt_tpu.elastic.range_server import RangeServer as RangeServer
+
+# r5: the data plane can shard across a RangeServer fleet (the
+# reference's key ranges, kvstore_dist.h:547-589 — launcher -s N), and a
+# crashed worker re-enters under its old identity via DT_RECOVERY=1
+# (van.cc:187-218 is_recovery; WorkerClient.wait_rejoin).
