@@ -41,17 +41,18 @@ def load_onnx(path: str, max_batch: int = 256) -> int:
 
 
 def forward(h: int, data: bytes, shape: Tuple[int, ...]
-            ) -> Tuple[bytes, Tuple[int, ...]]:
-    """Run one batch: float32 bytes + shape in, float32 bytes + shape
-    out.  Empty bytes signal an error (fetch :func:`last_error`)."""
+            ) -> Tuple[bool, bytes, Tuple[int, ...]]:
+    """Run one batch: float32 bytes + shape in, ``(ok, bytes, shape)``
+    out — an explicit ok flag, because empty bytes is also the
+    legitimate encoding of a zero-element output."""
     try:
         p = _handles[h]
         x = np.frombuffer(data, np.float32).reshape(shape)
         y = np.asarray(p.predict(x), np.float32)
-        return y.tobytes(), tuple(int(s) for s in y.shape)
+        return True, y.tobytes(), tuple(int(s) for s in y.shape)
     except Exception as e:  # noqa: BLE001 - crosses a C ABI
         _last_error[0] = repr(e)
-        return b"", ()
+        return False, b"", ()
 
 
 def last_error() -> str:

@@ -22,13 +22,17 @@
 #include <Python.h>
 
 #include <cstring>
+#include <mutex>
 #include <string>
 
 namespace {
 
-std::string g_error;
-PyObject* g_bridge = nullptr;  // dt_tpu.capi_bridge, owned
+// per-thread last error: the returned c_str() stays valid for the
+// calling thread regardless of other threads' failures
+thread_local std::string g_error;
+PyObject* g_bridge = nullptr;  // dt_tpu.capi_bridge, owned (GIL-guarded)
 bool g_we_initialized = false;
+std::mutex g_init_mutex;  // first-call interpreter init must not race
 
 void set_error_from_python() {
   PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
@@ -51,11 +55,14 @@ void set_error_from_python() {
 // ensure the interpreter + bridge module; returns the GIL state the
 // caller must release.  nullptr bridge => error (g_error set).
 PyGILState_STATE ensure(bool* ok) {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    g_we_initialized = true;
-    // release the GIL the init call acquired; per-call code re-takes it
-    PyEval_SaveThread();
+  {
+    std::lock_guard<std::mutex> lock(g_init_mutex);
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+      // release the GIL the init call acquired; per-call code re-takes it
+      PyEval_SaveThread();
+    }
   }
   PyGILState_STATE st = PyGILState_Ensure();
   if (g_bridge == nullptr) {
@@ -120,10 +127,11 @@ int dt_predict_forward(int h, const float* data, const long long* shape,
     if (r == nullptr) {
       set_error_from_python();
     } else {
-      PyObject* bytes = PyTuple_GetItem(r, 0);       // borrowed
-      PyObject* oshape = PyTuple_GetItem(r, 1);      // borrowed
+      PyObject* okflag = PyTuple_GetItem(r, 0);      // borrowed
+      PyObject* bytes = PyTuple_GetItem(r, 1);       // borrowed
+      PyObject* oshape = PyTuple_GetItem(r, 2);      // borrowed
       Py_ssize_t nbytes = PyBytes_Size(bytes);
-      if (nbytes == 0) {
+      if (PyObject_IsTrue(okflag) != 1) {
         PyObject* e = PyObject_CallMethod(g_bridge, "last_error", nullptr);
         if (e != nullptr) {
           const char* c = PyUnicode_AsUTF8(e);

@@ -6,7 +6,7 @@ networks (a3c.py: env.step on CPU, asynchronous gradient workers).  The
 TPU-first design inverts that: the ENVIRONMENT ITSELF is pure jax
 (CartPole dynamics as a handful of jnp ops), so thousands of envs
 vectorize under ``vmap`` and the whole actor-learner loop — env steps,
-policy/value forward, GAE, and the A2C update — compiles into ONE
+policy/value forward, n-step returns, and the A2C update — compiles into ONE
 ``lax.scan`` step with zero host<->device transfers (the "Anakin"
 architecture; the reference's async CPU workers exist only to hide env
 latency that simply isn't there any more).
@@ -118,12 +118,12 @@ def main():
         _, last_v = net(p, last_states)
 
         def disc(carry, xs):
-            r, d, v = xs
+            r, d = xs
             ret = r + args.gamma * carry * (1.0 - d)
             return ret, ret
 
         _, returns = lax.scan(
-            disc, last_v, (rewards, dones.astype(jnp.float32), values),
+            disc, last_v, (rewards, dones.astype(jnp.float32)),
             reverse=True)
         adv = lax.stop_gradient(returns - values)
         logp = jax.nn.log_softmax(logits)

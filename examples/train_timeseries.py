@@ -84,10 +84,11 @@ def main():
         "out_w": jax.random.normal(ks[2], (G, NV)) * 0.1,
         "out_b": jnp.zeros((NV,)),
         # autoregressive highway (lstnet.py 'ar' component): linear map
-        # of the last ar_window raw values per channel
-        "ar_w": jax.random.normal(ks[3], (8,)) * 0.1,
+        # of the last AR raw values per channel
+        "ar_w": jax.random.normal(ks[3], (min(8, W),)) * 0.1,
         "ar_b": jnp.zeros(()),
     }
+    AR = min(8, W)
 
     def forecast(p, x):                       # x (B, W, V)
         h = jax.lax.conv_general_dilated(
@@ -97,7 +98,7 @@ def main():
         outs, _ = rnn.gru(h.transpose(1, 0, 2),
                           jnp.zeros((1, x.shape[0], G)), p["gru"])
         nn_part = outs[-1] @ p["out_w"] + p["out_b"]   # (B, V)
-        ar = jnp.einsum("bwv,w->bv", x[:, -8:, :], p["ar_w"]) + p["ar_b"]
+        ar = jnp.einsum("bwv,w->bv", x[:, -AR:, :], p["ar_w"]) + p["ar_b"]
         return nn_part + ar
 
     def loss_fn(p, x, y):
