@@ -68,6 +68,7 @@ class WorkerClient:
             tuple(s) for s in resp.get("servers", [])]
         self._key_rows: Dict[str, int] = {}  # key -> total rows (sharding)
         self._ar_seq: Dict[str, int] = {}
+        self._pool = None  # lazy persistent pool for fleet fan-outs
         self._announce_to_servers()
         # profiler sync starts AT the current command seq: a joiner must
         # not replay a long-finished profiling session's command history
@@ -302,23 +303,20 @@ class WorkerClient:
             # never split again, so pathological chunk sizes below the
             # itemsize terminate instead of recursing on "#c0" forever
             if value.size > per:
-                from concurrent.futures import ThreadPoolExecutor
                 flat = value.ravel()
-                window = max(1, int(os.environ.get(
-                    "DT_AR_WINDOW", str(max(4, 2 * nsrv)))))
                 base = zlib.crc32(key.encode())
-                # a small in-flight window pipelines the per-chunk rounds
-                # (hides RTT + straggler skew) while keeping per-server
-                # memory at O(workers x chunk x window); connections are
+                # the persistent pool bounds the in-flight window (hides
+                # RTT + straggler skew while keeping per-server memory at
+                # O(workers x chunk x window)); connections are
                 # per-request, so concurrent _req calls are safe
-                with ThreadPoolExecutor(max_workers=window) as pool:
-                    futs = [
-                        pool.submit(self.allreduce, f"{key}#c{i}",
-                                    flat[start:start + per],
-                                    (base + i) if nsrv else None)
-                        for i, start in enumerate(
-                            range(0, flat.size, per))]
-                    parts = [f.result() for f in futs]
+                pool = self._fanout_pool()
+                futs = [
+                    pool.submit(self.allreduce, f"{key}#c{i}",
+                                flat[start:start + per],
+                                (base + i) if nsrv else None)
+                    for i, start in enumerate(
+                        range(0, flat.size, per))]
+                parts = [f.result() for f in futs]
                 return np.concatenate(parts).reshape(value.shape)
         seq = self._ar_seq.get(key, 0)
         self._ar_seq[key] = seq + 1
@@ -350,7 +348,6 @@ class WorkerClient:
             # server map; each server merges its range concurrently and
             # every worker contributes to EVERY server each round (empty
             # partitions included) so rounds complete
-            from concurrent.futures import ThreadPoolExecutor
             ids, vals, bounds, part = self._partition_rows(
                 rs.num_rows, rs.indices, rs.values)
 
@@ -365,8 +362,7 @@ class WorkerClient:
                      "value": {"ids": ids[sel], "vals": vals[sel],
                                "num_rows": rs.num_rows}})["value"]
 
-            with ThreadPoolExecutor(max_workers=nsrv) as pool:
-                outs = list(pool.map(one, range(nsrv)))
+            outs = list(self._fanout_pool().map(one, range(nsrv)))
             for o in outs:
                 if isinstance(o, dict) and "__error__" in o:
                     raise RuntimeError(
@@ -417,13 +413,25 @@ class WorkerClient:
         for addr in self.servers:
             self._req_addr(addr, {"cmd": "set_optimizer", "spec": spec})
 
+    def _fanout_pool(self):
+        """Persistent executor for fleet fan-outs and chunk windows
+        (creating a pool per round-trip costs more than the loopback RTT
+        it hides).  Tasks never submit back into the pool — routed
+        chunks and per-server rounds are direct requests — so sharing
+        one pool cannot deadlock."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(4, 2 * max(len(self.servers), 1),
+                                int(os.environ.get("DT_AR_WINDOW", "0"))))
+        return self._pool
+
     def _async_fanout(self, fn):
         """Run ``fn(j, addr)`` per range server concurrently; ordered
         results."""
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=len(self.servers)) as pool:
-            return list(pool.map(lambda j: fn(j, self.servers[j]),
-                                 range(len(self.servers))))
+        pool = self._fanout_pool()
+        return list(pool.map(lambda j: fn(j, self.servers[j]),
+                             range(len(self.servers))))
 
     def async_init(self, key: str, value) -> np.ndarray:
         """Init-or-get the master weights: the first writer seeds them,
@@ -573,6 +581,9 @@ class WorkerClient:
 
     def close(self):
         self._stop.set()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
 
 
 def auto_client(**kwargs) -> Optional[WorkerClient]:
