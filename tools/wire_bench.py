@@ -78,7 +78,7 @@ def run_config(n_workers, mb, iters, compress, n_servers):
     # wait for the fleet to register; a partial fleet would give workers
     # inconsistent server views (disjoint chunk routes → deadlocked
     # rounds), so raise rather than fall through
-    deadline = time.time() + 30
+    deadline = time.time() + 120
     while len(sched._server_list()) < n_servers:
         if time.time() > deadline:
             raise RuntimeError(
