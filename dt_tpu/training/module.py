@@ -672,6 +672,16 @@ class Module:
             # --- epoch end: publish snapshot (store_aux_params analog,
             # base_module.py:601-605) ---
             self._publish_snapshot()
+            if is_async and self.kv.rank == 0:
+                try:
+                    st = self.kv.staleness_stats()
+                    logger.info(
+                        "Epoch[%d] dist_async staleness: max %d mean "
+                        "%.2f over %d pushes", epoch,
+                        st["max_staleness"], st["mean_staleness"],
+                        st["measured_pushes"])
+                except (RuntimeError, OSError, KeyError):
+                    pass  # stats are observability, never fatal
 
             if epoch_end_callback is not None:
                 for cb in epoch_end_callback:
