@@ -42,7 +42,7 @@ def test_sigkill_worker_is_evicted_and_job_completes(tmp_path):
     hw = str(tmp_path / "host_worker")
     _write_hosts(hw, ["w0", "w1", "w2"])
     outs = {h: str(tmp_path / f"{h}.json") for h in ("w0", "w1", "w2")}
-    sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=2.0)
+    sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=6.0)
     procs = {}
     try:
         num_epoch = 40  # long enough that the kill lands mid-run
@@ -90,7 +90,7 @@ def test_crashed_worker_reenters_under_old_identity(tmp_path):
     _write_hosts(hw, ["w0", "w1", "w2"])
     outs = {h: str(tmp_path / f"{h}.json") for h in ("w0", "w1", "w2")}
     go_file = str(tmp_path / "go_recover")
-    sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=2.0)
+    sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=6.0)
     procs = {}
     restarted = None
     try:
@@ -192,7 +192,7 @@ def test_quick_restart_recovery_before_eviction(tmp_path):
                            is_recovery=True, heartbeat_interval_s=0.2)
         assert cb2.recovery_pending and cb2.rank == -1
         # the dead incarnation was dropped: a's round completes solo
-        t.join(30)
+        t.join(120)
         assert not t.is_alive()
         np.testing.assert_allclose(res["v"], np.ones(4))
 
@@ -204,8 +204,16 @@ def test_quick_restart_recovery_before_eviction(tmp_path):
 
         t2 = threading.Thread(target=wait)
         t2.start()
+        # the recovering host must ARRIVE at the barrier before the
+        # survivor releases it, or its re-admission defers to a next
+        # barrier this test never runs (re-admission only applies to
+        # pending hosts present in _barrier_arrived — by design)
+        deadline = time.time() + 60
+        while "b" not in sched._barrier_arrived:
+            assert time.time() < deadline, "recovery barrier never arrived"
+            time.sleep(0.05)
         ca.membership_change_barrier({"EPOCH_BEGIN": 0})
-        t2.join(30)
+        t2.join(120)
         assert not t2.is_alive()
         assert rejoin["epoch"] == 0
         assert sorted(ca.workers) == ["a", "b"]
