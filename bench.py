@@ -230,6 +230,7 @@ def main():
                 "resnet18", "transformer_lm"]
     completed = {}
     line = None
+    last_err = None
     for net in tiers:
         try:
             if net == "transformer_lm":
@@ -239,6 +240,7 @@ def main():
         except Exception as e:  # noqa: BLE001 - a failing tier must not
             # abort the ladder before the HEADLINE tier (resnet152, the
             # BASELINE row) gets its chance
+            last_err = e
             print(f"# tier {net} FAILED: {e!r}", file=sys.stderr,
                   flush=True)
             continue
@@ -275,7 +277,15 @@ def main():
                     {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **result})
                     + "\n")
         print(f"# tier {net} done: {line}", file=sys.stderr, flush=True)
+    if line is None:
+        # EVERY tier failed: a bare "None" on stdout with rc 0 would read
+        # as a bogus result to direct --run callers (the extra-tier calls
+        # in tools/bench_watchdog.sh) — emit the failure JSON and a
+        # non-zero rc so the empty ladder is unmistakable
+        _emit_failure(f"all tiers failed; last: {last_err!r}")
+        return 1
     print(line)
+    return 0
 
 
 # per-img fwd GFLOP (train step ~ 3x fwd) + the image size that figure

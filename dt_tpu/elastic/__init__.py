@@ -23,11 +23,21 @@ end).  Semantics kept verbatim:
   108-126``)
 """
 
+from dt_tpu.elastic import faults as faults
 from dt_tpu.elastic.scheduler import Scheduler as Scheduler
 from dt_tpu.elastic.client import WorkerClient as WorkerClient
 from dt_tpu.elastic.range_server import RangeServer as RangeServer
+from dt_tpu.elastic.faults import (FaultPlan as FaultPlan,
+                                   FaultRule as FaultRule,
+                                   CrashInjected as CrashInjected)
 
 # r5: the data plane can shard across a RangeServer fleet (the
 # reference's key ranges, kvstore_dist.h:547-589 — launcher -s N), and a
 # crashed worker re-enters under its old identity via DT_RECOVERY=1
 # (van.cc:187-218 is_recovery; WorkerClient.wait_rejoin).
+# r6: failure is a first-class testable input — elastic/faults.py is a
+# seeded deterministic fault-injection layer (drop/dup/delay/reorder/
+# reset/partition/crash-at-hook, DT_FAULT_PLAN env for subprocess
+# workers) threaded through protocol.request's at-least-once reliable
+# mode (retry/backoff/deadline + idempotency tokens); replay the chaos
+# demo with tools/chaos_run.py.

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dt_tpu.elastic import protocol
+from dt_tpu.elastic import faults, protocol
 
 logger = logging.getLogger("dt_tpu.elastic")
 
@@ -54,6 +54,7 @@ class WorkerClient:
             # a restarted worker re-entering under its old identity
             # (van.cc:187-218 is_recovery); set by the restart wrapper
             is_recovery = os.environ.get("DT_RECOVERY", "") in ("1", "true")
+        faults.crash_point("client.register", host=self.host)
         resp = self._req({"cmd": "register", "host": self.host,
                           "is_new": is_new, "is_recovery": is_recovery})
         self.rank: int = resp["rank"]
@@ -87,20 +88,14 @@ class WorkerClient:
     def _req_addr(self, addr, msg: dict, timeout: float = 600.0,
                   retries: int = 8) -> dict:
         """Request with at-least-once retry — the Resender role
-        (``ps-lite/src/resender.h``).  Safe because the server-side
-        fault-injection drop happens before dispatch, and every handler
-        is idempotent for re-sent requests."""
-        delay = 0.2
-        for attempt in range(retries):
-            try:
-                resp = protocol.request(addr[0], addr[1], msg,
-                                        timeout=timeout)
-                break
-            except (ConnectionError, socket.timeout, OSError):
-                if attempt == retries - 1:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 5.0)
+        (``ps-lite/src/resender.h``), now carried by
+        :func:`protocol.request`'s reliable mode: every re-send reuses
+        the SAME idempotency token, so a replay whose first dispatch
+        completed is served the cached response (the per-command
+        (host, seq) dedup covers the data plane).  ``retries`` is the
+        total attempt count, matching the historical signature."""
+        resp = protocol.request(addr[0], addr[1], msg, timeout=timeout,
+                                retries=max(retries - 1, 0))
         if "error" in resp:
             raise RuntimeError(f"scheduler error: {resp['error']}")
         return resp
@@ -160,6 +155,10 @@ class WorkerClient:
     def _heartbeat_loop(self, interval: float):
         while not self._stop.is_set():
             try:
+                faults.crash_point("client.heartbeat", host=self.host)
+            except faults.CrashInjected:
+                return  # injected heartbeat death: the thread just stops
+            try:
                 resp = self._req({"cmd": "heartbeat", "host": self.host,
                                   "pseq": self._prof_seq}, timeout=10)
                 for c in resp.get("profile_cmds", []):
@@ -210,6 +209,9 @@ class WorkerClient:
 
     def membership_change_barrier(self, info: Dict) -> None:
         epoch = int(info.get("EPOCH_BEGIN", 0))
+        # the epoch-boundary window: a crash HERE (before the scheduler
+        # sees our arrival) is the quick-restart re-admission race's trigger
+        faults.crash_point("client.mc_barrier", host=self.host, epoch=epoch)
         resp = self._req({"cmd": "mc_barrier", "host": self.host,
                           "epoch": epoch, "info": info})
         if resp.get("you_are_removed"):

@@ -1,0 +1,348 @@
+"""Deterministic fault injection for the elastic control plane.
+
+The reference's only transport fuzz was ``PS_DROP_MSG`` (``van.cc:430-431,
+563-570``): a receive-side percentage drop.  This module generalizes it into
+a seeded, reproducible fault *plan* threaded through the control-plane
+transport (``protocol.request`` send side, the Scheduler/RangeServer
+receive side) and explicit crash hooks in the client, scheduler, and
+``Module.fit`` — so every failure mode the heartbeat/dead-node machinery
+exists for (``van.cc:686-698``, ``postoffice.cc:410-429``) can be *caused*
+on demand, deterministically, in a unit test or a chaos run.
+
+Fault kinds
+-----------
+
+- ``drop``       the message never arrives (client side: raise
+  ``ConnectionError`` before sending; server side: read and discard) —
+  the client's at-least-once retry must recover.
+- ``dup``        the request is sent twice with the SAME idempotency token
+  and sequence numbers; the receiver's dedup layers must make the replay
+  a no-op (``ps-lite/src/resender.h`` ACK-dedup role).
+- ``delay``      sleep ``delay_s`` before the message proceeds.
+- ``reorder``    the first matching message is parked until the NEXT
+  matching message has passed (or ``delay_s`` elapses) — a true overtake,
+  not just a delay.
+- ``reset``      the connection dies AFTER the request was delivered but
+  BEFORE the response is read — the most dangerous replay window: the
+  server acted, the client retries, and only idempotency prevents a
+  double apply.
+- ``partition``  drop, scoped by host — a host that cannot reach the
+  scheduler for a bounded window (``times`` matching messages).
+- ``crash``      at a named hook *site* (see below): raise
+  :class:`CrashInjected` (in-process tests) or ``os._exit(137)``
+  (subprocess workers — indistinguishable from SIGKILL to the rest of
+  the job).
+
+Crash sites currently instrumented:
+
+- ``client.register``    — before the registration request
+- ``client.mc_barrier``  — before sending the membership barrier (the
+  epoch-boundary window the quick-restart re-admission race lives in)
+- ``client.heartbeat``   — kills the heartbeat thread only
+- ``sched.register``     — scheduler dies mid-registration
+- ``sched.barrier_arrived`` — scheduler dies after recording an arrival
+- ``module.epoch_begin`` — worker dies exactly at an epoch boundary
+  (rule ``epoch=`` pins which one)
+
+Determinism
+-----------
+
+Every probabilistic rule draws from a private stream seeded by
+``(plan.seed, rule_index, host)`` — concurrency between hosts cannot
+interleave a host's draws, so as long as each host's matching traffic is
+issued sequentially (true for ``WorkerClient``: one caller thread per
+host), two runs of the same plan+seed apply the same faults to the same
+messages.  ``applied_summary()`` exposes the per-rule-per-host applied
+counts for tests to assert that.
+
+Wiring
+------
+
+In process: ``faults.install(FaultPlan([...], seed=0))`` / ``clear()``.
+Subprocess workers: set ``DT_FAULT_PLAN`` to the plan JSON (or
+``@/path/to/plan.json``) — loaded lazily on first transport use; the
+launcher's env forwarding (``DT_*`` prefix) carries it to ssh workers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+KINDS = ("drop", "dup", "delay", "reorder", "reset", "partition", "crash")
+OPS = ("send", "recv")
+
+
+class CrashInjected(RuntimeError):
+    """An injected crash (rule ``action="raise"``).  Test code treats the
+    raising thread's worker as dead — the in-process analog of the
+    subprocess ``os._exit(137)``."""
+
+
+class FaultRule:
+    """One fault rule; see the module docstring for kind semantics.
+
+    ``cmd``/``host`` scope the rule (string or sequence; None = any);
+    ``prob`` gates each match through the rule's seeded stream;
+    ``after`` lets the first N matches through untouched; ``times`` caps
+    total applications per host; ``epoch`` pins ``crash`` rules to one
+    ``module.epoch_begin`` epoch; ``action`` is ``raise`` or ``exit``.
+    """
+
+    def __init__(self, kind: str, op: str = "send",
+                 cmd: Union[str, Sequence[str], None] = None,
+                 host: Union[str, Sequence[str], None] = None,
+                 site: Optional[str] = None, prob: float = 1.0,
+                 times: Optional[int] = None, after: int = 0,
+                 delay_s: float = 0.05, epoch: Optional[int] = None,
+                 action: str = "raise"):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        if op not in OPS:
+            raise ValueError(f"unknown fault op {op!r}")
+        if action not in ("raise", "exit"):
+            raise ValueError(f"unknown crash action {action!r}")
+        if kind == "crash" and not site:
+            raise ValueError("crash rules need a site=")
+        self.kind = kind
+        self.op = op
+        self.cmd = (cmd,) if isinstance(cmd, str) else \
+            tuple(cmd) if cmd else None
+        self.host = (host,) if isinstance(host, str) else \
+            tuple(host) if host else None
+        self.site = site
+        self.prob = float(prob)
+        self.times = times
+        self.after = int(after)
+        self.delay_s = float(delay_s)
+        self.epoch = epoch
+        self.action = action
+
+    def matches(self, op: str, cmd: Optional[str],
+                host: Optional[str]) -> bool:
+        if self.kind == "crash" or self.op != op:
+            return False
+        if self.cmd is not None and cmd not in self.cmd:
+            return False
+        if self.host is not None and host not in self.host:
+            return False
+        return True
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"kind": self.kind, "op": self.op}
+        if self.cmd is not None:
+            d["cmd"] = list(self.cmd)
+        if self.host is not None:
+            d["host"] = list(self.host)
+        if self.site is not None:
+            d["site"] = self.site
+        if self.prob != 1.0:
+            d["prob"] = self.prob
+        if self.times is not None:
+            d["times"] = self.times
+        if self.after:
+            d["after"] = self.after
+        if self.delay_s != 0.05:
+            d["delay_s"] = self.delay_s
+        if self.epoch is not None:
+            d["epoch"] = self.epoch
+        if self.action != "raise":
+            d["action"] = self.action
+        return d
+
+
+class FaultPlan:
+    """An ordered rule list + the seed its probabilistic streams derive
+    from.  Thread-safe; one instance serves a whole process."""
+
+    def __init__(self, rules: Sequence[Union[FaultRule, dict]],
+                 seed: int = 0):
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = [
+            r if isinstance(r, FaultRule) else FaultRule(**r)
+            for r in rules]
+        self._lock = threading.Lock()
+        self._matched: Dict[Tuple[int, str], int] = {}
+        self._applied: Dict[Tuple[int, str], int] = {}
+        self._rngs: Dict[Tuple[int, str], random.Random] = {}
+        # reorder: rule index -> the Event the parked first message waits on
+        self._reorder: Dict[int, Optional[threading.Event]] = {}
+
+    # -- deterministic per-(rule, host) streams ---------------------------
+
+    def _stream(self, idx: int, host: str) -> random.Random:
+        key = (idx, host)
+        rng = self._rngs.get(key)
+        if rng is None:
+            # crc32, not hash(): PYTHONHASHSEED must not change the plan
+            rng = random.Random(
+                zlib.crc32(f"{self.seed}|{idx}|{host}".encode()))
+            self._rngs[key] = rng
+        return rng
+
+    def _fire(self, idx: int, rule: FaultRule, host: Optional[str]) -> bool:
+        """Count a static match; True when the rule applies this time."""
+        h = host or ""
+        with self._lock:
+            key = (idx, h)
+            n = self._matched.get(key, 0) + 1
+            self._matched[key] = n
+            if n <= rule.after:
+                return False
+            a = self._applied.get(key, 0)
+            if rule.times is not None and a >= rule.times:
+                return False
+            if rule.prob < 1.0 and \
+                    self._stream(idx, h).random() >= rule.prob:
+                return False
+            self._applied[key] = a + 1
+            return True
+
+    # -- transport hooks --------------------------------------------------
+
+    def on_send(self, cmd: Optional[str],
+                host: Optional[str]) -> Optional[str]:
+        """Client-outbound hook (one request attempt).  Sleeps for
+        delay/reorder kinds; returns ``None`` or one of
+        ``"drop" | "reset" | "dup"`` for the transport to act on."""
+        out = None
+        for idx, r in enumerate(self.rules):
+            if not r.matches("send", cmd, host) or \
+                    not self._fire(idx, r, host):
+                continue
+            if r.kind == "delay":
+                time.sleep(r.delay_s)
+            elif r.kind == "reorder":
+                self._reorder_gate(idx, r)
+            elif r.kind in ("drop", "partition"):
+                return "drop"
+            elif r.kind == "reset":
+                return "reset"
+            elif r.kind == "dup" and out is None:
+                out = "dup"
+        return out
+
+    def on_recv(self, cmd: Optional[str], host: Optional[str]) -> bool:
+        """Server-inbound hook; False means drop (no response — the
+        client sees a closed connection and retries)."""
+        for idx, r in enumerate(self.rules):
+            if not r.matches("recv", cmd, host) or \
+                    not self._fire(idx, r, host):
+                continue
+            if r.kind == "delay":
+                time.sleep(r.delay_s)
+            elif r.kind == "reorder":
+                self._reorder_gate(idx, r)
+            elif r.kind in ("drop", "partition", "reset"):
+                return False
+        return True
+
+    def _reorder_gate(self, idx: int, rule: FaultRule) -> None:
+        """First matching message parks until the next one passes (true
+        overtake); ``delay_s`` caps the hold so a lone message cannot
+        park forever."""
+        with self._lock:
+            ev = self._reorder.get(idx)
+            if ev is None:
+                ev = threading.Event()
+                self._reorder[idx] = ev
+                wait = ev
+            else:
+                ev.set()
+                self._reorder[idx] = None
+                wait = None
+        if wait is not None:
+            wait.wait(timeout=max(rule.delay_s, 0.05))
+            with self._lock:
+                if self._reorder.get(idx) is wait:
+                    self._reorder[idx] = None
+
+    # -- crash hooks ------------------------------------------------------
+
+    def crash(self, site: str, host: Optional[str] = None,
+              **ctx: Any) -> None:
+        for idx, r in enumerate(self.rules):
+            if r.kind != "crash" or r.site != site:
+                continue
+            if r.host is not None and host not in r.host:
+                continue
+            if r.epoch is not None and ctx.get("epoch") != r.epoch:
+                continue
+            if not self._fire(idx, r, host):
+                continue
+            if r.action == "exit":
+                os._exit(137)  # SIGKILL-equivalent: no cleanup, no goodbye
+            raise CrashInjected(
+                f"fault injection: crash at {site} (host={host}, {ctx})")
+
+    # -- introspection / serialization ------------------------------------
+
+    def applied_summary(self) -> List[Tuple[int, str, int]]:
+        """Sorted (rule_index, host, applied_count) — the deterministic
+        record tests compare across runs of the same seed."""
+        with self._lock:
+            return sorted((i, h, n) for (i, h), n in self._applied.items())
+
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "rules": [r.to_dict() for r in self.rules]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls(d.get("rules", []), seed=d.get("seed", 0))
+
+
+# ---------------------------------------------------------------------------
+# process-global plan registry
+# ---------------------------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+_ENV_LOCK = threading.Lock()
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` as this process's active plan (tests)."""
+    global _PLAN, _ENV_CHECKED
+    _PLAN = plan
+    _ENV_CHECKED = True  # an explicit install overrides the env
+    return plan
+
+
+def clear() -> None:
+    global _PLAN, _ENV_CHECKED
+    _PLAN = None
+    _ENV_CHECKED = False
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, else one lazily loaded from ``DT_FAULT_PLAN``
+    (inline JSON, or ``@/path`` to a JSON file) — how subprocess workers
+    pick up the chaos harness's plan."""
+    global _PLAN, _ENV_CHECKED
+    if _PLAN is not None or _ENV_CHECKED:
+        return _PLAN
+    with _ENV_LOCK:
+        if _ENV_CHECKED:
+            return _PLAN
+        spec = os.environ.get("DT_FAULT_PLAN")
+        if spec:
+            text = open(spec[1:]).read() if spec.startswith("@") else spec
+            _PLAN = FaultPlan.from_json(text)
+        _ENV_CHECKED = True
+    return _PLAN
+
+
+def crash_point(site: str, host: Optional[str] = None, **ctx: Any) -> None:
+    """Named crash hook; a no-op unless an active plan has a matching
+    crash rule.  Call sites are the instrumentation points listed in the
+    module docstring."""
+    plan = active_plan()
+    if plan is not None:
+        plan.crash(site, host=host, **ctx)

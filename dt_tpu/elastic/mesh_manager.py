@@ -132,19 +132,29 @@ class MeshManager:
                 # drop the local client/service WITHOUT the coordinator
                 # round-trip (client.shutdown() handshakes with the dead
                 # rank 0 and blocks); jax.distributed.initialize refuses
-                # to run twice unless this state is cleared
-                from jax._src import distributed as _jdist
-                st = _jdist.global_state
-                if st.preemption_sync_manager is not None:
-                    st.preemption_sync_manager = None
-                st.client = None
-                if st.service is not None:
-                    try:
-                        st.service.shutdown()
-                    except Exception:  # best effort: world is dead anyway
-                        pass
-                    st.service = None
-                st.coordinator_address = None
+                # to run twice unless this state is cleared.  The
+                # global_state fields are jax-private and shift across
+                # releases — this path is best-effort by design, so a
+                # layout mismatch degrades to a warning instead of
+                # turning coordinator-loss teardown into an AttributeError
+                try:
+                    from jax._src import distributed as _jdist
+                    st = _jdist.global_state
+                    if st.preemption_sync_manager is not None:
+                        st.preemption_sync_manager = None
+                    st.client = None
+                    if st.service is not None:
+                        try:
+                            st.service.shutdown()
+                        except Exception:  # best effort: world is dead
+                            pass
+                        st.service = None
+                    st.coordinator_address = None
+                except (ImportError, AttributeError) as e:
+                    logger.warning(
+                        "jax._src.distributed.global_state layout changed "
+                        "(%s); skipping best-effort client teardown — "
+                        "re-initialize may require a process restart", e)
             # the XLA client caches the old world's device topology; drop
             # it so the next initialize() builds a client for the NEW world
             # (without this, jax.devices() keeps showing removed hosts'

@@ -40,13 +40,19 @@ fork's ``Control::Command`` additions (``message.h:123``):
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import hmac as _hmac
 import os
 import pickle
 import socket
 import struct
+import threading
+import time
+import uuid
 from typing import Any, Dict, Optional
+
+from dt_tpu.elastic import faults
 
 _LEN = struct.Struct("<Q")
 MAX_MSG = 1 << 33  # snapshots can be GBs in theory; sanity bound
@@ -147,11 +153,105 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def request(host: str, port: int, msg: Dict[str, Any],
-            timeout: float = 120.0) -> Dict[str, Any]:
-    """One-shot request/response (every control message is independent,
-    like ps-lite's per-request Customer tracking)."""
+def _request_once(host: str, port: int, msg: Dict[str, Any],
+                  timeout: float, reset: bool = False) -> Dict[str, Any]:
     with socket.create_connection((host, port), timeout=timeout) as s:
         s.settimeout(timeout)
         send_msg(s, msg)
+        if reset:
+            # injected fault: the request was DELIVERED but the
+            # connection dies before the response — the replay window
+            # only idempotency closes
+            raise ConnectionResetError(
+                "fault injection: connection reset after send")
         return recv_msg(s)
+
+
+def request(host: str, port: int, msg: Dict[str, Any],
+            timeout: float = 120.0, retries: int = 0,
+            backoff_s: float = 0.2, backoff_max_s: float = 5.0,
+            deadline_s: Optional[float] = None) -> Dict[str, Any]:
+    """Request/response.  With the defaults this is the historical
+    one-shot call (every control message is independent, like ps-lite's
+    per-request Customer tracking).
+
+    ``retries`` > 0 (extra attempts) or ``deadline_s`` (overall wall
+    budget; with ``retries=0`` it means retry-until-deadline) turn it
+    into an at-least-once reliable call — the ``ps-lite/src/resender.h``
+    role: exponential backoff between attempts, and every re-send
+    carries the SAME ``token`` (idempotency key) so a receiver that
+    already served the request answers from its token cache instead of
+    dispatching a replay.  Combined with the per-command sequence dedup
+    in the data plane this makes duplicated/replayed control messages
+    safe.
+
+    Fault injection (:mod:`dt_tpu.elastic.faults`) hooks each attempt:
+    drops/resets surface as the connection errors the retry loop already
+    handles, so an installed plan exercises exactly this machinery.
+    """
+    reliable = retries > 0 or deadline_s is not None
+    if reliable and isinstance(msg, dict) and "token" not in msg:
+        msg = dict(msg)
+        msg["token"] = uuid.uuid4().hex
+    if deadline_s is not None and retries == 0:
+        retries = 1 << 30  # deadline is the budget, not the attempt count
+    cmd = msg.get("cmd") if isinstance(msg, dict) else None
+    src = msg.get("host") if isinstance(msg, dict) else None
+    deadline = (time.monotonic() + deadline_s) \
+        if deadline_s is not None else None
+    delay = backoff_s
+    attempt = 0
+    while True:
+        try:
+            fault = None
+            plan = faults.active_plan()
+            if plan is not None:
+                fault = plan.on_send(cmd, src)
+                if fault == "drop":
+                    raise ConnectionError(
+                        f"fault injection: dropped {cmd!r} from {src!r}")
+            step_timeout = timeout
+            if deadline is not None:
+                step_timeout = min(
+                    timeout, max(deadline - time.monotonic(), 0.001))
+            resp = _request_once(host, port, msg, step_timeout,
+                                 reset=(fault == "reset"))
+            if fault == "dup":
+                try:  # replay the identical request; discard the answer
+                    _request_once(host, port, msg, step_timeout)
+                except OSError:
+                    pass
+            return resp
+        except (ConnectionError, socket.timeout, OSError):
+            attempt += 1
+            past_deadline = deadline is not None and \
+                time.monotonic() + delay >= deadline
+            if attempt > retries or past_deadline:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, backoff_max_s)
+
+
+class TokenCache:
+    """Bounded response cache keyed by request idempotency token — the
+    receiver side of :func:`request`'s at-least-once contract.  A re-sent
+    request whose first dispatch completed is served the SAME response
+    instead of being dispatched again (commands with their own
+    seq-dedup or read-only semantics are exempted by the servers)."""
+
+    def __init__(self, cap: int = 512):
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._cache: "collections.OrderedDict[str, Dict[str, Any]]" = \
+            collections.OrderedDict()
+
+    def get(self, token: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._cache.get(token)
+
+    def put(self, token: str, resp: Dict[str, Any]) -> None:
+        with self._lock:
+            self._cache[token] = resp
+            self._cache.move_to_end(token)
+            while len(self._cache) > self._cap:
+                self._cache.popitem(last=False)

@@ -34,11 +34,17 @@ import threading
 import time
 from typing import List, Optional, Set
 
-from dt_tpu.elastic import protocol
+from dt_tpu.elastic import faults, protocol
 from dt_tpu.elastic.dataplane import DataPlane
 
 logger = logging.getLogger("dt_tpu.elastic")
 _drop_rng = random.Random(0x5EED)  # deterministic fault injection
+
+#: responses never token-cached (read-only / own (host, seq) dedup);
+#: mirrors the scheduler's exemption list
+_TOKEN_EXEMPT = frozenset({"allreduce", "async_init", "async_push",
+                           "async_pull_rows", "async_stats", "ping",
+                           "stats"})
 
 
 class RangeServer:
@@ -64,6 +70,7 @@ class RangeServer:
         self._bytes_in = 0
         self._rounds = 0
         self._stats_lock = threading.Lock()
+        self._tokens = protocol.TokenCache()
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -148,7 +155,20 @@ class RangeServer:
                 if drop and _drop_rng.random() * 100 < float(drop):
                     logger.debug("DT_DROP_MSG: dropping %s", msg.get("cmd"))
                     return
+                plan = faults.active_plan()
+                if plan is not None and \
+                        not plan.on_recv(msg.get("cmd"), msg.get("host")):
+                    return
+                token = msg.get("token")
+                if token is not None:
+                    cached = self._tokens.get(token)
+                    if cached is not None:
+                        protocol.send_msg(conn, cached)
+                        return
                 resp = self._dispatch(msg)
+                if token is not None and "error" not in resp and \
+                        msg.get("cmd") not in _TOKEN_EXEMPT:
+                    self._tokens.put(token, resp)
                 protocol.send_msg(conn, resp)
             except (ConnectionError, OSError):
                 pass

@@ -38,11 +38,20 @@ from typing import Callable, Dict, List, Optional, Set
 
 import numpy as np
 
-from dt_tpu.elastic import protocol
+from dt_tpu.elastic import faults, protocol
 from dt_tpu.elastic.dataplane import DataPlane
 
 logger = logging.getLogger("dt_tpu.elastic")
 _drop_rng = random.Random(0xD207)  # deterministic fault injection
+
+#: commands whose responses are NOT token-cached: read-only, or already
+#: dedup'd by their own (host, seq) machinery — fetch_snapshot blobs would
+#: dominate the cache's memory, and high-rate heartbeats would churn the
+#: bounded cache out of the very tokens the dedup exists to protect
+_TOKEN_EXEMPT = frozenset({"fetch_snapshot", "allreduce", "async_init",
+                           "async_push", "async_pull_rows", "async_stats",
+                           "heartbeat", "num_dead", "membership",
+                           "servers"})
 
 
 class Scheduler:
@@ -127,6 +136,8 @@ class Scheduler:
         self._profile_cmds: List[dict] = []
         self._profile_seq = 0
         self._profile_posted: Dict[tuple, int] = {}  # retry dedup
+        # idempotency-token response cache (protocol.request reliable mode)
+        self._tokens = protocol.TokenCache()
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -176,11 +187,29 @@ class Scheduler:
                 # Fault injection: DT_DROP_MSG=<percent> drops received
                 # requests BEFORE dispatch (the ps-lite PS_DROP_MSG
                 # transport fuzz, van.cc:430-431,563-570); clients retry.
+                # A FaultPlan (elastic/faults.py) generalizes this with
+                # seeded drop/delay/reorder/partition rules.
                 drop = os.environ.get("DT_DROP_MSG")
                 if drop and _drop_rng.random() * 100 < float(drop):
                     logger.debug("DT_DROP_MSG: dropping %s", msg.get("cmd"))
                     return
+                plan = faults.active_plan()
+                if plan is not None and \
+                        not plan.on_recv(msg.get("cmd"), msg.get("host")):
+                    return
+                # idempotency-token dedup (protocol.request reliable
+                # mode): a replay whose first dispatch completed is
+                # served the SAME response instead of re-dispatching
+                token = msg.get("token")
+                if token is not None:
+                    cached = self._tokens.get(token)
+                    if cached is not None:
+                        protocol.send_msg(conn, cached)
+                        return
                 resp = self._dispatch(msg)
+                if token is not None and "error" not in resp and \
+                        msg.get("cmd") not in _TOKEN_EXEMPT:
+                    self._tokens.put(token, resp)
                 protocol.send_msg(conn, resp)
             except (ConnectionError, OSError):
                 pass
@@ -278,6 +307,7 @@ class Scheduler:
 
     def _register(self, host: str, is_new: bool,
                   is_recovery: bool = False) -> dict:
+        faults.crash_point("sched.register", host=host)
         with self._cv:
             if host in self._removed_hosts and not is_recovery:
                 # sender-validation drop of removed hosts
@@ -287,16 +317,32 @@ class Scheduler:
                 # QUICK restart: the old incarnation crashed but hasn't
                 # been evicted yet.  Its process is gone, so treat this
                 # exactly like an eviction (drop from the live set,
-                # finish survivor-satisfied collectives) and fall through
-                # to the pending-recovery queue — otherwise the restarted
-                # worker would park at the barrier while survivors wait
-                # forever on the dead incarnation's contributions.
+                # rewrite host_worker, finish survivor-satisfied
+                # collectives) and fall through to the pending-recovery
+                # queue — otherwise the restarted worker would park at
+                # the barrier while survivors wait forever on the dead
+                # incarnation's contributions.  The host joins
+                # _pending_recovery BEFORE _complete_pending_locked and
+                # host_worker is rewritten like the auto-evict path
+                # (r5 advisor): a parked barrier firing during THIS
+                # registration must not re-ADD the host via the normal
+                # diff — that would hand the restarted worker a normal
+                # rank with begin_epoch=0 (epoch desync) and, in elastic
+                # mode, spawn a duplicate process under its identity.
                 self._workers.remove(host)
                 self._registered.discard(host)
                 self._base.discard(host)
                 self._removed_hosts.add(host)
+                self._pending_recovery.add(host)
+                # the DEAD incarnation may have arrived at the parked
+                # barrier before crashing; its stale arrival must not
+                # count as the NEW incarnation's (re-admission requires
+                # the restarted worker to arrive itself, or survivors
+                # start the epoch expecting a still-bootstrapping host)
+                self._barrier_arrived.discard(host)
                 self._dp.hosts_removed({host})
                 self._append_log("REMOVED", host)
+                self._rewrite_host_file([host])
                 self._complete_pending_locked()
             if host in self._removed_hosts:
                 # identity reissue (van.cc:187-218 is_recovery=true): a
@@ -473,6 +519,8 @@ class Scheduler:
             if self._barrier_epoch is None:
                 self._barrier_epoch = epoch
             self._barrier_arrived.add(host)
+            faults.crash_point("sched.barrier_arrived", host=host,
+                               epoch=epoch)
 
             if self._barrier_arrived >= set(self._workers):
                 # everyone is here: apply at most one membership change
@@ -553,7 +601,12 @@ class Scheduler:
                 self._recovered_at[h] = epoch
                 self._append_log("RECOVERED", h)
                 self._add_to_host_file(h)
-            to_add = sorted(desired - set(self._workers))
+            # a pending-recovery host must re-enter ONLY through the
+            # recovery loop above (as itself, at a barrier it arrived
+            # at) — never through the plain ADD diff, which would grant
+            # it a fresh-worker rank mid-bootstrap (r5 advisor race)
+            to_add = sorted(desired - set(self._workers)
+                            - self._pending_recovery)
             for h in to_add:
                 if h in self._removed_hosts:
                     self._removed_hosts.discard(h)  # re-adding is allowed

@@ -133,7 +133,11 @@ def _bn_train_fwd_impl(x, gamma, beta, running_mean, running_var,
         interpret=interpret,
     )(x2p)
     mean = jnp.sum(sums, axis=0) / n
-    var = jnp.sum(sumsqs, axis=0) / n - mean * mean
+    # E[x^2] - mean^2 cancels catastrophically in f32 for large-mean /
+    # small-variance channels and can come out slightly NEGATIVE, which
+    # NaNs the rsqrt below (this kernel is the default-on train path).
+    # Clamp to 0: the true variance is >= 0 by definition.
+    var = jnp.maximum(jnp.sum(sumsqs, axis=0) / n - mean * mean, 0.0)
 
     # pass 2: the same fused scale/bias VMEM pass as the eval kernel
     inv = jax.lax.rsqrt(var + eps)

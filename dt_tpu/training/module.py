@@ -517,8 +517,10 @@ class Module:
             # not the count: a mid-epoch eviction followed by a recovery
             # admission at the next barrier leaves the count unchanged
             # while ranks shift (r5 review finding) — a count comparison
-            # would skip the rebuild and double-/un-process data shards
-            ctrl = self.kv._controller
+            # would skip the rebuild and double-/un-process data shards.
+            # getattr, like the recovery block above: a duck-typed
+            # kvstore without _controller must not fail fit() here
+            ctrl = getattr(self.kv, "_controller", None)
             members_list = getattr(ctrl, "workers", None)
             if members_list is not None:
                 return (tuple(members_list), ctrl.rank)
@@ -546,9 +548,19 @@ class Module:
             self.state = self.state.replace(
                 params=self._unravel(jnp.asarray(cur)))
 
+        from dt_tpu.elastic import faults as faults_lib
         for epoch in range(begin_epoch, num_epoch):
+            # chaos-harness hook: a crash rule pinned to this epoch dies
+            # HERE — exactly the epoch-boundary window the quick-restart
+            # recovery path must survive (elastic/faults.py)
+            faults_lib.crash_point(
+                "module.epoch_begin",
+                host=getattr(getattr(self.kv, "_controller", None),
+                             "host", None),
+                epoch=epoch)
             # --- membership-change barrier (base_module.py:540-543) ---
-            if elastic_enabled or self.kv._controller is not None:
+            if elastic_enabled or \
+                    getattr(self.kv, "_controller", None) is not None:
                 from dt_tpu.elastic.client import WorkerRemoved
                 try:
                     self.kv._membership_change_barrier({"EPOCH_BEGIN": epoch})
@@ -623,7 +635,7 @@ class Module:
                         if self._unravel_stats else self.state.batch_stats,
                         step=self.state.step + 1)
                 elif self.sync_mode == "host" and self.kv.num_workers > 1:
-                    if self.kv._controller is None:
+                    if getattr(self.kv, "_controller", None) is None:
                         raise RuntimeError(
                             "sync_mode='host' needs an elastic controller "
                             "(kv.set_controller) to carry the allreduce")
@@ -715,7 +727,7 @@ class Module:
         """Push the live TrainState to the elastic controller — the role the
         parameter-server copy played for joiners (``module.py:552-571``);
         BN aux stats ride along (the >= 10M key space)."""
-        ctrl = self.kv._controller
+        ctrl = getattr(self.kv, "_controller", None)
         # rank 0 publishes (all workers hold identical state under sync;
         # N identical uploads would only load the scheduler)
         if ctrl is not None and hasattr(ctrl, "publish_snapshot") and \

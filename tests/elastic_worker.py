@@ -159,9 +159,11 @@ def main():
     acc = dict(mod.score(data.NDArrayIter(x, y, batch_size=32), "acc"))
     val_acc = dict(mod.score(data.NDArrayIter(vx, vy, batch_size=256),
                              "acc"))
+    ce = dict(mod.score(data.NDArrayIter(vx, vy, batch_size=256), "ce"))
     result = {
         "host": args.host,
         "final_acc": acc["accuracy"],
+        "final_loss": ce["cross-entropy"],
         "final_val_acc": val_acc["accuracy"],
         "acc_curve": acc_curve,
         "final_step": int(mod.state.step),

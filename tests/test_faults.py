@@ -1,0 +1,618 @@
+"""Deterministic fault-injection scenarios for the elastic control plane.
+
+Each scenario installs a seeded :class:`~dt_tpu.elastic.faults.FaultPlan`,
+drives a real Scheduler + WorkerClient(s) over loopback, and asserts BOTH
+the correctness contract (exact values, single registration, ...) and
+determinism: two runs of the same seed apply the same faults and produce
+the same summary.  This is the transport fuzz the reference only gestured
+at with ``PS_DROP_MSG`` (``van.cc:430-431,563-570``), made a first-class
+testable input; the dead-worker scenarios exercise the heartbeat/dead-node
+semantics of ``van.cc:686-698``.
+
+The crash-at-barrier scenario un-dodges the quick-restart re-admission
+race (r5 advisor, ``scheduler.py`` quick-restart branch): pre-fix, a
+recovery registration landing while a survivor is PARKED at the membership
+barrier re-ADDED the host through the normal diff (normal rank,
+begin_epoch=0 desync, duplicate spawn in elastic mode).  The test fails on
+the pre-fix scheduler and passes post-fix.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dt_tpu.elastic import Scheduler, WorkerClient, faults
+from dt_tpu.elastic.faults import CrashInjected, FaultPlan, FaultRule
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("DT_DROP_MSG", raising=False)
+    monkeypatch.delenv("DT_FAULT_PLAN", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _write_hosts(path, hosts):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(hosts) + "\n")
+    os.replace(tmp, path)
+
+
+def _client(port, host):
+    # slow heartbeats: scenario rules are cmd-scoped, but quiet background
+    # traffic keeps the logs readable and the runs fast
+    return WorkerClient("127.0.0.1", port, host=host,
+                        heartbeat_interval_s=30.0)
+
+
+def _run_twice(scenario, tmp_path, seed=17):
+    """The determinism gate: the same seed must apply the same faults and
+    produce the same outcome summary on two independent runs."""
+    first = scenario(tmp_path / "run1", seed)
+    second = scenario(tmp_path / "run2", seed)
+    assert first == second, \
+        f"seed {seed} not deterministic:\n{first}\nvs\n{second}"
+    return first
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: seeded message DROP — retries recover, exactly
+# ---------------------------------------------------------------------------
+
+def test_seeded_drop_is_recovered_and_deterministic(tmp_path):
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["w0", "w1"])
+        plan = faults.install(FaultPlan(
+            [FaultRule("drop", op="send", cmd="allreduce", prob=0.5)],
+            seed=seed))
+        sched = Scheduler(host_worker_file=hw)
+        cs = []
+        try:
+            cs = [_client(sched.port, h) for h in ("w0", "w1")]
+            outs = {h: [] for h in ("w0", "w1")}
+
+            def run(c, base):
+                for i in range(4):
+                    v = c.allreduce("g", np.full(3, base + i, np.float32))
+                    outs[c.host].append(float(v[0]))
+
+            ts = [threading.Thread(target=run, args=(c, (k + 1) * 10.0))
+                  for k, c in enumerate(cs)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in ts)
+            # exact averages each round despite the drops
+            want = [15.0 + i for i in range(4)]
+            assert outs["w0"] == want and outs["w1"] == want
+            applied = plan.applied_summary()
+            assert applied, "seeded drop rule never fired"
+            return (tuple(outs["w0"]), tuple(outs["w1"]), tuple(applied))
+        finally:
+            for c in cs:
+                c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: request DUPLICATION — idempotency keeps updates single-apply
+# ---------------------------------------------------------------------------
+
+def test_duplicated_async_push_applies_once(tmp_path):
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["w0"])
+        plan = faults.install(FaultPlan(
+            [FaultRule("dup", op="send", cmd="async_push")], seed=seed))
+        sched = Scheduler(host_worker_file=hw)
+        c = None
+        try:
+            c = _client(sched.port, "w0")
+            c.set_optimizer({"name": "sgd", "learning_rate": 1.0})
+            w = c.async_init("w", np.zeros(4, np.float32))
+            np.testing.assert_array_equal(w, 0.0)
+            grads = [np.full(4, g, np.float32) for g in (1.0, 2.0, 4.0)]
+            for g in grads:
+                w = c.async_push("w", g)
+            # every push applied EXACTLY once: w = -lr * sum(g) = -7;
+            # a replayed (duplicated) push would double-count
+            np.testing.assert_allclose(w, -7.0)
+            applied = plan.applied_summary()
+            assert sum(n for _, _, n in applied) == len(grads)
+            return (tuple(np.asarray(w).tolist()), tuple(applied))
+        finally:
+            if c is not None:
+                c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scenario 3: DELAY on one host's barrier — completes, measurably late
+# ---------------------------------------------------------------------------
+
+def test_delayed_barrier_still_releases(tmp_path):
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["w0", "w1"])
+        plan = faults.install(FaultPlan(
+            [FaultRule("delay", op="send", cmd="mc_barrier", host="w1",
+                       delay_s=0.4, times=1)], seed=seed))
+        sched = Scheduler(host_worker_file=hw)
+        cs = []
+        try:
+            cs = [_client(sched.port, h) for h in ("w0", "w1")]
+            res = {}
+
+            def bar(c):
+                c.membership_change_barrier({"EPOCH_BEGIN": 0})
+                res[c.host] = (c.rank, tuple(c.workers))
+
+            t0 = time.monotonic()
+            ts = [threading.Thread(target=bar, args=(c,)) for c in cs]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            elapsed = time.monotonic() - t0
+            assert not any(t.is_alive() for t in ts)
+            assert res["w0"] == (0, ("w0", "w1"))
+            assert res["w1"] == (1, ("w0", "w1"))
+            assert elapsed >= 0.35  # w0 waited on w1's delayed arrival
+            return (res["w0"], res["w1"], tuple(plan.applied_summary()))
+        finally:
+            for c in cs:
+                c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: REORDER — barrier arrivals overtake each other, still correct
+# ---------------------------------------------------------------------------
+
+def test_reordered_barrier_arrivals(tmp_path):
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["w0", "w1"])
+        plan = faults.install(FaultPlan(
+            [FaultRule("reorder", op="recv", cmd="mc_barrier",
+                       delay_s=5.0, times=1)], seed=seed))
+        sched = Scheduler(host_worker_file=hw)
+        cs = []
+        try:
+            cs = [_client(sched.port, h) for h in ("w0", "w1")]
+            res = {}
+
+            def bar(c):
+                c.membership_change_barrier({"EPOCH_BEGIN": 0})
+                res[c.host] = (c.rank, tuple(c.workers))
+
+            t0 = time.monotonic()
+            ts = [threading.Thread(target=bar, args=(c,)) for c in cs]
+            ts[0].start()
+            time.sleep(0.1)  # w0's arrival is parked by the reorder gate
+            ts[1].start()
+            for t in ts:
+                t.join(timeout=60)
+            elapsed = time.monotonic() - t0
+            assert not any(t.is_alive() for t in ts)
+            # the overtake happened (gate released by the second message,
+            # NOT by its 5s park timeout) and the barrier stayed correct
+            assert elapsed < 4.0
+            assert res["w0"] == (0, ("w0", "w1"))
+            assert res["w1"] == (1, ("w0", "w1"))
+            return (res["w0"], res["w1"], tuple(plan.applied_summary()))
+        finally:
+            for c in cs:
+                c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scenario 5: host PARTITION — a bounded outage heals through retries
+# ---------------------------------------------------------------------------
+
+def test_partitioned_host_heals(tmp_path):
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["w0", "w1"])
+        plan = faults.install(FaultPlan(
+            [FaultRule("partition", op="recv", cmd="allreduce",
+                       host="w1", times=2)], seed=seed))
+        sched = Scheduler(host_worker_file=hw)
+        cs = []
+        try:
+            cs = [_client(sched.port, h) for h in ("w0", "w1")]
+            outs = {}
+
+            def run(c, v):
+                outs[c.host] = float(
+                    c.allreduce("g", np.full(2, v, np.float32))[0])
+
+            ts = [threading.Thread(target=run, args=(c, (k + 1) * 2.0))
+                  for k, c in enumerate(cs)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in ts)
+            assert outs["w0"] == outs["w1"] == 3.0  # exact (2+4)/2
+            applied = plan.applied_summary()
+            assert applied == [(0, "w1", 2)]  # exactly the outage window
+            return (outs["w0"], outs["w1"], tuple(applied))
+        finally:
+            for c in cs:
+                c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scenario 6: connection RESET after delivery — the replay window
+# ---------------------------------------------------------------------------
+
+def test_reset_after_send_is_replay_safe(tmp_path):
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["w0", "w1"])
+        plan = faults.install(FaultPlan(
+            [FaultRule("reset", op="send", cmd="allreduce",
+                       host="w0", times=1)], seed=seed))
+        sched = Scheduler(host_worker_file=hw)
+        cs = []
+        try:
+            cs = [_client(sched.port, h) for h in ("w0", "w1")]
+            outs = {}
+
+            def run(c, v):
+                outs[c.host] = float(
+                    c.allreduce("g", np.full(2, v, np.float32))[0])
+
+            ts = [threading.Thread(target=run, args=(c, (k + 1) * 1.0))
+                  for k, c in enumerate(cs)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in ts)
+            # w0's request was DELIVERED, then the connection died; the
+            # retry's (host, seq) dedup must not double-count w0 — the
+            # average is exactly (1+2)/2, not (1+1+2)/3
+            assert outs["w0"] == outs["w1"] == 1.5
+            applied = plan.applied_summary()
+            assert applied == [(0, "w0", 1)]
+            return (outs["w0"], outs["w1"], tuple(applied))
+        finally:
+            for c in cs:
+                c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scenario 7: CRASH at the epoch-boundary barrier window + quick restart —
+# the re-admission race (r5 advisor), un-dodged
+# ---------------------------------------------------------------------------
+
+def test_crash_at_barrier_quick_restart_race(tmp_path):
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["a", "b"])
+        plan = faults.install(FaultPlan(
+            [FaultRule("crash", site="client.mc_barrier", host="b",
+                       times=1)], seed=seed))
+        launched = []
+        sched = Scheduler(
+            host_worker_file=hw,
+            launch_callback=lambda h, e: launched.append((h, e)))
+        ca = cb = cb2 = None
+        try:
+            ca = _client(sched.port, "a")
+            cb = _client(sched.port, "b")
+
+            # a parks at the epoch-0 membership barrier...
+            done = {}
+
+            def bar_a():
+                ca.membership_change_barrier({"EPOCH_BEGIN": 0})
+                done["a"] = (ca.rank, tuple(ca.workers))
+
+            ta = threading.Thread(target=bar_a)
+            ta.start()
+            deadline = time.time() + 30
+            while "a" not in sched._barrier_arrived:
+                assert time.time() < deadline, "a never reached the barrier"
+                time.sleep(0.02)
+
+            # ...and b crashes IN the barrier window (before the
+            # scheduler sees its arrival)
+            with pytest.raises(CrashInjected):
+                cb.membership_change_barrier({"EPOCH_BEGIN": 0})
+            cb.close()  # the "process" is gone
+
+            # quick restart under the old identity, while a is STILL
+            # parked: registration must take the recovery path — not be
+            # re-ADDED by the barrier its own eviction releases
+            cb2 = WorkerClient("127.0.0.1", sched.port, host="b",
+                               is_recovery=True, heartbeat_interval_s=30.0)
+            assert cb2.recovery_pending and cb2.rank == -1, \
+                "quick restart bypassed the recovery queue (the race)"
+            assert launched == [], "duplicate process spawned for b"
+
+            # a's barrier released by the eviction, as a 1-worker job
+            ta.join(timeout=60)
+            assert not ta.is_alive()
+            assert done["a"] == (0, ("a",))
+            log = open(hw + "_log").read()
+            assert "REMOVED b" in log
+            assert "ADDED b" not in log, \
+                "b re-entered through the normal ADD path (the race)"
+            # host_worker was rewritten like the auto-evict path
+            assert [ln.strip() for ln in open(hw) if ln.strip()] == ["a"]
+
+            # re-admission at the next barrier, as itself, in lockstep
+            rejoin = {}
+
+            def wait():
+                rejoin["epoch"] = cb2.wait_rejoin()
+
+            t2 = threading.Thread(target=wait)
+            t2.start()
+            deadline = time.time() + 30
+            while "b" not in sched._barrier_arrived:
+                assert time.time() < deadline, "rejoin barrier never arrived"
+                time.sleep(0.02)
+            ca.membership_change_barrier({"EPOCH_BEGIN": 1})
+            t2.join(timeout=60)
+            assert not t2.is_alive()
+            assert rejoin["epoch"] == 1  # resumes the epoch now starting
+            assert sorted(ca.workers) == ["a", "b"]
+            assert cb2.rank >= 0 and not cb2.recovery_pending
+            log = open(hw + "_log").read()
+            assert "RECOVERED b" in log and "ADDED b" not in log
+            # exactly one registration for b post-crash, no spawns
+            assert launched == []
+            hosts = sorted(ln.strip() for ln in open(hw) if ln.strip())
+            assert hosts == ["a", "b"]  # host file repaired on recovery
+            return (done["a"], rejoin["epoch"], tuple(sorted(ca.workers)),
+                    tuple(plan.applied_summary()))
+        finally:
+            for c in (ca, cb2):
+                if c is not None:
+                    c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# scenario 7b: crash AFTER barrier arrival + quick restart — the dead
+# incarnation's stale arrival must not stand in for the new one
+# ---------------------------------------------------------------------------
+
+def test_crash_after_arrival_stale_arrival_not_counted(tmp_path):
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["a", "b", "c"])
+        faults.install(FaultPlan([], seed=seed))  # no transport faults
+        sched = Scheduler(host_worker_file=hw)
+        ca = cb = cc = cb2 = None
+        try:
+            ca, cb, cc = [_client(sched.port, h) for h in ("a", "b", "c")]
+            done = {}
+
+            def bar(c, epoch):
+                c.membership_change_barrier({"EPOCH_BEGIN": epoch})
+                done[c.host] = (c.rank, tuple(c.workers))
+
+            # a AND b arrive at the epoch-0 barrier (c not yet)...
+            ta = threading.Thread(target=bar, args=(ca, 0))
+            tb = threading.Thread(target=bar, args=(cb, 0))
+            ta.start()
+            tb.start()
+            deadline = time.time() + 30
+            while not {"a", "b"} <= sched._barrier_arrived:
+                assert time.time() < deadline, "a/b never reached barrier"
+                time.sleep(0.02)
+            # ...then b dies AFTER arriving, and quick-restarts
+            cb.close()
+            cb2 = WorkerClient("127.0.0.1", sched.port, host="b",
+                               is_recovery=True, heartbeat_interval_s=30.0)
+            assert cb2.recovery_pending and cb2.rank == -1
+            # the dead incarnation's stale arrival was purged: the NEW
+            # incarnation must arrive itself before re-admission
+            assert "b" not in sched._barrier_arrived
+
+            # c arrives: the barrier fires for the survivors ONLY —
+            # pre-fix, b's stale arrival re-admitted it here while the
+            # restarted process was still bootstrapping
+            bar(cc, 0)
+            ta.join(timeout=60)
+            assert not ta.is_alive()
+            assert done["a"] == (0, ("a", "c"))
+            assert done["c"] == (1, ("a", "c"))
+            log = open(hw + "_log").read()
+            assert "RECOVERED b" not in log, \
+                "b admitted on its dead incarnation's stale arrival"
+            assert cb2.recovery_pending
+
+            # normal re-admission at the next barrier, once b ARRIVES
+            rejoin = {}
+
+            def wait():
+                rejoin["epoch"] = cb2.wait_rejoin()
+
+            t2 = threading.Thread(target=wait)
+            t2.start()
+            deadline = time.time() + 30
+            while "b" not in sched._barrier_arrived:
+                assert time.time() < deadline, "rejoin never arrived"
+                time.sleep(0.02)
+            t1a = threading.Thread(target=bar, args=(ca, 1))
+            t1a.start()
+            bar(cc, 1)
+            for t in (t1a, t2):
+                t.join(timeout=60)
+                assert not t.is_alive()
+            assert rejoin["epoch"] == 1
+            assert sorted(ca.workers) == ["a", "b", "c"]
+            assert "RECOVERED b" in open(hw + "_log").read()
+            return (done["a"], done["c"], rejoin["epoch"],
+                    tuple(sorted(ca.workers)))
+        finally:
+            for c in (ca, cc, cb2):
+                if c is not None:
+                    c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# reliable-request mechanics (retry/deadline/idempotency tokens)
+# ---------------------------------------------------------------------------
+
+def test_request_deadline_bounds_retries():
+    """``deadline_s`` turns request() into retry-until-deadline: a dead
+    endpoint raises once the budget is spent, not after one attempt and
+    not never."""
+    import socket as socket_lib
+
+    from dt_tpu.elastic import protocol
+
+    s = socket_lib.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here now
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        protocol.request("127.0.0.1", port, {"cmd": "x"}, timeout=0.5,
+                         deadline_s=1.0)
+    elapsed = time.monotonic() - t0
+    assert 0.2 <= elapsed < 5.0  # retried within, then gave up at, budget
+
+
+def test_token_cache_serves_replays_without_redispatch(tmp_path):
+    """The scheduler's idempotency-token cache: a duplicated request
+    whose first dispatch completed is answered from the cache — the
+    handler runs ONCE."""
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0"])
+    sched = Scheduler(host_worker_file=hw)
+    c = None
+    try:
+        calls = []
+        orig = sched._dispatch
+
+        def counting(msg):
+            if msg.get("cmd") == "publish_snapshot":
+                calls.append(msg.get("token"))
+            return orig(msg)
+
+        sched._dispatch = counting
+        faults.install(FaultPlan(
+            [FaultRule("dup", op="send", cmd="publish_snapshot")]))
+        c = _client(sched.port, "w0")
+        c.publish_snapshot({"x": 1})
+        assert c.fetch_snapshot() == {"x": 1}
+        assert len(calls) == 1, \
+            "replayed request was re-dispatched instead of token-dedup'd"
+        assert calls[0] is not None  # reliable mode attached a token
+    finally:
+        if c is not None:
+            c.close()
+        sched.close()
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan mechanics
+# ---------------------------------------------------------------------------
+
+def test_plan_json_roundtrip_and_env_loading(monkeypatch, tmp_path):
+    plan = FaultPlan([
+        FaultRule("drop", op="recv", cmd=["allreduce", "barrier"],
+                  host="w1", prob=0.25, times=4, after=2),
+        FaultRule("crash", site="module.epoch_begin", host="w2",
+                  epoch=3, action="exit"),
+    ], seed=99)
+    back = FaultPlan.from_json(plan.to_json())
+    assert back.seed == 99
+    assert [r.to_dict() for r in back.rules] == \
+        [r.to_dict() for r in plan.rules]
+
+    # env loading: inline JSON and @file, picked up lazily
+    faults.clear()
+    monkeypatch.setenv("DT_FAULT_PLAN", plan.to_json())
+    loaded = faults.active_plan()
+    assert loaded is not None and loaded.seed == 99
+    faults.clear()
+    p = tmp_path / "plan.json"
+    p.write_text(plan.to_json())
+    monkeypatch.setenv("DT_FAULT_PLAN", "@" + str(p))
+    loaded = faults.active_plan()
+    assert loaded is not None and len(loaded.rules) == 2
+    faults.clear()
+    monkeypatch.delenv("DT_FAULT_PLAN")
+    assert faults.active_plan() is None
+
+
+def test_crash_point_epoch_pinning():
+    faults.install(FaultPlan(
+        [FaultRule("crash", site="module.epoch_begin", host="w0",
+                   epoch=2)]))
+    # wrong epoch / host / site: no crash
+    faults.crash_point("module.epoch_begin", host="w0", epoch=1)
+    faults.crash_point("module.epoch_begin", host="w1", epoch=2)
+    faults.crash_point("client.mc_barrier", host="w0", epoch=2)
+    with pytest.raises(CrashInjected):
+        faults.crash_point("module.epoch_begin", host="w0", epoch=2)
+    faults.clear()
+    # cleared: hooks are no-ops again
+    faults.crash_point("module.epoch_begin", host="w0", epoch=2)
+
+
+def test_seeded_streams_differ_across_seeds(tmp_path):
+    """Different seeds give different drop patterns (the plan is seeded,
+    not hardwired) while each seed remains self-consistent."""
+    def draws(seed):
+        plan = FaultPlan([FaultRule("drop", op="send", cmd="x",
+                                    prob=0.5)], seed=seed)
+        return tuple(plan.on_send("x", "h") for _ in range(32))
+
+    a, b = draws(0), draws(1)
+    assert a == draws(0) and b == draws(1)
+    assert a != b

@@ -229,6 +229,46 @@ def test_fused_bn_train_matches_oracle_and_grads():
                                atol=1e-5)
 
 
+def test_fused_bn_train_large_mean_small_variance_no_nan():
+    """f32 cancellation guard: E[x^2] - mean^2 for a large-mean,
+    tiny-variance channel can come out slightly NEGATIVE, and the
+    unclamped rsqrt(var + eps) then NaNs the whole layer (r5 advisor —
+    this kernel is the default-on train path).  With the clamp the
+    outputs, running stats, and gradients stay finite."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from dt_tpu.ops.pallas.kernels import fused_bn_train
+
+    rng = np.random.RandomState(3)
+    c = 16
+    # mean ~2048 with sigma 1e-3: true var 1e-6, but E[x^2] ~ 4.2e6 whose
+    # f32 ulp is ~0.25 — the subtraction is pure cancellation noise and
+    # goes negative for ~half the channels without the clamp
+    x = (2048.0 + rng.normal(0, 1e-3, (8, 4, 4, c))).astype(np.float32)
+    gamma = jnp.ones(c, jnp.float32)
+    beta = jnp.asarray(rng.normal(0, 1, c).astype(np.float32))
+    rm = jnp.zeros(c, jnp.float32)
+    rv = jnp.ones(c, jnp.float32)
+
+    y, nm, nv = fused_bn_train(jnp.asarray(x), gamma, beta, rm, rv,
+                               0.9, 1e-5)
+    assert np.isfinite(np.asarray(y)).all()
+    assert np.isfinite(np.asarray(nm)).all()
+    assert np.isfinite(np.asarray(nv)).all()
+    # the clamp floors the batch variance at 0, so the running-var
+    # update can never go below the momentum passthrough
+    assert (np.asarray(nv) >= 0.9 - 1e-6).all()
+
+    def loss(x, g, b):
+        y, _, _ = fused_bn_train(x, g, b, rm, rv, 0.9, 1e-5)
+        return jnp.sum(y ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(jnp.asarray(x), gamma, beta)
+    for a in grads:
+        assert np.isfinite(np.asarray(a)).all()
+
+
 def test_fused_batchnorm_train_path_matches_linen():
     """FusedBatchNorm's TRAIN path (fused_train=True default) produces
     the same outputs/updated stats as linen.BatchNorm."""
@@ -248,9 +288,11 @@ def test_fused_batchnorm_train_path_matches_linen():
                        mutable=["batch_stats"])
     np.testing.assert_allclose(np.asarray(yf), np.asarray(yl), rtol=1e-5,
                                atol=1e-5)
+    # atol floor: the running mean has near-zero elements where a pure
+    # rtol gate flags single-ulp XLA fusion differences
     np.testing.assert_allclose(
         np.asarray(mf["batch_stats"]["mean"]),
-        np.asarray(ml["batch_stats"]["mean"]), rtol=1e-5)
+        np.asarray(ml["batch_stats"]["mean"]), rtol=1e-5, atol=1e-7)
     np.testing.assert_allclose(
         np.asarray(mf["batch_stats"]["var"]),
-        np.asarray(ml["batch_stats"]["var"]), rtol=1e-5)
+        np.asarray(ml["batch_stats"]["var"]), rtol=1e-5, atol=1e-7)

@@ -136,6 +136,27 @@ def test_module_fit_learns_blobs():
     assert res["accuracy"] > 0.95, res
 
 
+def test_module_fit_with_controller_less_kvstore():
+    """A duck-typed kvstore WITHOUT a ``_controller`` attribute must fit
+    cleanly: every controller access in the fit loop (membership_sig,
+    the barrier gate, snapshot publish) uses getattr like the recovery
+    block, so a missing attribute means "no elastic control plane", not
+    an AttributeError at the top of every fit (r5 advisor)."""
+    class DuckKV:
+        num_workers = 1
+        rank = 0
+        type = "local"
+
+    x, y = _blob_dataset(64)
+    train = data.NDArrayIter(x, y, batch_size=32)
+    mod = Module(models.create("mlp", num_classes=2, hidden=(8,)),
+                 optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1},
+                 kvstore=DuckKV())
+    mod.fit(train, num_epoch=1)
+    assert int(mod.state.step) == 2  # 64/32 batches actually trained
+
+
 def test_module_fit_with_bn_model_updates_stats():
     rng = np.random.RandomState(1)
     x = rng.normal(2.0, 3.0, (32, 16, 16, 3)).astype(np.float32)

@@ -1,0 +1,218 @@
+"""Chaos harness: replay a deterministic fault plan against the elastic
+demo on the CPU 8-device mesh.
+
+Drives the same job as ``tests/test_crash_recovery.py`` — an in-process
+:class:`~dt_tpu.elastic.Scheduler` plus N ``tests/elastic_worker.py``
+subprocess workers training in exact host-sync — while a seeded
+:class:`~dt_tpu.elastic.faults.FaultPlan` injects control-plane faults:
+
+- worker side (via ``DT_FAULT_PLAN`` in each worker's env): seeded
+  heartbeat/allreduce drops, barrier delays and duplications, and one
+  ``crash`` rule that ``os._exit(137)``s a worker exactly at an epoch
+  boundary (``module.epoch_begin``) — the quick-restart re-admission
+  window (ps-lite ``van.cc:187-218`` ``is_recovery``; heartbeat/dead-node
+  semantics ``van.cc:686-698``).
+- scheduler side (installed in-process): receive drops and a bounded
+  host partition.
+
+The harness plays the restart wrapper's role: when the crashed worker
+exits it is immediately respawned under its OLD identity with
+``DT_RECOVERY=1`` (and a plan without the crash rule), taking the
+quick-restart recovery path while the survivors are parked at the
+barrier.  Success = every worker (including the restarted one) exits 0,
+final loss is finite, all workers hold bit-identical params, and
+membership converged back to the full host set.
+
+Usage::
+
+    python tools/chaos_run.py --seed 0 --plan default
+    python tools/chaos_run.py --plan none          # fault-free baseline
+
+Prints one JSON summary line and exits non-zero on any failed check.
+"""
+
+import argparse
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+HOSTS = ["w0", "w1", "w2"]
+CRASH_HOST = "w2"
+CRASH_EPOCH = 3
+
+
+def _plans(num_epoch):
+    """(worker_rules, scheduler_rules) per named plan.  Worker rules ship
+    via DT_FAULT_PLAN; scheduler rules install in-process.  The seed is
+    applied where it matters — in the FaultPlan the caller builds."""
+    from dt_tpu.elastic.faults import FaultRule
+    if num_epoch <= CRASH_EPOCH + 2:
+        raise SystemExit(f"--num-epoch must leave re-admission room past "
+                         f"the epoch-{CRASH_EPOCH} crash")
+    noise = [
+        FaultRule("drop", op="send", cmd="heartbeat", prob=0.2),
+        FaultRule("drop", op="send", cmd="allreduce", prob=0.05),
+        FaultRule("dup", op="send", cmd="mc_barrier", prob=0.5),
+        FaultRule("delay", op="send", cmd="mc_barrier", prob=0.3,
+                  delay_s=0.1),
+    ]
+    crash = [FaultRule("crash", site="module.epoch_begin", host=CRASH_HOST,
+                       epoch=CRASH_EPOCH, action="exit")]
+    sched_noise = [
+        FaultRule("drop", op="recv", cmd="allreduce", prob=0.05),
+        FaultRule("partition", op="recv", cmd="allreduce", host="w1",
+                  after=4, times=2),
+    ]
+    plans = {
+        "none": ([], []),
+        "noise": (noise, sched_noise),          # churn-free transport fuzz
+        "default": (noise + crash, sched_noise),  # fuzz + crash + recovery
+        "crash-only": (crash, []),
+    }
+    return plans
+
+
+def _spawn(port, host, out, num_epoch, plan_json, recovery=False):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["ELASTIC_TRAINING_ENABLED"] = "1"
+    if plan_json:
+        env["DT_FAULT_PLAN"] = plan_json
+    else:
+        env.pop("DT_FAULT_PLAN", None)
+    if recovery:
+        env["DT_RECOVERY"] = "1"
+    # log to a file, not a PIPE: nothing drains the pipe while workers
+    # run, so a chatty worker would wedge on pipe backpressure — and the
+    # full log (not a 2000-byte tail) survives for post-mortems
+    log_path = out + (".restart.log" if recovery else ".log")
+    with open(log_path, "w") as log:
+        return subprocess.Popen(
+            [sys.executable, WORKER, "--scheduler-port", str(port),
+             "--host", host, "--num-epoch", str(num_epoch), "--out", out,
+             "--heartbeat", "0.2"],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--plan", default="default",
+                    choices=["default", "noise", "crash-only", "none"])
+    ap.add_argument("--num-epoch", type=int, default=8)
+    ap.add_argument("--timeout-s", type=float, default=1200.0)
+    args = ap.parse_args()
+
+    from dt_tpu.elastic import Scheduler, faults
+    from dt_tpu.elastic.faults import FaultPlan
+
+    worker_rules, sched_rules = _plans(args.num_epoch)[args.plan]
+    worker_plan = FaultPlan(worker_rules, seed=args.seed)
+    # the restarted incarnation keeps the transport noise but NOT the
+    # crash rule — rule counters do not survive a process restart, so a
+    # re-loaded crash rule would fire again at the same epoch forever
+    restart_plan = FaultPlan(
+        [r for r in worker_rules if r.kind != "crash"], seed=args.seed + 1)
+    sched_plan = faults.install(FaultPlan(sched_rules, seed=args.seed)) \
+        if sched_rules else None
+
+    tmp = tempfile.mkdtemp(prefix="chaos_run_")
+    hw = os.path.join(tmp, "host_worker")
+    with open(hw, "w") as f:
+        f.write("\n".join(HOSTS) + "\n")
+    outs = {h: os.path.join(tmp, f"{h}.json") for h in HOSTS}
+    sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=30.0)
+    procs = {h: _spawn(sched.port, h, outs[h], args.num_epoch,
+                       worker_plan.to_json() if worker_rules else "")
+             for h in HOSTS}
+    expect_crash = any(r.kind == "crash" for r in worker_rules)
+    restarted = False
+    deadline = time.time() + args.timeout_s
+    checks = {}
+    try:
+        # reap, playing the restart wrapper for the injected crash
+        pending = dict(procs)
+        while pending and time.time() < deadline:
+            for h, p in list(pending.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del pending[h]
+                if rc != 0 and expect_crash and h == CRASH_HOST \
+                        and not restarted:
+                    print(f"# {h} crashed (rc={rc}) as planned; quick "
+                          "restart with DT_RECOVERY=1", file=sys.stderr)
+                    procs[h] = _spawn(
+                        sched.port, h, outs[h], args.num_epoch,
+                        restart_plan.to_json() if restart_plan.rules
+                        else "", recovery=True)
+                    pending[h] = procs[h]
+                    restarted = True
+                elif rc != 0:
+                    log = outs[h] + (".restart.log"
+                                     if restarted and h == CRASH_HOST
+                                     else ".log")
+                    try:
+                        tail = open(log).read()[-2000:]
+                    except OSError:
+                        tail = "(no log)"
+                    print(f"# {h} FAILED rc={rc}:\n{tail}", file=sys.stderr)
+                    checks["worker_rcs"] = False
+            time.sleep(0.2)
+        checks.setdefault("worker_rcs", not pending)
+        if pending:
+            print(f"# timed out waiting for {sorted(pending)}",
+                  file=sys.stderr)
+
+        results = {}
+        for h in HOSTS:
+            try:
+                results[h] = json.load(open(outs[h]))
+            except (OSError, ValueError):
+                checks[f"result_{h}"] = False
+        if len(results) == len(HOSTS):
+            losses = [r["final_loss"] for r in results.values()]
+            checks["loss_finite"] = all(math.isfinite(l) for l in losses)
+            checks["params_identical"] = \
+                len({r["param_hash"] for r in results.values()}) == 1
+            checks["steps_identical"] = \
+                len({r["final_step"] for r in results.values()}) == 1
+            checks["membership_converged"] = (
+                sorted(sched._workers) == sorted(HOSTS)
+                and all(r["num_workers_at_end"] == len(HOSTS)
+                        for r in results.values()))
+            if expect_crash:
+                checks["crash_recovered"] = restarted and \
+                    "RECOVERED w2" in open(hw + "_log").read()
+        ok = bool(checks) and all(checks.values())
+        print(json.dumps({
+            "ok": ok, "plan": args.plan, "seed": args.seed,
+            "num_epoch": args.num_epoch, "checks": checks,
+            "final_loss": {h: r.get("final_loss")
+                           for h, r in results.items()},
+            "final_acc": {h: r.get("final_acc")
+                          for h, r in results.items()},
+            "scheduler_faults_applied":
+                sched_plan.applied_summary() if sched_plan else [],
+            "workdir": tmp,
+        }))
+        return 0 if ok else 1
+    finally:
+        sched.close()
+        faults.clear()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
