@@ -41,3 +41,9 @@ from dt_tpu.elastic.faults import (FaultPlan as FaultPlan,
 # workers) threaded through protocol.request's at-least-once reliable
 # mode (retry/backoff/deadline + idempotency tokens); replay the chaos
 # demo with tools/chaos_run.py.
+# r7: the wire path is zero-copy and connection-pooled — protocol.py's
+# ChannelPool multiplexes frames over persistent sockets (ps-lite's
+# long-lived Van connections), gradients ride pickle-5 out-of-band
+# buffers (vectored sendmsg -> preallocated recv_into, the zero-copy
+# SArray role), and client.allreduce streams chunk rounds through a
+# bounded in-flight window (DT_AR_WINDOW), 2-bit-compressed included.

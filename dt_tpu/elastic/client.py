@@ -159,8 +159,11 @@ class WorkerClient:
             except faults.CrashInjected:
                 return  # injected heartbeat death: the thread just stops
             try:
+                # retries=1: a lost heartbeat is superseded by the next
+                # interval's; a long retry loop would only delay close()
                 resp = self._req({"cmd": "heartbeat", "host": self.host,
-                                  "pseq": self._prof_seq}, timeout=10)
+                                  "pseq": self._prof_seq}, timeout=10,
+                                 retries=1)
                 for c in resp.get("profile_cmds", []):
                     self._apply_profile_cmd(c)
             except (OSError, RuntimeError):
@@ -265,6 +268,54 @@ class WorkerClient:
     def num_dead_nodes(self, timeout_s: float = 60.0) -> int:
         return self._req({"cmd": "num_dead", "timeout_s": timeout_s})["count"]
 
+    def _ar_chunk_elems(self, value_size: int, itemsize: int,
+                        route: Optional[int], nbytes: int,
+                        quantum: int = 1) -> int:
+        """Elements per chunked-allreduce round: the DT_AR_CHUNK_BYTES
+        funnel bound, shrunk to ~size/R under a server fleet (the
+        reference's bigarray split) — shared by the dense and the 2-bit
+        compressed paths so both produce the same subkey structure.
+        ``quantum`` rounds the chunk DOWN to a whole code-packing word
+        (never below one word), so a fleet split may yield one extra
+        small trailing chunk."""
+        chunk_bytes = int(os.environ.get("DT_AR_CHUNK_BYTES",
+                                         str(4 << 20)))
+        per = max(1, chunk_bytes // max(itemsize, 1))
+        nsrv = len(self.servers)
+        if nsrv > 1 and route is None and nbytes > int(
+                os.environ.get("DT_AR_SHARD_MIN_BYTES", str(64 << 10))):
+            # with a server fleet, split every sizable tensor across
+            # ALL R servers (the reference's bigarray split,
+            # kvstore_dist.h:547-589) — not only past the 4 MiB
+            # funnel-protection bound.  Top level only (_route is
+            # None): a routed chunk must ship as-is, else each chunk
+            # re-splits recursively into an exploding round tree
+            per = min(per, -(-value_size // nsrv))
+        if quantum > 1:
+            per = max(quantum, (per // quantum) * quantum)
+        return per
+
+    def _stream_chunks(self, tasks) -> List[np.ndarray]:
+        """Run chunk-round thunks through the persistent fan-out pool
+        with a BOUNDED in-flight window (``DT_AR_WINDOW``, default
+        2xfleet): chunk i+W is submitted only once chunk i completed, so
+        serialization, socket I/O, and server-side reduction overlap
+        while per-server peak memory stays O(workers x chunk x window).
+        Results come back in submission order."""
+        import collections
+        window = int(os.environ.get("DT_AR_WINDOW", "0")) or \
+            max(4, 2 * max(len(self.servers), 1))
+        pool = self._fanout_pool()
+        out: List[np.ndarray] = []
+        inflight = collections.deque()
+        for t in tasks:
+            inflight.append(pool.submit(t))
+            if len(inflight) >= window:
+                out.append(inflight.popleft().result())
+        while inflight:
+            out.append(inflight.popleft().result())
+        return out
+
     def allreduce(self, key: str, value, _route: Optional[int] = None
                   ) -> np.ndarray:
         """Exact average across live workers (CPU-cluster data plane; on a
@@ -272,53 +323,64 @@ class WorkerClient:
         is an array, or a ``{"packed", "n", "threshold"}`` dict for
         2-bit-compressed gradients (the server dequantizes before merging).
 
-        Arrays larger than ``DT_AR_CHUNK_BYTES`` (default 4 MiB) are split
-        into per-chunk rounds on subkeys ``key#c<i>`` — the reference
-        splits big tensors across server key ranges for the same reason
-        (``kvstore_dist.h:547-589`` EncodeDefaultKey): bounded message
-        size and server peak memory of O(workers x chunk), not
-        O(workers x full gradient).  With a range-server fleet the chunks
-        round-robin across the R servers (chunk i → server (crc32(key)+i)
-        % R, identical on every worker) so R servers carry 1/R of the
-        bytes each and aggregate bandwidth scales with the fleet.
+        Payloads larger than ``DT_AR_CHUNK_BYTES`` (default 4 MiB of
+        represented gradient) are split into per-chunk rounds on subkeys
+        ``key#c<i>`` — the reference splits big tensors across server key
+        ranges for the same reason (``kvstore_dist.h:547-589``
+        EncodeDefaultKey): bounded message size and server peak memory of
+        O(workers x chunk), not O(workers x full gradient).  Chunks
+        STREAM over the pooled channels with a bounded in-flight window
+        (:meth:`_stream_chunks`), and 2-bit-compressed payloads chunk on
+        the same element grid (whole packed words per chunk, 16 codes
+        each) so the compressed path rides the identical machinery.  With
+        a range-server fleet the chunks round-robin across the R servers
+        (chunk i → server (crc32(key)+i) % R, identical on every worker)
+        so R servers carry 1/R of the bytes each and aggregate bandwidth
+        scales with the fleet.
 
         Each call carries a per-host sequence number so an at-least-once
         retry of a lost RESPONSE is served the cached result instead of
         being mistaken for the next round's contribution."""
-        if not isinstance(value, dict):
+        nsrv = len(self.servers)
+        if isinstance(value, dict) and "packed" in value:
+            from dt_tpu.parallel.compression import (CODES_PER_WORD,
+                                                     packed_chunks)
+            n = int(value["n"])
+            # chunk on the ELEMENT grid (4 bytes/elem represented), like
+            # the dense path — server peak memory is O(dequantized chunk)
+            per = self._ar_chunk_elems(n, 4, _route, n * 4,
+                                       quantum=CODES_PER_WORD)
+            if _route is None and n > per:
+                packed = np.asarray(value["packed"])
+                thr = float(value["threshold"])
+                base = zlib.crc32(key.encode())
+                chunks = packed_chunks(packed, n, per)
+                parts = self._stream_chunks([
+                    (lambda i=i, words=words, cn=cn:
+                     self.allreduce(f"{key}#c{i}",
+                                    {"packed": words, "n": cn,
+                                     "threshold": thr},
+                                    (base + i) if nsrv else None))
+                    for i, (words, cn) in enumerate(chunks)])
+                return np.concatenate(parts)
+        elif not isinstance(value, dict):
             value = np.asarray(value)
-            chunk_bytes = int(os.environ.get("DT_AR_CHUNK_BYTES",
-                                             str(4 << 20)))
-            per = max(1, chunk_bytes // max(value.itemsize, 1))
-            nsrv = len(self.servers)
-            if nsrv > 1 and _route is None and value.nbytes > int(
-                    os.environ.get("DT_AR_SHARD_MIN_BYTES",
-                                   str(64 << 10))):
-                # with a server fleet, split every sizable tensor across
-                # ALL R servers (the reference's bigarray split,
-                # kvstore_dist.h:547-589) — not only past the 4 MiB
-                # funnel-protection bound.  Top level only (_route is
-                # None): a routed chunk must ship as-is, else each chunk
-                # re-splits recursively into an exploding round tree
-                per = min(per, -(-value.size // nsrv))
+            per = self._ar_chunk_elems(value.size,
+                                       max(value.itemsize, 1),
+                                       _route, value.nbytes)
             # split on element count, not bytes: a single-element array is
             # never split again, so pathological chunk sizes below the
             # itemsize terminate instead of recursing on "#c0" forever
             if value.size > per:
                 flat = value.ravel()
                 base = zlib.crc32(key.encode())
-                # the persistent pool bounds the in-flight window (hides
-                # RTT + straggler skew while keeping per-server memory at
-                # O(workers x chunk x window)); connections are
-                # per-request, so concurrent _req calls are safe
-                pool = self._fanout_pool()
-                futs = [
-                    pool.submit(self.allreduce, f"{key}#c{i}",
-                                flat[start:start + per],
-                                (base + i) if nsrv else None)
+                parts = self._stream_chunks([
+                    (lambda i=i, start=start:
+                     self.allreduce(f"{key}#c{i}",
+                                    flat[start:start + per],
+                                    (base + i) if nsrv else None))
                     for i, start in enumerate(
-                        range(0, flat.size, per))]
-                parts = [f.result() for f in futs]
+                        range(0, flat.size, per))])
                 return np.concatenate(parts).reshape(value.shape)
         seq = self._ar_seq.get(key, 0)
         self._ar_seq[key] = seq + 1
@@ -418,9 +480,11 @@ class WorkerClient:
     def _fanout_pool(self):
         """Persistent executor for fleet fan-outs and chunk windows
         (creating a pool per round-trip costs more than the loopback RTT
-        it hides).  Tasks never submit back into the pool — routed
-        chunks and per-server rounds are direct requests — so sharing
-        one pool cannot deadlock."""
+        it hides).  Each task draws its own channel from the persistent
+        connection pool (``protocol.pool()``), so concurrent requests
+        never share a socket.  Tasks never submit back into the pool —
+        routed chunks and per-server rounds are direct requests — so
+        sharing one executor cannot deadlock."""
         if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
             self._pool = ThreadPoolExecutor(
@@ -583,9 +647,18 @@ class WorkerClient:
 
     def close(self):
         self._stop.set()
+        # bounded join: an in-flight heartbeat would otherwise release
+        # its channel back into the pool AFTER the purge below (the
+        # thread is normally parked in _stop.wait and exits instantly)
+        self._hb_thread.join(timeout=2.0)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        # drop this client's idle pooled channels: the server side's
+        # per-connection threads see EOF and exit (fd/thread hygiene
+        # when tests churn through schedulers)
+        for addr in [self.addr] + list(self.servers):
+            protocol.pool().close_addr(tuple(addr))
 
 
 def auto_client(**kwargs) -> Optional[WorkerClient]:

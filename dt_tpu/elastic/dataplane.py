@@ -183,7 +183,26 @@ class DataPlane:
         if any(isinstance(a, tuple) and a[0] == "rsp" for a in stacked):
             slot["result"] = self._merge_sparse(stacked)
         else:
-            slot["result"] = np.mean(stacked, axis=0)
+            # accumulate in place instead of np.mean(stacked): mean first
+            # materializes a (workers, N) stack — a full extra copy of
+            # every contribution on the hot path, under the round lock.
+            # Same dtype rules as np.mean: mixed inputs promote via
+            # result_type, integers average in float64, and float16
+            # accumulates through float32 intermediates before casting
+            # back.
+            out_dtype = np.result_type(*[np.asarray(a).dtype
+                                         for a in stacked])
+            if not np.issubdtype(out_dtype, np.inexact):
+                out_dtype = np.float64
+            acc_dtype = np.float32 if out_dtype == np.float16 else out_dtype
+            if len(stacked) == 1:
+                acc = np.array(stacked[0], dtype=acc_dtype, copy=True)
+            else:
+                acc = np.add(stacked[0], stacked[1], dtype=acc_dtype)
+                for a in stacked[2:]:
+                    np.add(acc, a, out=acc)
+            acc /= len(stacked)
+            slot["result"] = acc.astype(out_dtype, copy=False)
         for h, (h_seq, _) in slot["vals"].items():
             slot["served"][h] = (h_seq, slot["result"])
         slot["vals"] = {}

@@ -25,7 +25,9 @@ Fault kinds
 - ``reset``      the connection dies AFTER the request was delivered but
   BEFORE the response is read — the most dangerous replay window: the
   server acted, the client retries, and only idempotency prevents a
-  double apply.
+  double apply.  On the pooled transport (``protocol.ChannelPool``) the
+  injected reset destroys the persistent channel mid-stream; the retry
+  draws a fresh one, so the scenario covers reconnect-and-replay too.
 - ``partition``  drop, scoped by host — a host that cannot reach the
   scheduler for a bounded window (``times`` matching messages).
 - ``crash``      at a named hook *site* (see below): raise

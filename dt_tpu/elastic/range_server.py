@@ -13,6 +13,10 @@ pushes are partitioned by row id, and each slice travels to its server
 concurrently — so R servers move R slices in parallel where the embedded
 scheduler plane funneled everything through one socket.
 
+Like the scheduler, a range server serves many requests per persistent
+connection (``protocol.serve_connection``) — the workers' chunk windows
+ride pooled channels, so the per-round cost is frames, not handshakes.
+
 Control remains with the scheduler: a range server registers itself
 (``register_server``) and mirrors the live worker membership from the
 scheduler with a short-TTL cache — refreshed synchronously when an
@@ -146,38 +150,34 @@ class RangeServer:
                              daemon=True).start()
 
     def _handle_conn(self, conn: socket.socket):
-        with conn:
-            try:
-                msg = protocol.recv_msg(conn)
-                # the same DT_DROP_MSG transport fuzz as the scheduler —
-                # the sharded plane must survive at-least-once retries too
-                drop = os.environ.get("DT_DROP_MSG")
-                if drop and _drop_rng.random() * 100 < float(drop):
-                    logger.debug("DT_DROP_MSG: dropping %s", msg.get("cmd"))
-                    return
-                plan = faults.active_plan()
-                if plan is not None and \
-                        not plan.on_recv(msg.get("cmd"), msg.get("host")):
-                    return
-                token = msg.get("token")
-                if token is not None:
-                    cached = self._tokens.get(token)
-                    if cached is not None:
-                        protocol.send_msg(conn, cached)
-                        return
-                resp = self._dispatch(msg)
-                if token is not None and "error" not in resp and \
-                        msg.get("cmd") not in _TOKEN_EXEMPT:
-                    self._tokens.put(token, resp)
-                protocol.send_msg(conn, resp)
-            except (ConnectionError, OSError):
-                pass
-            except Exception as e:
-                logger.exception("range server %d handler error", self.index)
-                try:
-                    protocol.send_msg(conn, {"error": repr(e)})
-                except OSError:
-                    pass
+        protocol.serve_connection(conn, self._handle_one)
+
+    def _handle_one(self, msg: dict) -> Optional[dict]:
+        """One request on a persistent connection (``None`` = drop)."""
+        # the same DT_DROP_MSG transport fuzz as the scheduler —
+        # the sharded plane must survive at-least-once retries too
+        drop = os.environ.get("DT_DROP_MSG")
+        if drop and _drop_rng.random() * 100 < float(drop):
+            logger.debug("DT_DROP_MSG: dropping %s", msg.get("cmd"))
+            return None
+        plan = faults.active_plan()
+        if plan is not None and \
+                not plan.on_recv(msg.get("cmd"), msg.get("host")):
+            return None
+        token = msg.get("token")
+        if token is not None:
+            cached = self._tokens.get(token)
+            if cached is not None:
+                return cached
+        try:
+            resp = self._dispatch(msg)
+        except Exception as e:
+            logger.exception("range server %d handler error", self.index)
+            return {"error": repr(e)}
+        if token is not None and "error" not in resp and \
+                msg.get("cmd") not in _TOKEN_EXEMPT:
+            self._tokens.put(token, resp)
+        return resp
 
     def _dispatch(self, msg: dict) -> dict:
         cmd = msg.get("cmd")

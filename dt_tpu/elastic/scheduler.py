@@ -2,8 +2,10 @@
 
 Replaces the ps-lite scheduler role + the fork's ``ETDefaultNodeManager``
 (``ps-lite/src/elastic_training.cc``, ``van.cc:256-315``).  One instance per
-job (the launcher runs it on the root host).  Thread-per-connection TCP; all
-state under one lock — control traffic is a handful of messages per epoch.
+job (the launcher runs it on the root host).  Thread-per-connection TCP
+serving many requests per persistent connection (the pooled transport,
+``protocol.serve_connection``); all state under one lock — control traffic
+is a handful of messages per epoch.
 
 Responsibilities (SURVEY.md §3.3):
 
@@ -138,6 +140,11 @@ class Scheduler:
         self._profile_posted: Dict[tuple, int] = {}  # retry dedup
         # idempotency-token response cache (protocol.request reliable mode)
         self._tokens = protocol.TokenCache()
+        # transport stats: with pooled client channels many requests ride
+        # each accepted connection (chaos_run asserts requests >> conns)
+        self._tstats_lock = threading.Lock()
+        self._conns_accepted = 0
+        self._requests_served = 0
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -181,44 +188,53 @@ class Scheduler:
                              daemon=True).start()
 
     def _handle_conn(self, conn: socket.socket):
-        with conn:
-            try:
-                msg = protocol.recv_msg(conn)
-                # Fault injection: DT_DROP_MSG=<percent> drops received
-                # requests BEFORE dispatch (the ps-lite PS_DROP_MSG
-                # transport fuzz, van.cc:430-431,563-570); clients retry.
-                # A FaultPlan (elastic/faults.py) generalizes this with
-                # seeded drop/delay/reorder/partition rules.
-                drop = os.environ.get("DT_DROP_MSG")
-                if drop and _drop_rng.random() * 100 < float(drop):
-                    logger.debug("DT_DROP_MSG: dropping %s", msg.get("cmd"))
-                    return
-                plan = faults.active_plan()
-                if plan is not None and \
-                        not plan.on_recv(msg.get("cmd"), msg.get("host")):
-                    return
-                # idempotency-token dedup (protocol.request reliable
-                # mode): a replay whose first dispatch completed is
-                # served the SAME response instead of re-dispatching
-                token = msg.get("token")
-                if token is not None:
-                    cached = self._tokens.get(token)
-                    if cached is not None:
-                        protocol.send_msg(conn, cached)
-                        return
-                resp = self._dispatch(msg)
-                if token is not None and "error" not in resp and \
-                        msg.get("cmd") not in _TOKEN_EXEMPT:
-                    self._tokens.put(token, resp)
-                protocol.send_msg(conn, resp)
-            except (ConnectionError, OSError):
-                pass
-            except Exception as e:  # surface handler bugs to the worker
-                logger.exception("scheduler handler error")
-                try:
-                    protocol.send_msg(conn, {"error": repr(e)})
-                except OSError:
-                    pass
+        with self._tstats_lock:
+            self._conns_accepted += 1
+        protocol.serve_connection(conn, self._handle_one)
+
+    def _handle_one(self, msg: dict) -> Optional[dict]:
+        """One request on a persistent connection; ``None`` closes the
+        channel without answering (receive-side drop injection — the
+        pooled client sees EOF and retries on a fresh channel)."""
+        with self._tstats_lock:
+            self._requests_served += 1
+        # Fault injection: DT_DROP_MSG=<percent> drops received
+        # requests BEFORE dispatch (the ps-lite PS_DROP_MSG
+        # transport fuzz, van.cc:430-431,563-570); clients retry.
+        # A FaultPlan (elastic/faults.py) generalizes this with
+        # seeded drop/delay/reorder/partition rules.
+        drop = os.environ.get("DT_DROP_MSG")
+        if drop and _drop_rng.random() * 100 < float(drop):
+            logger.debug("DT_DROP_MSG: dropping %s", msg.get("cmd"))
+            return None
+        plan = faults.active_plan()
+        if plan is not None and \
+                not plan.on_recv(msg.get("cmd"), msg.get("host")):
+            return None
+        # idempotency-token dedup (protocol.request reliable
+        # mode): a replay whose first dispatch completed is
+        # served the SAME response instead of re-dispatching
+        token = msg.get("token")
+        if token is not None:
+            cached = self._tokens.get(token)
+            if cached is not None:
+                return cached
+        try:
+            resp = self._dispatch(msg)
+        except Exception as e:  # surface handler bugs to the worker
+            logger.exception("scheduler handler error")
+            return {"error": repr(e)}
+        if token is not None and "error" not in resp and \
+                msg.get("cmd") not in _TOKEN_EXEMPT:
+            self._tokens.put(token, resp)
+        return resp
+
+    def transport_stats(self) -> dict:
+        """{connections, requests}: pooled channels make requests greatly
+        exceed accepted connections (chaos_run asserts this)."""
+        with self._tstats_lock:
+            return {"connections": self._conns_accepted,
+                    "requests": self._requests_served}
 
     def close(self):
         self._stop.set()

@@ -1,0 +1,34 @@
+"""jax version-compat shims for the parallel layer.
+
+``jax.shard_map`` is the public API from jax 0.6 on; on the 0.4.x line
+the same functionality lives at ``jax.experimental.shard_map.shard_map``
+with the replication-check kwarg spelled ``check_rep`` instead of
+``check_vma``.  Every ``shard_map`` user in this package
+(``pipeline.py``, ``ring_attention.py``, ``ulysses.py``) resolves
+through :func:`shard_map` here so the call sites stay written against
+the current public API and older jax runtimes keep working.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None, **kwargs):
+    """``jax.shard_map`` where available, else the ``jax.experimental``
+    equivalent with ``check_vma`` mapped to its old ``check_rep`` name.
+    Same contract as the public API; extra kwargs pass through."""
+    top = getattr(jax, "shard_map", None)
+    if top is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return top(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kwargs)
+    from jax.experimental.shard_map import shard_map as _sm
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kwargs)

@@ -91,6 +91,24 @@ def np_quantize_2bit(grad: np.ndarray, residual: np.ndarray,
     return packed, new_residual
 
 
+def packed_chunks(packed: np.ndarray, n: int, per_elems: int):
+    """Split a packed 2-bit stream into per-chunk (words, n_chunk) pairs
+    on the ELEMENT grid — ``per_elems`` must be a multiple of
+    ``CODES_PER_WORD`` so every chunk is whole uint32 words.  The
+    chunked-allreduce wire path ships each pair as its own
+    ``{"packed", "n", "threshold"}`` round (subkey ``key#c<i>``); the
+    slices are views, so chunking copies nothing."""
+    if per_elems % CODES_PER_WORD:
+        raise ValueError(f"per_elems {per_elems} must be a multiple of "
+                         f"{CODES_PER_WORD}")
+    words_per = per_elems // CODES_PER_WORD
+    out = []
+    for start in range(0, n, per_elems):
+        w0 = start // CODES_PER_WORD
+        out.append((packed[w0:w0 + words_per], min(per_elems, n - start)))
+    return out
+
+
 def np_dequantize_2bit(packed: np.ndarray, n: int, threshold: float = 0.5,
                        dtype=np.float32) -> np.ndarray:
     shifts = (np.arange(CODES_PER_WORD, dtype=np.uint32) * 2)

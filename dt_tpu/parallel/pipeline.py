@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dt_tpu.parallel._compat import shard_map
+
 
 def _pipeline_sharded(stacked_params, x, *, stage_fn, num_micro, axis_name):
     """Per-device body.  ``stacked_params``: local (1, ...) stage slice;
@@ -86,7 +88,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     xspec = P(None, batch_axis, *rest) if batch_axis else P()
     yspec = P(axis_name, None, batch_axis, *rest) if batch_axis \
         else P(axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pipeline_sharded, stage_fn=stage_fn,
                           num_micro=num_micro, axis_name=axis_name),
         mesh=mesh,

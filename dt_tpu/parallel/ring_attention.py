@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dt_tpu.parallel._compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -100,7 +102,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_attention_sharded, axis_name=axis_name,
                           scale=scale, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

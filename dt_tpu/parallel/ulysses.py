@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dt_tpu.parallel._compat import shard_map
 from dt_tpu.parallel.ring_attention import full_attention
 
 
@@ -60,7 +61,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             f"num_heads {q.shape[2]} must divide by axis size {n} for "
             f"ulysses; use ring_attention for head counts < axis size")
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ulysses_sharded, axis_name=axis_name, scale=scale,
                           causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -502,6 +502,68 @@ def test_crash_after_arrival_stale_arrival_not_counted(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# scenario 8: mid-stream RESET on a POOLED connection — the persistent
+# channel dies between requests it already served; reconnect + token dedup
+# ---------------------------------------------------------------------------
+
+def test_pooled_connection_midstream_reset_dedup(tmp_path):
+    """r7 pooled transport: a connection that has ALREADY served requests
+    is reset right after a delivered request (the replay window, on a
+    warm channel).  The retry must draw a fresh channel, carry the SAME
+    idempotency token, and be served from the TokenCache — the handler
+    dispatches exactly once despite the reconnect."""
+    from dt_tpu.elastic import protocol
+
+    def scenario(dirpath, seed):
+        os.makedirs(dirpath, exist_ok=True)
+        hw = str(dirpath / "hosts")
+        _write_hosts(hw, ["w0"])
+        sched = Scheduler(host_worker_file=hw)
+        c = None
+        try:
+            calls = []
+            orig = sched._dispatch
+
+            def counting(msg):
+                if msg.get("cmd") == "publish_snapshot":
+                    calls.append(msg.get("token"))
+                return orig(msg)
+
+            sched._dispatch = counting
+            c = _client(sched.port, "w0")
+            # warm the pooled channel: several requests ride ONE conn
+            for _ in range(3):
+                c.num_dead_nodes()
+            warm = protocol.pool().stats()
+            # now inject the reset: delivered, then the channel dies
+            plan = faults.install(FaultPlan(
+                [FaultRule("reset", op="send", cmd="publish_snapshot",
+                           times=1)], seed=seed))
+            c.publish_snapshot({"epoch": 7})
+            assert c.fetch_snapshot() == {"epoch": 7}
+            # dispatched once; the replayed token was served from cache
+            assert len(calls) == 1, \
+                "reset replay re-dispatched instead of token-dedup'd"
+            assert calls[0] is not None
+            healed = protocol.pool().stats()
+            # the reset destroyed its channel; the retry rode the pool
+            # (a fresh connect, or another idle pooled channel — e.g.
+            # the heartbeat's) and still completed
+            assert healed["connects"] >= warm["connects"]
+            applied = plan.applied_summary()
+            # publish_snapshot carries no host field -> host key ""
+            assert applied == [(0, "", 1)]
+            return (len(calls), tuple(applied))
+        finally:
+            if c is not None:
+                c.close()
+            sched.close()
+            faults.clear()
+
+    _run_twice(scenario, tmp_path)
+
+
+# ---------------------------------------------------------------------------
 # reliable-request mechanics (retry/deadline/idempotency tokens)
 # ---------------------------------------------------------------------------
 

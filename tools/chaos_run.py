@@ -193,10 +193,18 @@ def main():
             if expect_crash:
                 checks["crash_recovered"] = restarted and \
                     "RECOVERED w2" in open(hw + "_log").read()
+        # the r7 pooled transport: every worker multiplexes its requests
+        # over a handful of persistent channels, so the scheduler serves
+        # far more requests than it accepts connections (per-request
+        # connections would make these counts track 1:1)
+        tstats = sched.transport_stats()
+        checks["pooled_connections"] = \
+            tstats["requests"] > 2 * tstats["connections"]
         ok = bool(checks) and all(checks.values())
         print(json.dumps({
             "ok": ok, "plan": args.plan, "seed": args.seed,
             "num_epoch": args.num_epoch, "checks": checks,
+            "transport": tstats,
             "final_loss": {h: r.get("final_loss")
                            for h, r in results.items()},
             "final_acc": {h: r.get("final_acc")

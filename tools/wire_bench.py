@@ -11,9 +11,18 @@ here demonstrate *load-split correctness* (each server carries ~1/R of
 the bytes — the property that scales on real clusters) rather than
 wall-clock speedup; the JSON notes this honestly.
 
-Output: one JSON line per config + ``WIRE_BENCH_r05.json`` summary.
+Round 6 rebuilds the transport underneath this bench: persistent
+pooled channels (one long-lived socket per concurrent request instead of
+a TCP handshake per message), zero-copy pickle-5 out-of-band framing
+(gradients ride ``sendmsg`` straight from the source array into a
+preallocated receive buffer), and chunk rounds streamed through a
+bounded in-flight window — including the 2-bit-compressed path, which
+now chunks on the same element grid (``compressed: true`` rows).
+
+Output: one JSON line per config + ``WIRE_BENCH_r06.json`` summary
+(same row schema as r05 for trend comparison).
 Run: ``python tools/wire_bench.py [--workers 2] [--mb 1,4,16]
-[--servers 0,2,4]``
+[--servers 0,2,4] [--no-compressed]``
 """
 
 import argparse
@@ -136,8 +145,10 @@ def main():
                     help="range-server fleet sizes; 0 = the embedded "
                          "scheduler funnel")
     ap.add_argument("--iters", type=int, default=5)
-    ap.add_argument("--compressed", action="store_true",
-                    help="also run 2-bit-compressed rows")
+    ap.add_argument("--compressed", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run 2-bit-compressed rows (chunked allreduce "
+                         "path) alongside the uncompressed grid")
     args = ap.parse_args()
 
     rows = []
@@ -152,7 +163,10 @@ def main():
                 "funnel (servers=0) vs key-range-sharded RangeServer "
                 "fleet (elastic/range_server.py, the reference's "
                 "kvstore_dist.h:547-589 split), real worker/server "
-                "processes",
+                "processes; r6 transport = pooled persistent channels + "
+                "zero-copy pickle-5 out-of-band framing + windowed chunk "
+                "streaming (elastic/protocol.py), compressed rows ride "
+                "the chunked 2-bit path",
         "host_cores": os.cpu_count(),
         "rows": rows,
         "interpretation": (
@@ -164,14 +178,14 @@ def main():
             ">= G*S, beyond that use the mesh path (ICI collectives) or "
             "2-bit compression"),
         "single_core_note": (
-            "this box has ONE CPU core: all server processes time-share "
-            "it, so R>1 wall-clock equals R=1 here; the scaling claim "
-            "rests on the measured 1/R byte split + process isolation, "
-            "not on local wall-clock"),
+            f"this box has {os.cpu_count()} CPU core(s): all server "
+            "processes time-share them, so R>1 wall-clock does not scale "
+            "with R here; the scaling claim rests on the measured 1/R "
+            "byte split + process isolation, not on local wall-clock"),
     }
-    with open(os.path.join(REPO, "WIRE_BENCH_r05.json"), "w") as f:
+    with open(os.path.join(REPO, "WIRE_BENCH_r06.json"), "w") as f:
         json.dump(summary, f, indent=1)
-    print(json.dumps({"out": "WIRE_BENCH_r05.json",
+    print(json.dumps({"out": "WIRE_BENCH_r06.json",
                       "configs": len(rows)}))
 
 
