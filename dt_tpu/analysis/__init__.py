@@ -1,0 +1,37 @@
+"""dt_tpu.analysis — project-invariant static analysis (dtlint).
+
+The reference gated its tree with ``make cpplint``/``make pylint``
+(reference ``Makefile:140-160``, ``tests/ci_build/``); dt_tpu's invariants
+are TPU-shaped, so they get a bespoke rule engine instead:
+
+- DT001 pallas-tiling    — (8, 128) block tiling + no unsigned reductions
+- DT002 bf16-downcast    — preferred_element_type=f32 + downcast in ops
+- DT003 cpu-donate       — donate_argnums without a backend guard
+- DT004 partial-block    — timing next to block_until_ready(loss)
+- DT005 env-registry     — DT_*/JAX_* reads vs config.ENV_REGISTRY
+- DT006 lock-discipline  — ``# guarded-by:`` annotations in elastic/*
+- DT007 parity-citation  — module docstrings cite reference file:line
+
+CLI: ``python tools/dtlint.py``; engine: :func:`dt_tpu.analysis.engine.run`;
+rule catalog with examples: ``docs/dtlint_rules.md``.  Stdlib-only — the
+linter imports without jax.
+"""
+
+from typing import List
+
+from dt_tpu.analysis.engine import (Baseline, FileContext, Finding,
+                                    ProjectContext, Rule, run)
+
+
+def all_rules() -> List[Rule]:
+    """One fresh instance of every registered rule, id order."""
+    from dt_tpu.analysis import rules_project, rules_tpu
+    rules = [rules_tpu.PallasTiling(), rules_tpu.Bf16Downcast(),
+             rules_tpu.CpuDonate(), rules_tpu.PartialBlock(),
+             rules_project.EnvRegistry(), rules_project.LockDiscipline(),
+             rules_project.ParityCitation()]
+    return sorted(rules, key=lambda r: r.id)
+
+
+__all__ = ["Baseline", "FileContext", "Finding", "ProjectContext",
+           "Rule", "all_rules", "run"]

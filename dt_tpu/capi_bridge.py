@@ -1,6 +1,6 @@
 """Python half of the C predict ABI.
 
-Reference: ``src/c_api/c_predict_api.cc`` — a C surface
+Reference: ``src/c_api/c_predict_api.cc:1`` — a C surface
 (``MXPredCreate``/``MXPredSetInput``/``MXPredForward``/...) wrapping the
 full runtime so foreign hosts (C/C++ services, other languages) can
 serve models.  The dt_tpu equivalent keeps the same shape: the C

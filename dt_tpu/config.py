@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Mapping, Tuple
+from typing import Any, Mapping, Optional, Tuple
 
 # ---------------------------------------------------------------------------
 # Env contract (distributed bootstrap only).
@@ -32,6 +32,79 @@ ENV_WORKER_HOST_FILE = "WORKER_HOST_FILE"
 ENV_TRAINING_CMD = "TRAINING_CMD"
 ENV_SCHEDULER_URI = "DMLC_PS_ROOT_URI"
 ENV_SCHEDULER_PORT = "DMLC_PS_ROOT_PORT"
+
+
+# ---------------------------------------------------------------------------
+# DT_* env-var registry — the single declaration point for every project
+# knob, the role ps-lite's one GetEnv block played
+# (``ps-lite/src/postoffice.cc:18-31``).  dtlint rule DT005 enforces it:
+# a DT_*/JAX_* read anywhere in the tree must have a row here (undeclared
+# reads and dead rows are findings).  Values are ``(default, doc)``;
+# defaults are strings (callers convert) so one table serves flags,
+# sizes, and paths alike.  Read through :func:`env` to inherit the
+# default from this table.
+# ---------------------------------------------------------------------------
+
+ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
+    # runtime / backend
+    "DT_FORCE_CPU": ("", "1 = flip jax to the CPU backend before init (tests/CI)"),
+    "DT_COMPILE_CACHE": ("", "persistent XLA compile-cache dir (elastic restarts hit it)"),
+    # Pallas kernel opt-ins (model zoo / op surface swaps)
+    "DT_PALLAS_BN": ("", "1 = model zoo uses the Pallas fused BN (models/common.py)"),
+    "DT_PALLAS_ATTN": ("", "1 = TransformerLM local attention uses the Pallas flash kernel"),
+    "DT_PALLAS_RNN": ("", "1 = lstm() runs the Pallas fused cell in the scan"),
+    "DT_PALLAS_QUANT": ("", "1 = 2-bit gradient compression uses the Pallas kernels"),
+    # elastic control plane / wire
+    "DT_ELASTIC_SECRET": ("", "HMAC secret authenticating control frames (launcher generates per-job)"),
+    "DT_ELASTIC_INSECURE": ("", "1 = explicit opt-out of frame authentication (trusted single host)"),
+    "DT_ELASTIC_BIND": ("0.0.0.0", "interface the scheduler/range servers listen on"),
+    "DT_ELASTIC_ADVERTISE": ("", "address peers dial to reach a server bound here (DMLC_NODE_HOST analog)"),
+    "DT_WIRE_SOCKBUF": (str(4 << 20), "SO_SNDBUF/SO_RCVBUF for data-plane sockets (bytes)"),
+    "DT_WIRE_INBAND": ("", "1 = legacy copying framing (no pickle-5 out-of-band buffers)"),
+    "DT_AR_CHUNK_BYTES": (str(4 << 20), "represented-gradient bytes per chunked-allreduce round"),
+    "DT_AR_SHARD_MIN_BYTES": (str(64 << 10), "tensors above this split across ALL range servers"),
+    "DT_AR_WINDOW": ("0", "in-flight chunk-round window (0 = 2x fleet, min 4)"),
+    "DT_WORKER_ID": ("", "this worker's host identity under the launcher env contract"),
+    "DT_RECOVERY": ("", "1 = re-register under the old identity after a crash (restart wrapper)"),
+    "DT_SERVER_ID": ("0", "range-server index under the launcher env contract"),
+    # fault injection / chaos
+    "DT_FAULT_PLAN": ("", "fault-plan JSON (or @/path) for subprocess workers (elastic/faults.py)"),
+    "DT_DROP_MSG": ("", "percent of received control messages to drop (ps-lite PS_DROP_MSG fuzz)"),
+    # data pipeline
+    "DT_DECODE_THREADS": ("", "recordio decode pool size (default min(cpus, 16))"),
+    # bench.py harness
+    "DT_BENCH_TIMEOUT_S": ("1500", "total bench wall budget"),
+    "DT_BENCH_PREFLIGHT_TIMEOUT_S": ("90", "per-attempt preflight budget"),
+    "DT_BENCH_MEASURE_RESERVE_S": ("600", "tail budget reserved for measurement"),
+    "DT_BENCH_MODEL": ("", "run only this tier (default: headline ladder)"),
+    "DT_BENCH_BATCH": ("32", "CNN tier batch size"),
+    "DT_BENCH_IMAGE": ("224", "CNN tier image size"),
+    "DT_BENCH_ITERS": ("20", "measured steps per tier"),
+    "DT_BENCH_LM_BATCH": ("8", "transformer_lm tier batch"),
+    "DT_BENCH_LM_SEQ": ("2048", "transformer_lm tier sequence length"),
+    "DT_BENCH_LM_VOCAB": ("8192", "transformer_lm tier vocab"),
+    "DT_BENCH_LM_ATTN": ("", "override transformer_lm attention path (e.g. pallas)"),
+    "DT_BENCH_RESULT_FILE": ("", "child->parent result handoff file (bench.py internal)"),
+    "DT_BENCH_JSONL": ("", "append per-tier rows to this jsonl (bench.py internal)"),
+    # tools/convergence_run.py
+    "DT_CONV_EPOCHS": ("40", "convergence-run epoch budget"),
+    "DT_CONV_SKIP_ELASTIC": ("", "1 = skip the elastic leg of the convergence run"),
+}
+
+
+def env(name: str, default: Optional[str] = None) -> str:
+    """Read a REGISTERED env var; unset falls back to ``default`` (when
+    given) else the registry default.  Unregistered names raise — the
+    runtime counterpart of dtlint DT005, so a typo'd knob fails loudly
+    instead of silently returning ''."""
+    spec = ENV_REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(f"{name!r} is not declared in "
+                       f"dt_tpu.config.ENV_REGISTRY (dtlint DT005)")
+    v = os.environ.get(name)
+    if v is not None:
+        return v
+    return spec[0] if default is None else default
 
 
 def env_flag(name: str, default: bool = False) -> bool:
@@ -61,7 +134,7 @@ def enable_compilation_cache(cache_dir: str = "") -> str:
     ``Module.__init__`` calls this, so setting the env var on the launcher
     command line enables it job-wide (workers inherit the environment)."""
     import jax
-    cache_dir = cache_dir or os.environ.get("DT_COMPILE_CACHE", "")
+    cache_dir = cache_dir or env("DT_COMPILE_CACHE")
     if cache_dir:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -76,7 +149,7 @@ def maybe_force_cpu() -> bool:
     backend init.  Used by tests/CI where the TPU is absent — env var alone
     is not enough when a sitecustomize pre-registers an accelerator
     backend."""
-    if os.environ.get("DT_FORCE_CPU") == "1":
+    if env("DT_FORCE_CPU") == "1":
         import jax
         jax.config.update("jax_platforms", "cpu")
         return True

@@ -1,6 +1,6 @@
 """Host-side image augmentation (numpy, HWC uint8/float).
 
-Reference: ``src/io/image_aug_default.cc`` (DefaultImageAugmenter: resize,
+Reference: ``src/io/image_aug_default.cc:1`` (DefaultImageAugmenter: resize,
 random-resized crop ``:357-407``, random crop, random mirror, HSL jitter
 ``:495-520``, PCA lighting ``:522-545``, mean/std normalize) and the Python
 augmenters in ``python/mxnet/image/image.py``.  Detection-side (image +

@@ -1,7 +1,7 @@
 """Bucketed sequence iteration for variable-length RNN training.
 
 Reference: ``mx.rnn.BucketSentenceIter`` + ``BucketingModule``
-(``python/mxnet/module/bucketing_module.py``; ``example/rnn/bucketing/``).
+(``python/mxnet/module/bucketing_module.py:1``; ``example/rnn/bucketing/``).
 The reference re-binds a shared-parameter executor per bucket; under jax the
 per-bucket "executor cache" is simply jit's shape-specialized compile cache —
 each bucket length is one compiled program, weights shared by construction.

@@ -1,6 +1,6 @@
 """Core data iterators.
 
-Reference: ``python/mxnet/io/io.py`` (DataIter/DataBatch/NDArrayIter/
+Reference: ``python/mxnet/io/io.py:1`` (DataIter/DataBatch/NDArrayIter/
 ResizeIter/PrefetchingIter) and the C++ iterators in ``src/io/``.  Iterators
 yield numpy host batches; device placement happens in the training loop (so
 the same iterator drives a sharded `jax.make_array_from_process_local_data`

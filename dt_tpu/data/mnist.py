@@ -1,6 +1,6 @@
 """MNIST idx-ubyte iterator.
 
-Reference: ``src/io/iter_mnist.cc`` — reads the original idx format
+Reference: ``src/io/iter_mnist.cc:1`` — reads the original idx format
 (``train-images-idx3-ubyte`` + ``train-labels-idx1-ubyte``, optionally
 .gz), yields flat or (28, 28, 1) batches, shardable like every iterator.
 """

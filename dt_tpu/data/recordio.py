@@ -1,6 +1,6 @@
 """RecordIO: dmlc-format record files + packed image records.
 
-Reference: ``3rdparty/dmlc-core/src/io/recordio_split.cc`` +
+Reference: ``3rdparty/dmlc-core/src/io/recordio_split.cc:1`` +
 ``python/mxnet/recordio.py`` (MXRecordIO/MXIndexedRecordIO, IRHeader
 pack/unpack) and the C++ image iterator ``src/io/iter_image_recordio_2.cc``.
 The wire format is kept byte-compatible so ``.rec``/``.idx`` files packed by

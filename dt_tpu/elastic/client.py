@@ -2,7 +2,7 @@
 ``kv.set_controller(...)``.
 
 Plays the role of the worker's Postoffice/Van connection to the scheduler
-(``ps-lite/src/postoffice.cc``): registration, background heartbeats,
+(``ps-lite/src/postoffice.cc:1``): registration, background heartbeats,
 membership-change barrier, snapshot publish/fetch, and (for CPU-process
 clusters) the exact-average allreduce data plane.
 """
@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dt_tpu import config
 from dt_tpu.elastic import faults, protocol
 
 logger = logging.getLogger("dt_tpu.elastic")
@@ -53,7 +54,7 @@ class WorkerClient:
         if is_recovery is None:
             # a restarted worker re-entering under its old identity
             # (van.cc:187-218 is_recovery); set by the restart wrapper
-            is_recovery = os.environ.get("DT_RECOVERY", "") in ("1", "true")
+            is_recovery = config.env("DT_RECOVERY") in ("1", "true")
         faults.crash_point("client.register", host=self.host)
         resp = self._req({"cmd": "register", "host": self.host,
                           "is_new": is_new, "is_recovery": is_recovery})
@@ -73,7 +74,7 @@ class WorkerClient:
         self._announce_to_servers()
         # profiler sync starts AT the current command seq: a joiner must
         # not replay a long-finished profiling session's command history
-        self._prof_seq = int(resp.get("profile_seq", 0))
+        self._prof_seq = int(resp.get("profile_seq", 0))  # guarded-by: _prof_lock
         self._prof_lock = threading.Lock()  # heartbeat vs caller thread
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(
@@ -159,10 +160,15 @@ class WorkerClient:
             except faults.CrashInjected:
                 return  # injected heartbeat death: the thread just stops
             try:
+                with self._prof_lock:
+                    # snapshot under the lock: racing a synchronous
+                    # profile_command could send a stale pseq and replay
+                    # an already-applied command on this worker
+                    pseq = self._prof_seq
                 # retries=1: a lost heartbeat is superseded by the next
                 # interval's; a long retry loop would only delay close()
                 resp = self._req({"cmd": "heartbeat", "host": self.host,
-                                  "pseq": self._prof_seq}, timeout=10,
+                                  "pseq": pseq}, timeout=10,
                                  retries=1)
                 for c in resp.get("profile_cmds", []):
                     self._apply_profile_cmd(c)
@@ -278,12 +284,11 @@ class WorkerClient:
         ``quantum`` rounds the chunk DOWN to a whole code-packing word
         (never below one word), so a fleet split may yield one extra
         small trailing chunk."""
-        chunk_bytes = int(os.environ.get("DT_AR_CHUNK_BYTES",
-                                         str(4 << 20)))
+        chunk_bytes = int(config.env("DT_AR_CHUNK_BYTES"))
         per = max(1, chunk_bytes // max(itemsize, 1))
         nsrv = len(self.servers)
         if nsrv > 1 and route is None and nbytes > int(
-                os.environ.get("DT_AR_SHARD_MIN_BYTES", str(64 << 10))):
+                config.env("DT_AR_SHARD_MIN_BYTES")):
             # with a server fleet, split every sizable tensor across
             # ALL R servers (the reference's bigarray split,
             # kvstore_dist.h:547-589) — not only past the 4 MiB
@@ -303,7 +308,7 @@ class WorkerClient:
         while per-server peak memory stays O(workers x chunk x window).
         Results come back in submission order."""
         import collections
-        window = int(os.environ.get("DT_AR_WINDOW", "0")) or \
+        window = int(config.env("DT_AR_WINDOW")) or \
             max(4, 2 * max(len(self.servers), 1))
         pool = self._fanout_pool()
         out: List[np.ndarray] = []
@@ -489,7 +494,7 @@ class WorkerClient:
             from concurrent.futures import ThreadPoolExecutor
             self._pool = ThreadPoolExecutor(
                 max_workers=max(4, 2 * max(len(self.servers), 1),
-                                int(os.environ.get("DT_AR_WINDOW", "0"))))
+                                int(config.env("DT_AR_WINDOW"))))
         return self._pool
 
     def _async_fanout(self, fn):
@@ -670,4 +675,4 @@ def auto_client(**kwargs) -> Optional[WorkerClient]:
     if not uri or not port:
         return None
     return WorkerClient(uri, int(port),
-                        host=os.environ.get("DT_WORKER_ID"), **kwargs)
+                        host=config.env("DT_WORKER_ID") or None, **kwargs)

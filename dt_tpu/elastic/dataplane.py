@@ -42,23 +42,23 @@ class DataPlane:
         self.confirm_fn = confirm_fn or expected_fn
         self._cv = threading.Condition()
         # key -> {vals: {host: (seq, arr)}, gen, result, served: {host: (seq, result)}}
-        self._reduce: Dict[str, dict] = {}
+        self._reduce: Dict[str, dict] = {}  # guarded-by: _cv
         self._async_lock = threading.Lock()
-        self._async_live: Set[str] = set()
-        self._async_store: Dict[str, np.ndarray] = {}
-        self._async_updater = None
-        self._async_served: Dict[tuple, tuple] = {}  # (host,key)->(seq,val)
+        self._async_live: Set[str] = set()  # guarded-by: _async_lock
+        self._async_store: Dict[str, np.ndarray] = {}  # guarded-by: _async_lock
+        self._async_updater = None  # guarded-by: _async_lock
+        self._async_served: Dict[tuple, tuple] = {}  # (host,key)->(seq,val); guarded-by: _async_lock
         # staleness accounting (VERDICT r4 weak 7): how many updates by
         # OTHER workers landed on a key between the weights a worker
         # trained on (its previous push's response / its init pull) and
         # its next push — the actual dist_async gradient lag.  The
         # reference never measured this; unbounded by design
         # (kvstore_dist_server.h:347 applies pushes on arrival).
-        self._async_update_count: Dict[str, int] = {}   # key -> updates
-        self._async_last_seen: Dict[tuple, int] = {}    # (host,key) -> cnt
-        self._async_stale_max = 0
-        self._async_stale_sum = 0
-        self._async_stale_n = 0
+        self._async_update_count: Dict[str, int] = {}   # key -> updates; guarded-by: _async_lock
+        self._async_last_seen: Dict[tuple, int] = {}    # (host,key) -> cnt; guarded-by: _async_lock
+        self._async_stale_max = 0  # guarded-by: _async_lock
+        self._async_stale_sum = 0  # guarded-by: _async_lock
+        self._async_stale_n = 0  # guarded-by: _async_lock
 
     # ------------------------------------------------------------------
     # dispatch

@@ -76,6 +76,8 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
+from dt_tpu import config
+
 KINDS = ("drop", "dup", "delay", "reorder", "reset", "partition", "crash")
 OPS = ("send", "recv")
 
@@ -333,7 +335,7 @@ def active_plan() -> Optional[FaultPlan]:
     with _ENV_LOCK:
         if _ENV_CHECKED:
             return _PLAN
-        spec = os.environ.get("DT_FAULT_PLAN")
+        spec = config.env("DT_FAULT_PLAN")
         if spec:
             text = open(spec[1:]).read() if spec.startswith("@") else spec
             _PLAN = FaultPlan.from_json(text)

@@ -1,5 +1,10 @@
 """Mesh lifecycle across membership changes — the multi-host data plane.
 
+The reference rebuilt its ps-lite world the same way: a membership change
+re-runs the ADD_NODE/BARRIER dance and every node adopts the new ring
+(``ps-lite/src/van.cc:269-315``); the worker re-binds its executors at
+the epoch boundary (``python/mxnet/module/base_module.py:503-549``).
+
 SURVEY.md §5.8/§7 "hard parts": XLA/GSPMD assumes a fixed device set, so a
 membership change means tearing down and re-initializing the
 ``jax.distributed`` runtime with the new host set, rebuilding the mesh, and

@@ -80,6 +80,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from dt_tpu import config
 from dt_tpu.elastic import faults
 
 _LEN = struct.Struct("<Q")
@@ -104,7 +105,7 @@ def _tune_sock(sock: socket.socket) -> None:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     except OSError:
         pass
-    buf = int(os.environ.get("DT_WIRE_SOCKBUF", str(4 << 20)))
+    buf = int(config.env("DT_WIRE_SOCKBUF"))
     if buf > 0:
         for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
             try:
@@ -128,13 +129,13 @@ def set_secret(secret: Optional[str]) -> None:
 def _secret() -> Optional[bytes]:
     if _SECRET_OVERRIDE:
         return _SECRET_OVERRIDE.encode()
-    s = os.environ.get("DT_ELASTIC_SECRET", "")
+    s = config.env("DT_ELASTIC_SECRET")
     return s.encode() if s else None
 
 
 def bind_interface() -> str:
     """Interface the scheduler listens on (``DT_ELASTIC_BIND``)."""
-    return os.environ.get("DT_ELASTIC_BIND", "0.0.0.0")
+    return config.env("DT_ELASTIC_BIND")
 
 
 def advertise_host() -> str:
@@ -142,7 +143,7 @@ def advertise_host() -> str:
     (``DT_ELASTIC_ADVERTISE``; falls back to the bind interface when it
     is a concrete address, else the machine hostname — the same contract
     as ps-lite's ``DMLC_NODE_HOST``)."""
-    adv = os.environ.get("DT_ELASTIC_ADVERTISE")
+    adv = config.env("DT_ELASTIC_ADVERTISE")
     if adv:
         return adv
     bind = bind_interface()
@@ -166,7 +167,7 @@ def _encode(msg: Dict[str, Any]):
     writes them straight from the original array memory (no serialized
     copy), the ps-lite zero-copy SArray property.  ``DT_WIRE_INBAND=1``
     forces everything in-band (the historical copying framing)."""
-    if os.environ.get("DT_WIRE_INBAND", "") in ("1", "true"):
+    if config.env("DT_WIRE_INBAND") in ("1", "true"):
         return pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL), []
     bufs = []
 
@@ -344,13 +345,13 @@ class ChannelPool:
     def __init__(self, max_idle_per_addr: int = 8,
                  max_idle_total: int = 64):
         self._lock = threading.Lock()
-        self._idle: Dict[tuple, list] = {}
-        self._order: list = []  # addr LRU for the global idle cap
+        self._idle: Dict[tuple, list] = {}  # guarded-by: _lock
+        self._order: list = []  # addr LRU for the global idle cap; guarded-by: _lock
         self._max_per = max_idle_per_addr
         self._max_total = max_idle_total
-        self._pid = os.getpid()
-        self.connects = 0
-        self.reuses = 0
+        self._pid = os.getpid()  # guarded-by: _lock
+        self.connects = 0  # guarded-by: _lock
+        self.reuses = 0  # guarded-by: _lock
 
     def _reset_if_forked_locked(self) -> None:
         if os.getpid() != self._pid:
@@ -604,8 +605,8 @@ class TokenCache:
     def __init__(self, cap: int = 512):
         self._cap = cap
         self._lock = threading.Lock()
-        self._cache: "collections.OrderedDict[str, Dict[str, Any]]" = \
-            collections.OrderedDict()
+        # token -> response, LRU order
+        self._cache = collections.OrderedDict()  # guarded-by: _lock
 
     def get(self, token: str) -> Optional[Dict[str, Any]]:
         with self._lock:

@@ -59,8 +59,8 @@ class RangeServer:
                  poll_interval_s: float = 1.0):
         self.index = int(index)
         self.sched_addr = (scheduler_host, scheduler_port)
-        self._members: List[str] = []
-        self._members_ts = 0.0
+        self._members: List[str] = []  # guarded-by: _members_lock
+        self._members_ts = 0.0  # guarded-by: _members_lock
         self._members_lock = threading.Lock()
         self._ttl = membership_ttl_s
         # confirm_fn forces a synchronous scheduler read right before a
@@ -71,8 +71,8 @@ class RangeServer:
                              confirm_fn=self._refresh_members)
         # data bytes received (gradient payloads), for load-balance
         # evidence: with R servers each should carry ~1/R of the bytes
-        self._bytes_in = 0
-        self._rounds = 0
+        self._bytes_in = 0  # guarded-by: _stats_lock
+        self._rounds = 0  # guarded-by: _stats_lock
         self._stats_lock = threading.Lock()
         self._tokens = protocol.TokenCache()
 

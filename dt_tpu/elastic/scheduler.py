@@ -76,32 +76,32 @@ class Scheduler:
         if initial_workers is None and host_worker_file and \
                 os.path.exists(host_worker_file):
             initial_workers = _read_hosts(host_worker_file)
-        self._workers: List[str] = list(initial_workers or [])
-        self._base: Set[str] = set(self._workers)
+        self._workers: List[str] = list(initial_workers or [])  # guarded-by: _lock
+        self._base: Set[str] = set(self._workers)  # guarded-by: _lock
         # launch-time base membership, immutable: eviction removes a
         # crashed base worker from _base (it must be evictable), but a
         # RECOVERED one gets its base protection back from this record
-        self._base0: Set[str] = set(self._workers)
-        self._registered: Set[str] = set()
+        self._base0: Set[str] = set(self._workers)  # guarded-by: _lock
+        self._registered: Set[str] = set()  # guarded-by: _lock
         # crashed-and-evicted hosts that re-registered under their old
         # identity (van.cc:187-218 is_recovery): re-admitted at the next
         # membership barrier, not mid-epoch (sync rounds in flight must
         # not change their expected contributor set)
-        self._pending_recovery: Set[str] = set()
+        self._pending_recovery: Set[str] = set()  # guarded-by: _lock
         # host -> epoch it was re-admitted at: a wait_rejoin retry whose
         # admitting RESPONSE was lost must be served the SAME result (its
         # resume_epoch is stale and the pending-recovery bump no longer
         # applies once admitted); cleared when the host reaches a later
         # barrier through the normal fit loop
-        self._recovered_at: Dict[str, int] = {}
+        self._recovered_at: Dict[str, int] = {}  # guarded-by: _lock
         # Seed heartbeats at startup so a worker that never comes up ages
         # out and is counted dead, instead of defaulting to "alive forever".
         now = time.time()
-        self._heartbeats: Dict[str, float] = {h: now for h in self._workers}
-        self._removed_hosts: Set[str] = set()
+        self._heartbeats: Dict[str, float] = {h: now for h in self._workers}  # guarded-by: _lock
+        self._removed_hosts: Set[str] = set()  # guarded-by: _lock
         self._log_path = host_worker_log or (
             host_worker_file + "_log" if host_worker_file else None)
-        self._log_seq = 0
+        self._log_seq = 0  # guarded-by: _lock
         self._launch_callback = launch_callback
         # Called with the epoch right before the host_worker diff — the
         # in-process analog of the EC2 manager thread that rewrites the file
@@ -112,16 +112,16 @@ class Scheduler:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # barrier state
-        self._barrier_epoch: Optional[int] = None
-        self._barrier_arrived: Set[str] = set()
-        self._barrier_result: Dict[int, dict] = {}
-        self._last_completed_epoch = -1
+        self._barrier_epoch: Optional[int] = None  # guarded-by: _lock
+        self._barrier_arrived: Set[str] = set()  # guarded-by: _lock
+        self._barrier_result: Dict[int, dict] = {}  # guarded-by: _lock
+        self._last_completed_epoch = -1  # guarded-by: _lock
         # plain barrier
-        self._plain_arrived: Set[str] = set()
-        self._plain_gen = 0
-        self._plain_served: Dict[str, int] = {}
+        self._plain_arrived: Set[str] = set()  # guarded-by: _lock
+        self._plain_gen = 0  # guarded-by: _lock
+        self._plain_served: Dict[str, int] = {}  # guarded-by: _lock
         # snapshot
-        self._snapshot = None
+        self._snapshot = None  # guarded-by: _snapshot_lock
         self._snapshot_lock = threading.Lock()
         # the single-funnel data plane (allreduce rounds + dist_async
         # store), shared machinery with RangeServer (dataplane.py).  When
@@ -132,19 +132,19 @@ class Scheduler:
         # (the reference's server count is DMLC_NUM_SERVER, not elastic).
         # Own lock: _server_list() is called from inside _register, which
         # already holds the (non-reentrant) scheduler lock.
-        self._servers: Dict[int, tuple] = {}
+        self._servers: Dict[int, tuple] = {}  # guarded-by: _servers_lock
         self._servers_lock = threading.Lock()
         # remote profiler control (rank 0 drives all workers)
-        self._profile_cmds: List[dict] = []
-        self._profile_seq = 0
-        self._profile_posted: Dict[tuple, int] = {}  # retry dedup
+        self._profile_cmds: List[dict] = []  # guarded-by: _lock
+        self._profile_seq = 0  # guarded-by: _lock
+        self._profile_posted: Dict[tuple, int] = {}  # retry dedup; guarded-by: _lock
         # idempotency-token response cache (protocol.request reliable mode)
         self._tokens = protocol.TokenCache()
         # transport stats: with pooled client channels many requests ride
         # each accepted connection (chaos_run asserts requests >> conns)
         self._tstats_lock = threading.Lock()
-        self._conns_accepted = 0
-        self._requests_served = 0
+        self._conns_accepted = 0  # guarded-by: _tstats_lock
+        self._requests_served = 0  # guarded-by: _tstats_lock
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -643,7 +643,8 @@ class Scheduler:
                 "added": added, "recovered": recovered, "epoch": epoch}
 
     def _append_log(self, action: str, host: str):
-        """``SEQ ADDED|REMOVED IP TIME`` (``elastic_training.cc:108-126``)."""
+        """``SEQ ADDED|REMOVED IP TIME`` (``elastic_training.cc:108-126``).
+        Caller holds the lock (the seq must be unique and ordered)."""
         self._log_seq += 1
         if self._log_path:
             with open(self._log_path, "a") as f:

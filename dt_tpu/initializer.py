@@ -1,6 +1,6 @@
 """Initializer zoo with the reference's registry surface.
 
-Reference: ``python/mxnet/initializer.py`` — Zero, One, Constant, Uniform,
+Reference: ``python/mxnet/initializer.py:1`` — Zero, One, Constant, Uniform,
 Normal, Orthogonal, Xavier (rnd_type gaussian|uniform, factor_type
 in|out|avg, magnitude), MSRAPrelu, Bilinear (for deconv upsampling), Mixed
 (pattern-dispatch).  Each returns a flax-style ``init(key, shape, dtype)``

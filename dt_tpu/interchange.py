@@ -13,7 +13,7 @@ layers:
    HWIO->OIHW, TF-"SAME" asymmetric padding reproduced with ``F.pad``,
    BN running stats, NHWC->NCHW at the boundary).  This is a real
    third-party serving path, numerically parity-tested in
-   ``tests/test_interchange.py`` — the proof that weights leave the
+   ``tests/test_interchange.py:1`` — the proof that weights leave the
    framework losslessly.
 2. ONNX interchange moved to ``dt_tpu.onnx`` (round 4): a self-contained
    protobuf codec that exports AND imports in-container, round-trip

@@ -35,6 +35,8 @@ import os
 import subprocess
 import sys
 import time
+
+from dt_tpu import config
 from typing import List, Optional
 
 logger = logging.getLogger("dt_tpu.launcher")
@@ -51,10 +53,10 @@ def _job_secret() -> Optional[str]:
     ``os.environ`` — unrelated subprocesses must not inherit it) and to the
     workers via their Popen env (local) or ssh stdin (never the remote
     command line, which is world-readable in process listings)."""
-    s = os.environ.get("DT_ELASTIC_SECRET")
+    s = config.env("DT_ELASTIC_SECRET")
     if s:
         return s
-    if os.environ.get("DT_ELASTIC_INSECURE", "").lower() in ("1", "true"):
+    if config.env("DT_ELASTIC_INSECURE").lower() in ("1", "true"):
         logger.warning("elastic control plane running UNAUTHENTICATED "
                        "(DT_ELASTIC_INSECURE set)")
         return None

@@ -1,4 +1,4 @@
-"""AlexNet.  Reference: ``example/image-classification/symbols/alexnet.py``
+"""AlexNet.  Reference: ``example/image-classification/symbols/alexnet.py:1``
 (the single-tower variant with LRN, BASELINE row 'AlexNet 457 img/s')."""
 
 from typing import Any

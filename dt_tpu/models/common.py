@@ -1,4 +1,9 @@
-"""Shared building blocks for the model zoo (flax.linen, NHWC)."""
+"""Shared building blocks for the model zoo (flax.linen, NHWC).
+
+Reference: the conv/BN/act idiom shared by the classification symbols
+(``example/image-classification/symbols/resnet.py:1`` and siblings);
+``DT_PALLAS_BN=1`` swaps in the Pallas fused BN — the role of the
+reference's fused ``src/operator/nn/batch_norm.cu:1``."""
 
 from __future__ import annotations
 

@@ -1,6 +1,6 @@
 """DenseNet 121/161/169/201.
 
-Reference: ``python/mxnet/gluon/model_zoo/vision/densenet.py``."""
+Reference: ``python/mxnet/gluon/model_zoo/vision/densenet.py:1``."""
 
 from typing import Any, Dict, Tuple
 

@@ -1,6 +1,6 @@
 """GoogLeNet (Inception v1).
 
-Reference: ``example/image-classification/symbols/googlenet.py`` (Szegedy et
+Reference: ``example/image-classification/symbols/googlenet.py:1`` (Szegedy et
 al. 2014, without the auxiliary heads — matching the reference symbol)."""
 
 from typing import Any

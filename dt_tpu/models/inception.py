@@ -1,6 +1,6 @@
 """Inception-v3.
 
-Reference: ``example/image-classification/symbols/inception-v3.py``
+Reference: ``example/image-classification/symbols/inception-v3.py:1``
 (BASELINE row Inception-v3 30.4 -> 6,660.98 img/s).  Structure follows
 Szegedy et al. 2015 as the reference symbol does: stem, 3x InceptionA,
 ReductionA(grid 35->17), 4x InceptionB(7x7 factorized), ReductionB(17->8),

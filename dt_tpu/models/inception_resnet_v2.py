@@ -1,6 +1,6 @@
 """Inception-ResNet-v2.
 
-Reference: ``example/image-classification/symbols/inception-resnet-v2.py``
+Reference: ``example/image-classification/symbols/inception-resnet-v2.py:1``
 (Szegedy et al. 2016) — the last of the reference's inception symbol family:
 inception branches with residual connections scaled before the add.
 """

@@ -1,6 +1,6 @@
 """Inception-BN (v2) and Inception-v4.
 
-Reference: ``example/image-classification/symbols/inception-bn.py`` and
+Reference: ``example/image-classification/symbols/inception-bn.py:1`` and
 ``symbols/inception-v4.py`` (Ioffe & Szegedy 2015; Szegedy et al. 2016).
 """
 

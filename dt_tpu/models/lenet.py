@@ -1,4 +1,4 @@
-"""LeNet-5.  Reference: ``example/image-classification/symbols/lenet.py``
+"""LeNet-5.  Reference: ``example/image-classification/symbols/lenet.py:1``
 (and the distributed convergence gate ``tests/nightly/dist_lenet.py``)."""
 
 from typing import Any

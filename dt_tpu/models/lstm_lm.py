@@ -1,6 +1,6 @@
 """LSTM word-level language model.
 
-Reference: ``example/rnn/word_lm/`` (PTB LSTM LM — BASELINE config #5,
+Reference: ``example/rnn/word_lm/train.py:1`` (PTB LSTM LM — BASELINE config #5,
 the elastic RNN workload) and the bucketing LM in ``example/rnn/bucketing/``.
 Embedding -> multi-layer LSTM (scan-fused, ``dt_tpu.ops.rnn``) -> tied or
 untied softmax head.

@@ -1,4 +1,4 @@
-"""MLP.  Reference: ``example/image-classification/symbols/mlp.py``
+"""MLP.  Reference: ``example/image-classification/symbols/mlp.py:1``
 (128-64-num_classes with relu)."""
 
 from typing import Any, Sequence

@@ -1,6 +1,6 @@
 """MobileNet v1/v2.
 
-Reference: ``example/image-classification/symbols/mobilenet.py`` (v1
+Reference: ``example/image-classification/symbols/mobilenet.py:1`` (v1
 depthwise-separable) and ``python/mxnet/gluon/model_zoo/vision/mobilenet.py``
 (v2 inverted residuals).  Depthwise convs lower to XLA grouped convs (the
 reference hand-wrote ``depthwise_convolution_tf.cuh``)."""

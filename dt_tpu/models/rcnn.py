@@ -3,7 +3,7 @@
 Reference: ``example/rcnn/`` — backbone -> RPN (objectness + deltas over
 anchors) -> proposal op -> ROI feature extraction -> classification head
 with per-class box refinement, backed by the contrib ops this framework
-re-implements (``src/operator/contrib/proposal.cc``,
+re-implements (``src/operator/contrib/proposal.cc:1``,
 ``src/operator/contrib/roi_align.cc`` / ``roi_pooling.cc``).
 
 TPU-first shape discipline: the proposal stage emits a FIXED number of

@@ -1,6 +1,6 @@
 """ResNet v1/v2 (ImageNet) and CIFAR ResNet.
 
-Reference: ``example/image-classification/symbols/resnet.py`` (the v2
+Reference: ``example/image-classification/symbols/resnet.py:1`` (the v2
 pre-activation symbol used for the published throughput/convergence baselines,
 BASELINE rows ResNet-152) and ``python/mxnet/gluon/model_zoo/vision/resnet.py``
 (v1 + v2 block zoo).  CIFAR variant (depth 20/56/110, 6n+2 basic blocks,

@@ -1,6 +1,6 @@
 """ResNeXt (aggregated residual transformations).
 
-Reference: ``example/image-classification/symbols/resnext.py`` (Xie et al.
+Reference: ``example/image-classification/symbols/resnext.py:1`` (Xie et al.
 2017).  Grouped 3x3 convs lower to XLA grouped convolution on the MXU."""
 
 from typing import Any, Tuple

@@ -1,6 +1,6 @@
 """SqueezeNet 1.1.
 
-Reference: ``python/mxnet/gluon/model_zoo/vision/squeezenet.py``."""
+Reference: ``python/mxnet/gluon/model_zoo/vision/squeezenet.py:1``."""
 
 from typing import Any
 

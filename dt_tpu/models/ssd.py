@@ -1,6 +1,6 @@
 """SSD single-shot detector.
 
-Reference: ``example/ssd/symbol/symbol_builder.py`` (multi-scale feature
+Reference: ``example/ssd/symbol/symbol_builder.py:1`` (multi-scale feature
 pyramid + per-scale multibox heads), backed by the contrib multibox ops
 (``src/operator/contrib/multibox_{prior,target,detection}.cc``) this
 framework re-implements in ``dt_tpu.ops.detection``.  The reference builds

@@ -1,6 +1,7 @@
 """Transformer language model with pluggable sequence parallelism.
 
-Beyond the reference's RNN ceiling (SURVEY.md §5.7) — the long-context
+Beyond the reference's RNN ceiling (the cuDNN fused LSTM,
+``src/operator/cudnn_rnn-inl.h:1``; SURVEY.md §5.7) — the long-context
 first-class citizen: pre-norm decoder blocks whose attention runs as plain
 full attention (single device), ring attention (``seq_parallel='ring'``), or
 Ulysses all-to-all (``seq_parallel='ulysses'``) over a mesh axis, letting

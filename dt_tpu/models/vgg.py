@@ -1,6 +1,6 @@
 """VGG 11/13/16/19 with optional BN.
 
-Reference: ``example/image-classification/symbols/vgg.py`` and
+Reference: ``example/image-classification/symbols/vgg.py:1`` and
 ``python/mxnet/gluon/model_zoo/vision/vgg.py`` (BASELINE config #4 is
 VGG-16+BN)."""
 

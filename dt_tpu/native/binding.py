@@ -1,4 +1,9 @@
-"""ctypes binding + on-demand build for the native components."""
+"""ctypes binding + on-demand build for the native components.
+
+Reference: the dmlc ctypes bootstrap (``python/mxnet/base.py:1`` loads
+``libmxnet`` and wraps the C API); here the native pieces are small
+(``dt_tpu/native/recordio.cc``, ``predict_capi.cc``) and built on demand
+with the host compiler instead of shipped as one monolith."""
 
 from __future__ import annotations
 

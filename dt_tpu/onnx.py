@@ -1,7 +1,7 @@
 """Self-contained ONNX export/import — no ``onnx`` package required.
 
 Reference surface: ``python/mxnet/contrib/onnx/`` — ``mx2onnx``
-(``onnx/mx2onnx/export_onnx.py``: symbol graph -> ONNX nodes) and
+(``onnx/mx2onnx/export_onnx.py:1``: symbol graph -> ONNX nodes) and
 ``onnx2mx`` (``onnx/onnx2mx/import_onnx.py``: ONNX graph -> symbols).
 The reference leans on the ``onnx`` python package for protobuf
 serialization; this container has none, so serialization is done here

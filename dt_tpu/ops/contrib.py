@@ -1,7 +1,7 @@
 """Remaining contrib ops: adaptive pooling, count sketch, Khatri-Rao,
 FFT packing, quadratic, index_copy.
 
-Reference: ``src/operator/contrib/`` — ``adaptive_avg_pooling.cc``
+Reference: ``src/operator/contrib/`` — ``adaptive_avg_pooling.cc:1``
 (torch-style adaptive average pooling), ``count_sketch.cc`` (the
 compact-bilinear-pooling sketch: signed scatter-add through a hash),
 ``krprod.cc`` (row-wise Kronecker / Khatri-Rao products), ``fft.cc`` /

@@ -1,6 +1,6 @@
 """Custom Python operators inside jitted programs.
 
-Reference: ``src/operator/custom/custom.cc`` + ``python/mxnet/operator.py``
+Reference: ``src/operator/custom/custom.cc:1`` + ``python/mxnet/operator.py``
 (``CustomOp``/``CustomOpProp``) — user-defined forward/backward written in
 Python/numpy, executed via callback from the compiled graph on a dedicated
 thread, with declared output shapes.

@@ -1,6 +1,6 @@
 """Detection ops: anchors, IoU, NMS, multibox matching.
 
-Reference: ``src/operator/contrib/`` detection family — ``multibox_prior.cc``
+Reference: ``src/operator/contrib/`` detection family — ``multibox_prior.cc:1``
 (anchor generation), ``multibox_target.cc`` (anchor<->ground-truth matching +
 loc offsets), ``multibox_detection.cc`` (decode + NMS), ``bounding_box.cc``
 (IoU / box ops) — the C++/CUDA core behind ``example/ssd``.  TPU-first: all

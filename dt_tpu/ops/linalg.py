@@ -1,6 +1,6 @@
 """Linear-algebra operator family.
 
-Reference: ``src/operator/tensor/la_op.cc`` (``_linalg_*``, backed by
+Reference: ``src/operator/tensor/la_op.cc:1`` (``_linalg_*``, backed by
 LAPACK via ``c_lapack_api.h`` / ``linalg_impl.h``): gemm, gemm2, potrf,
 potri, trmm, trsm, sumlogdiag, syrk, gelqf, syevd.  All batched over
 leading dims, lower-triangular convention — semantics below mirror the

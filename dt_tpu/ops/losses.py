@@ -1,6 +1,6 @@
 """Loss ops.
 
-Reference analogs: ``src/operator/softmax_output.cc`` (SoftmaxOutput — the
+Reference analogs: ``src/operator/softmax_output.cc:1`` (SoftmaxOutput — the
 symbol-era classification head), ``src/operator/regression_output.cc``
 (LinearRegressionOutput / LogisticRegressionOutput / MAERegressionOutput),
 ``src/operator/make_loss.cc``, gluon losses (``python/mxnet/gluon/loss.py``).

@@ -2,7 +2,8 @@
 
 Covers the reference's ``src/operator/nn/`` family (Convolution, Deconvolution,
 FullyConnected, BatchNorm, LayerNorm, LRN, Pooling, Activation, Softmax,
-Dropout, Concat, UpSampling — reference ``src/operator/nn/*.cc``, SURVEY.md
+Dropout, Concat, UpSampling — reference ``src/operator/nn/*.cc``, e.g.
+``src/operator/nn/convolution.cc:1`` / ``batch_norm.cc:1``, SURVEY.md
 §2.2) as pure functions.  Design differences from the reference, on purpose:
 
 - NHWC layout by default (TPU/XLA native; the reference is NCHW+cuDNN).

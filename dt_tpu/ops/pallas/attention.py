@@ -1,7 +1,7 @@
 """Flash attention: fused online-softmax attention as a Pallas TPU kernel.
 
 The reference's long-context ceiling is the cuDNN fused RNN
-(``src/operator/cudnn_rnn-inl.h`` — SURVEY §5.7: no attention anywhere in
+(``src/operator/cudnn_rnn-inl.h:1`` — SURVEY §5.7: no attention anywhere in
 the 2018 tree); this framework makes long-context first-class, so the
 single-device attention hot path gets the same treatment the reference
 gave its RNN cells: a hand-fused kernel.  Forward is a Pallas kernel —

@@ -1,7 +1,7 @@
 """Pallas TPU kernels for the paths the reference hand-wrote CUDA for.
 
 Reference targets (SURVEY.md §7 translation table):
-- fused BN + activation epilogue (``src/operator/nn/batch_norm.cu``; cuDNN
+- fused BN + activation epilogue (``src/operator/nn/batch_norm.cu:1``; cuDNN
   fused BN-ReLU)
 - 2-bit gradient quantize/dequantize (``src/kvstore/gradient_compression.cu``)
 - fused LSTM cell pointwise stage (``cudnn_rnn-inl.h`` fused elementwise)

@@ -2,7 +2,7 @@
 
 Reference: ``src/operator/quantization/`` (quantize/dequantize/requantize,
 quantized conv/FC with int32 accumulation, min/max calibration and the
-entropy/KL calibration flow in ``python/mxnet/contrib/quantization.py``).
+entropy/KL calibration flow in ``python/mxnet/contrib/quantization.py:1``).
 TPU-native shape: int8 matmuls/convs hit the MXU at 2x bf16 rate with int32
 accumulation (``preferred_element_type=jnp.int32``); scales are symmetric
 per-tensor like the reference's ``quantize_v2`` int8 path.

@@ -1,6 +1,6 @@
 """Recurrent ops: LSTM/GRU/vanilla cells and fused multi-layer RNN.
 
-Reference: fused RNN operator ``src/operator/rnn.cc`` + ``rnn_impl.h`` (CPU)
+Reference: fused RNN operator ``src/operator/rnn.cc:1`` + ``rnn_impl.h`` (CPU)
 and ``cudnn_rnn-inl.h`` (GPU), modes rnn_relu|rnn_tanh|lstm|gru, with
 multi-layer and bidirectional support.  TPU-native design: the time loop is a
 ``lax.scan`` (single compiled step, no unrolling), the four LSTM gates are one

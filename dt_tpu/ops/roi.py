@@ -2,7 +2,7 @@
 
 Reference semantics covered (re-designed TPU-first, not translated):
 
-- ``src/operator/roi_pooling.cc`` — max ROI pooling with rounded pixel
+- ``src/operator/roi_pooling.cc:1`` — max ROI pooling with rounded pixel
   coordinates, +1 box widths, malformed-ROI 1x1 clamp, empty bins -> 0.
 - ``src/operator/contrib/roi_align.cc`` — average ROI align, bilinear
   sampling on an adaptive (or fixed ``sample_ratio``) grid, roi sizes

@@ -1,6 +1,8 @@
 """Tensor ops with reference semantics worth preserving.
 
-The reference's ``src/operator/tensor/`` (~30K LoC, SURVEY.md §2.2) is almost
+The reference's ``src/operator/tensor/`` (~30K LoC — e.g.
+``src/operator/tensor/indexing_op.cc:1``, ``matrix_op.cc:1``; SURVEY.md
+§2.2) is almost
 entirely subsumed by ``jax.numpy``; this module keeps only the ops whose
 *semantics* differ from numpy or that models/training code calls by the
 reference's names (sequence ops, topk with MXNet conventions, one_hot,

@@ -1,7 +1,7 @@
 """Spatial-warp ops: grid generator, bilinear sampler, spatial transformer,
 FlowNet correlation.
 
-Reference: ``src/operator/grid_generator.cc`` (affine / optical-flow "warp"
+Reference: ``src/operator/grid_generator.cc:1`` (affine / optical-flow "warp"
 sampling grids in [-1, 1] coords), ``src/operator/bilinear_sampler.cc``
 (grid-directed bilinear sampling with zero outside),
 ``src/operator/spatial_transformer.cc`` (affine STN = grid + sampler),

@@ -1,6 +1,6 @@
 """LR schedulers with the reference's semantics.
 
-Reference: ``python/mxnet/lr_scheduler.py`` — FactorScheduler,
+Reference: ``python/mxnet/lr_scheduler.py:1`` — FactorScheduler,
 MultiFactorScheduler, PolyScheduler, CosineScheduler, each with linear/constant
 warmup.  Schedulers are jit-friendly callables ``step -> lr`` (jnp math, no
 Python branches on traced values), so they can live inside the compiled train

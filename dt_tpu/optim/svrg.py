@@ -9,7 +9,7 @@ Functional shape: :class:`SVRG` holds (w_snap, full_grad) in its optax
 state; the trainer refreshes them via :meth:`snapshot` at epoch boundaries.
 The per-step corrected gradient needs ``grad_at_snapshot`` for the SAME
 batch, so the training loop computes grads twice per step (w and w_snap) —
-exactly the reference's dual-executor design (``svrg_module.py``).
+exactly the reference's dual-executor design (``svrg_module.py:1``).
 """
 
 from __future__ import annotations

@@ -146,8 +146,8 @@ def quantize_2bit_best(grad: jax.Array, residual: jax.Array,
 
 
 def _use_pallas_quant() -> bool:
-    import os
-    return os.environ.get("DT_PALLAS_QUANT", "") in ("1", "true")
+    from dt_tpu import config
+    return config.env("DT_PALLAS_QUANT") in ("1", "true")
 
 
 class GradientCompression:

@@ -1,7 +1,8 @@
 """Mixture-of-experts layer with expert parallelism over the mesh.
 
 The reference caps out at data parallelism + manual model parallelism
-(SURVEY §2.3 parallelism inventory); this framework treats distributed
+(``python/mxnet/module/executor_group.py:143`` group2ctx placement;
+SURVEY §2.3 parallelism inventory); this framework treats distributed
 execution as first-class, so the sharding family is completed with
 expert parallelism: experts shard over a mesh axis, and the
 dispatch/combine einsums carry GSPMD-inserted all_to_all-style

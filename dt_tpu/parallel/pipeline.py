@@ -2,7 +2,8 @@
 
 Beyond the reference: its only model parallelism was manual per-layer
 ``group2ctx`` device placement with cross-device copies
-(``example/model-parallel/``, SURVEY.md §2.3) — no microbatch scheduling.
+(``example/model-parallel/``, ``python/mxnet/module/executor_group.py:143``,
+SURVEY.md §2.3) — no microbatch scheduling.
 Here: stages are sharded over a ``pipe`` mesh axis (stage-stacked params,
 leading dim = num_stages), microbatches stream through the ring with
 ``ppermute``, and the whole schedule is one ``lax.scan`` inside ``shard_map``

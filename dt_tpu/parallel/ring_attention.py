@@ -1,6 +1,7 @@
 """Ring attention — sequence/context parallelism over the mesh.
 
-Beyond the reference (its ceiling is bucketed LSTM, SURVEY.md §5.7), but
+Beyond the reference (its ceiling is the bucketed cuDNN LSTM,
+``src/operator/cudnn_rnn-inl.h:1``; SURVEY.md §5.7), but
 first-class here: long sequences shard over a mesh axis, and attention runs
 as a ring — each device holds one query block resident and passes K/V blocks
 around the ring with ``ppermute`` over ICI, accumulating streaming-softmax

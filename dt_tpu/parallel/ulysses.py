@@ -1,7 +1,8 @@
 """Ulysses-style all-to-all sequence parallelism.
 
-The second of the two canonical long-context schemes (DeepSpeed-Ulysses,
-Jacobs et al. 2023): instead of circulating K/V around a ring
+Beyond the reference's RNN ceiling (``src/operator/cudnn_rnn-inl.h:1``,
+SURVEY.md §5.7).  The second of the two canonical long-context schemes
+(DeepSpeed-Ulysses, Jacobs et al. 2023): instead of circulating K/V around a ring
 (``dt_tpu.parallel.ring_attention``), two ``all_to_all`` collectives
 re-partition between sequence-sharded and head-sharded layouts:
 

@@ -1,6 +1,6 @@
 """Public numerical test fixtures.
 
-Reference: ``python/mxnet/test_utils.py`` — the assertion/fixture toolkit
+Reference: ``python/mxnet/test_utils.py:1`` — the assertion/fixture toolkit
 the reference ships as a *public API* (users test their own ops with it):
 ``assert_almost_equal``, ``check_numeric_gradient`` (finite differences),
 ``check_consistency`` (same computation across contexts/dtypes),

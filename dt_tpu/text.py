@@ -1,6 +1,6 @@
 """Text utilities: vocabulary and pretrained token embeddings.
 
-Reference: ``python/mxnet/contrib/text/`` — ``vocab.py`` (``Vocabulary``:
+Reference: ``python/mxnet/contrib/text/`` — ``vocab.py:1`` (``Vocabulary``:
 frequency-sorted indexing with unknown + reserved tokens), ``embedding.py``
 (``CustomEmbedding``/glove-style ``.vec`` file loading,
 ``get_vecs_by_tokens``, attaching vectors to a vocabulary).

@@ -1,6 +1,6 @@
 """Evaluation metric zoo.
 
-Reference: ``python/mxnet/metric.py`` (1,424 LoC — EvalMetric base with
+Reference: ``python/mxnet/metric.py:1`` (1,424 LoC — EvalMetric base with
 update/reset/get, Accuracy, TopKAccuracy, F1, MAE/MSE/RMSE, CrossEntropy,
 NegativeLogLikelihood, Perplexity, CompositeEvalMetric, CustomMetric,
 ``metric.create``).  Updates take numpy/jax arrays; accumulation is

@@ -1,6 +1,6 @@
 """Monitor: tap intermediate layer outputs during forward passes.
 
-Reference: ``python/mxnet/monitor.py`` — installs an executor callback that
+Reference: ``python/mxnet/monitor.py:1`` — installs an executor callback that
 applies ``stat_func`` to every op output matching a pattern, printed via
 ``toc_print``.  Flax-native: ``linen.Module.apply(...,
 capture_intermediates=...)`` collects the intermediates in one pass; the

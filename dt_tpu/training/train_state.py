@@ -1,7 +1,9 @@
 """TrainState: the complete training state as one pytree.
 
-Replaces the reference's scattered state (executor arg_params on workers +
-optimizer state on parameter servers + aux params under server keys >= 10M).
+Replaces the reference's scattered state (executor arg_params on workers,
+``python/mxnet/module/base_module.py:497``; optimizer state on parameter
+servers, ``src/kvstore/kvstore_dist_server.h:240-273``; aux params under
+server keys >= 10M).
 Having it in ONE pytree is what makes elastic resharding and full
 checkpointing (closing the reference's lost-server-state gap, SURVEY.md §5.4)
 trivial: snapshot/restore is a tree (de)serialization.

@@ -1,6 +1,6 @@
 """Model summaries + computation-graph rendering.
 
-Reference: ``python/mxnet/visualization.py`` (``print_summary`` layer table
+Reference: ``python/mxnet/visualization.py:1`` (``print_summary`` layer table
 ``:25``; ``plot_network`` graphviz ``:198``).  ``print_summary`` maps to
 flax's tabulate.  ``plot_network`` here renders the TRACED JAXPR of the
 model's forward as Graphviz dot source — the jaxpr is the TPU-side analog

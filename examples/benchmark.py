@@ -95,11 +95,14 @@ def main():
                        out_shardings=(NamedSharding(mesh, P()),
                                       NamedSharding(mesh, P())))
         state, loss = step(state, x, y)   # compile + warmup
-        jax.block_until_ready(loss)
+        jax.block_until_ready((state, loss))
         t0 = time.perf_counter()
         for _ in range(args.iters):
             state, loss = step(state, x, y)
-        jax.block_until_ready(loss)
+        # block on the FULL output state: block_until_ready(loss) can
+        # return while queued programs still execute (CLAUDE.md axon
+        # timing gotcha)
+        jax.block_until_ready((state, loss))
         dt = time.perf_counter() - t0
         ips = batch * args.iters / dt
         if base is None:

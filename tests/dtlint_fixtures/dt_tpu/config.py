@@ -1,0 +1,7 @@
+"""DT005 fixture registry (stands in for dt_tpu/config.py when the
+fixture tree is linted as its own root; reference analog
+``ps-lite/src/postoffice.cc:18-31``)."""
+
+ENV_REGISTRY = {
+    "DT_DECLARED": ("", "a declared knob the good fixture reads"),
+}

@@ -1,0 +1,19 @@
+"""DT001 fixture (bad): literal BlockSpec that cannot tile (8, 128) on
+real TPU, and a reduction over unsigned ints inside a Pallas kernel."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kern(x_ref, o_ref):
+    # Mosaic has no unsigned reductions on real TPU
+    o_ref[:] = jnp.sum(x_ref[:].astype(jnp.uint32), axis=1, keepdims=True)
+
+
+def run(x):
+    return pl.pallas_call(
+        _kern,
+        out_shape=jax.ShapeDtypeStruct((64, 100), jnp.uint32),
+        in_specs=[pl.BlockSpec((4, 100), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((4, 100), lambda i: (i, 0)),
+    )(x)

@@ -1,0 +1,21 @@
+"""DT001 fixture (good): (8, 128)-tiled literal blocks, symbolic dims for
+array-shaped blocks, and the int32-pack idiom for unsigned data."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kern(x_ref, o_ref):
+    # pack via int32: disjoint 2-bit fields, carry-free, bit-identical
+    codes = x_ref[:].astype(jnp.int32)
+    o_ref[:] = jnp.sum(codes, axis=1, keepdims=True, dtype=jnp.int32)
+
+
+def run(x, rows, cols):
+    return pl.pallas_call(
+        _kern,
+        out_shape=jax.ShapeDtypeStruct((64, 128), jnp.int32),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                  pl.BlockSpec((rows, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((16, 256), lambda i: (i, 0)),
+    )(x)

@@ -1,0 +1,7 @@
+"""DT003 fixture (bad): unconditional donation — segfaults on XLA CPU
+with multi-device collectives (jax 0.9.0)."""
+import jax
+
+
+def build(train_step):
+    return jax.jit(train_step, donate_argnums=(0,))

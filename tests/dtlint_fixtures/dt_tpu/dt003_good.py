@@ -1,0 +1,12 @@
+"""DT003 fixture (good): donation gated on the backend (and the
+donate-nothing literal)."""
+import jax
+
+
+def build(train_step):
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(train_step, donate_argnums=donate)
+
+
+def build_nodonate(train_step):
+    return jax.jit(train_step, donate_argnums=())

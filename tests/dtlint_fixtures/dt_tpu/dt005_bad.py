@@ -1,0 +1,8 @@
+"""DT005 fixture (bad): reads an env knob nobody declared."""
+import os
+
+
+def flag():
+    # also read the declared one so the bad-file run has no dead entries
+    os.environ.get("DT_DECLARED")
+    return os.environ.get("DT_UNDECLARED", "") == "1"

@@ -1,0 +1,6 @@
+"""DT005 fixture (dead-entry arm): reads nothing, so linting ONLY this
+file leaves the registry's DT_DECLARED entry with no reader."""
+
+
+def nothing():
+    return None

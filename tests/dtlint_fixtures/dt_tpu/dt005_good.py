@@ -1,0 +1,6 @@
+"""DT005 fixture (good): every DT_* read is declared in the registry."""
+import os
+
+
+def flag():
+    return os.environ.get("DT_DECLARED", "") == "1"

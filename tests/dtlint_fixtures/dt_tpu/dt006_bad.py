@@ -1,0 +1,23 @@
+"""DT006 fixture (bad): a guarded attribute touched outside its lock."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._members = []  # guarded-by: _lock
+
+    def add(self, host):
+        with self._lock:
+            self._members.append(host)
+
+    def racy_len(self):
+        return len(self._members)
+
+    def racy_closure(self):
+        with self._lock:
+            # defining the closure under the lock does NOT guard its body
+            def later():
+                return list(self._members)
+        return later

@@ -1,0 +1,26 @@
+"""DT006 fixture (good): every access under the lock, through the
+Condition alias, or in a caller-holds-the-lock method."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._members = []  # guarded-by: _lock
+
+    def add(self, host):
+        with self._lock:
+            self._members.append(host)
+
+    def wait_nonempty(self):
+        with self._cv:  # the Condition wraps the same lock
+            while not self._members:
+                self._cv.wait()
+
+    def _evict_locked(self, host):
+        self._members.remove(host)
+
+    def snapshot(self):
+        """Caller holds the lock."""
+        return list(self._members)

@@ -1,0 +1,5 @@
+"""A public module whose docstring never cites its reference files."""
+
+
+def f():
+    return 1

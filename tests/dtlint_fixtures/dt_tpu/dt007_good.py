@@ -1,0 +1,5 @@
+"""A public module citing its reference (``src/kvstore/kvstore_dist.h:59``)."""
+
+
+def f():
+    return 1

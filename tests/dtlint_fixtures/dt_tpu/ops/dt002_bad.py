@@ -1,0 +1,11 @@
+"""DT002 fixture (bad): f32 accumulation downcast inside an op — breaks
+the conv/dot transpose under bf16 autodiff."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dense(x, w):
+    return lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)

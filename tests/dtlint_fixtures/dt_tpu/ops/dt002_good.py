@@ -1,0 +1,22 @@
+"""DT002 fixture (good): let the MXU accumulate f32 natively (no
+preferred_element_type downcast); int32 accumulation for int8 is fine."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dense(x, w):
+    return lax.dot_general(x, w, (((1,), (0,)), ((), ())))
+
+
+def int8_dense(x, w):
+    # integer accumulation is not the bf16 transpose hazard
+    return lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def f32_out(x, w):
+    # astype(f32) after f32 accumulation is a no-op, not a downcast
+    return lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.float32)

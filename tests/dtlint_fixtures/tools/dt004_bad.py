@@ -1,0 +1,13 @@
+"""DT004 fixture (bad): timing a step but blocking only on the scalar
+loss — queued programs may still be executing when it returns."""
+import time
+
+import jax
+
+
+def bench(step, state, x, y, iters):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+    return time.perf_counter() - t0

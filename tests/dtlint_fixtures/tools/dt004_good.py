@@ -1,0 +1,13 @@
+"""DT004 fixture (good): block on the FULL output state before reading
+the clock."""
+import time
+
+import jax
+
+
+def bench(step, state, x, y, iters):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, x, y)
+    jax.block_until_ready((state, loss))
+    return time.perf_counter() - t0

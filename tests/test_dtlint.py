@@ -1,0 +1,221 @@
+"""dtlint — the repo's own invariants, enforced in tier-1.
+
+Covers: the repo-wide zero-finding gate (with the checked-in baseline),
+per-rule fixture pairs (bad fires / good silent), determinism, the
+suppression and baseline round-trips, and the acceptance scenario of
+un-guarding a field in a fixture copy of the real scheduler.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dt_tpu.analysis import Baseline, all_rules, run
+from dt_tpu.analysis.engine import DEFAULT_PATHS
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "dtlint_fixtures")
+
+
+def _lint(paths, select=None, root=FIXTURES):
+    return run(root, paths=paths, select={select} if select else None)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the repo itself is clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_after_baseline():
+    findings = run(ROOT, paths=DEFAULT_PATHS)
+    baseline = Baseline.load(os.path.join(ROOT, "dtlint_baseline.txt"))
+    live = [f for f in findings if not baseline.covers(f)]
+    assert not live, "non-baselined dtlint findings:\n" + \
+        "\n".join(f.render() for f in live)
+    stale = baseline.stale(findings)
+    assert not stale, f"stale baseline entries (delete them): {stale}"
+
+
+def test_two_runs_identical_ordering():
+    a = run(ROOT, paths=DEFAULT_PATHS)
+    b = run(ROOT, paths=DEFAULT_PATHS)
+    assert [f.render() for f in a] == [f.render() for f in b]
+
+
+def test_cli_exits_zero(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--no-cache"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixture pairs
+# ---------------------------------------------------------------------------
+
+_PAIRS = [
+    ("DT001", "dt_tpu/dt001_bad.py", "dt_tpu/dt001_good.py"),
+    ("DT002", "dt_tpu/ops/dt002_bad.py", "dt_tpu/ops/dt002_good.py"),
+    ("DT003", "dt_tpu/dt003_bad.py", "dt_tpu/dt003_good.py"),
+    ("DT004", "tools/dt004_bad.py", "tools/dt004_good.py"),
+    ("DT005", "dt_tpu/dt005_bad.py", "dt_tpu/dt005_good.py"),
+    ("DT006", "dt_tpu/dt006_bad.py", "dt_tpu/dt006_good.py"),
+    ("DT007", "dt_tpu/dt007_bad.py", "dt_tpu/dt007_good.py"),
+]
+
+
+@pytest.mark.parametrize("rule,bad,good", _PAIRS)
+def test_rule_fires_on_bad_and_not_on_good(rule, bad, good):
+    bad_findings = _lint([bad], select=rule)
+    assert any(f.rule == rule for f in bad_findings), \
+        f"{rule} did not fire on {bad}"
+    good_findings = _lint([good], select=rule)
+    assert not good_findings, \
+        f"{rule} false positives on {good}:\n" + \
+        "\n".join(f.render() for f in good_findings)
+
+
+def test_dt001_flags_both_tiling_and_unsigned_reduction():
+    msgs = [f.message for f in _lint(["dt_tpu/dt001_bad.py"],
+                                     select="DT001")]
+    assert any("BlockSpec" in m for m in msgs), msgs
+    assert any("unsigned" in m for m in msgs), msgs
+
+
+def test_dt005_dead_entry_arm(tmp_path):
+    """Dead-entry findings only fire on a full-default-scope run: build a
+    tree whose registry declares DT_DECLARED but where nothing reads it."""
+    root = tmp_path / "dead"
+    (root / "dt_tpu").mkdir(parents=True)
+    for name in ("config.py", "dt005_dead.py"):
+        (root / "dt_tpu" / name).write_text(
+            open(os.path.join(FIXTURES, "dt_tpu", name)).read())
+    findings = run(str(root), paths=DEFAULT_PATHS, select={"DT005"})
+    assert any("dead registry entry" in f.message and
+               "DT_DECLARED" in f.message for f in findings), findings
+
+
+def test_dt005_dead_entry_arm_skipped_on_path_subset():
+    """Linting a subset must NOT report knobs whose readers are merely
+    outside the subset (the `dtlint dt_tpu/elastic`-style invocation)."""
+    findings = _lint(["dt_tpu/dt005_dead.py"], select="DT005")
+    assert not findings, [f.render() for f in findings]
+
+
+def test_dt006_closure_does_not_inherit_lock():
+    findings = _lint(["dt_tpu/dt006_bad.py"], select="DT006")
+    # both the plain unguarded read and the under-lock-defined closure
+    assert len(findings) >= 2, [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# DT006 acceptance: un-guard a field in a fixture copy of the REAL scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_dt006_scheduler_copy_detects_unguarded_access(tmp_path):
+    src = open(os.path.join(ROOT, "dt_tpu", "elastic",
+                            "scheduler.py")).read()
+    fixture_root = tmp_path / "fr"
+    pkg = fixture_root / "dt_tpu" / "elastic"
+    pkg.mkdir(parents=True)
+    (pkg / "scheduler.py").write_text(src)
+    clean = run(str(fixture_root), paths=["dt_tpu"], select={"DT006"})
+    assert not clean, None if not clean else \
+        "\n".join(f.render() for f in clean)
+
+    # move an access outside the lock: a new method reads the guarded
+    # live set with no 'with self._lock' — the quick-restart-race class
+    # of bug this rule exists to catch
+    racy = src.replace(
+        "    def _append_log(self, action: str, host: str):",
+        "    def _racy_membership(self):\n"
+        "        return list(self._workers)\n\n"
+        "    def _append_log(self, action: str, host: str):")
+    assert "_racy_membership" in racy
+    (pkg / "scheduler.py").write_text(racy)
+    findings = run(str(fixture_root), paths=["dt_tpu"], select={"DT006"})
+    assert any("_workers" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+    # equivalently: deleting the guarded-by annotation must not crash and
+    # silences the rule for that attribute (annotation IS the contract)
+    unannotated = racy.replace(
+        "self._workers: List[str] = list(initial_workers or [])  "
+        "# guarded-by: _lock",
+        "self._workers: List[str] = list(initial_workers or [])")
+    (pkg / "scheduler.py").write_text(unannotated)
+    findings = run(str(fixture_root), paths=["dt_tpu"], select={"DT006"})
+    assert not any("_workers" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_comment_silences_finding(tmp_path):
+    root = tmp_path / "s"
+    (root / "dt_tpu").mkdir(parents=True)
+    bad = open(os.path.join(FIXTURES, "dt_tpu", "dt003_bad.py")).read()
+    bad = bad.replace("donate_argnums=(0,))",
+                      "donate_argnums=(0,))  # dtlint: ignore[DT003]")
+    (root / "dt_tpu" / "mod.py").write_text(bad)
+    assert not run(str(root), paths=["dt_tpu"], select={"DT003"})
+    # an ignore listing a DIFFERENT rule does not silence it
+    other = bad.replace("ignore[DT003]", "ignore[DT001]")
+    (root / "dt_tpu" / "mod.py").write_text(other)
+    assert run(str(root), paths=["dt_tpu"], select={"DT003"})
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = _lint(["dt_tpu/dt003_bad.py"], select="DT003")
+    assert findings
+    path = str(tmp_path / "baseline.txt")
+    Baseline().save(path, findings,
+                    reasons={f.key: "fixture grandfather"
+                             for f in findings})
+    loaded = Baseline.load(path)
+    assert all(loaded.covers(f) for f in findings)
+    assert not loaded.stale(findings)
+    # an entry whose line was fixed shows up as stale
+    assert loaded.stale([]) == sorted({f.key for f in findings})
+
+
+def test_baseline_requires_reason(tmp_path):
+    path = tmp_path / "b.txt"
+    path.write_text("DT003\tdt_tpu/mod.py\tjax.jit(f, donate_argnums=(0,))\n")
+    with pytest.raises(ValueError, match="reason"):
+        Baseline.load(str(path))
+
+
+# ---------------------------------------------------------------------------
+# tooling invariants that ride along with the linter
+# ---------------------------------------------------------------------------
+
+
+def test_rule_ids_unique_and_documented():
+    rules = all_rules()
+    ids = [r.id for r in rules]
+    assert len(set(ids)) == len(ids) == 7
+    catalog = open(os.path.join(ROOT, "docs", "dtlint_rules.md")).read()
+    for r in rules:
+        assert r.id in catalog, f"{r.id} missing from docs/dtlint_rules.md"
+
+
+def test_bench_and_chaos_run_import_without_side_effects():
+    """bench.py and tools/chaos_run.py must be importable (the linter and
+    tooling load them); importing must not spawn work."""
+    import importlib.util
+    for rel in ("bench.py", os.path.join("tools", "chaos_run.py")):
+        name = "_dtlint_import_" + os.path.basename(rel)[:-3]
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(ROOT, rel))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert callable(getattr(mod, "main")), rel

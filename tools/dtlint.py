@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""dtlint — project-invariant static analysis for dt_tpu.
+
+The reference's ``make cpplint``/``make pylint`` gate (reference
+``Makefile:140-160``) for this tree: walks the repo, runs the DT001-DT007
+rules (``dt_tpu/analysis/``), and reports findings as
+``path:line: RULEID message [hint: ...]``.
+
+Usage::
+
+    python tools/dtlint.py                  # default scope, baseline applied
+    python tools/dtlint.py dt_tpu/elastic   # explicit paths
+    python tools/dtlint.py --select DT006   # one rule
+    python tools/dtlint.py --no-baseline    # full finding set
+    python tools/dtlint.py --write-baseline # grandfather current findings
+    python tools/dtlint.py --list-rules
+
+Exit codes: 0 clean (after baseline), 1 findings (or stale baseline
+entries), 2 usage/internal error.  Per-line suppression:
+``# dtlint: ignore[DT001]``.  Baseline: ``dtlint_baseline.txt`` at the
+repo root — every entry needs a ``# reason:`` line.
+"""
+
+import argparse
+import json
+import os
+import sys
+import types
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_analysis():
+    """Import dt_tpu.analysis WITHOUT executing dt_tpu/__init__.py (which
+    pulls the ops surface and therefore jax): register a path-only shim
+    for the parent package first.  Under pytest dt_tpu is already real
+    and the shim is skipped."""
+    if "dt_tpu" not in sys.modules:
+        if _ROOT not in sys.path:
+            sys.path.insert(0, _ROOT)
+        shim = types.ModuleType("dt_tpu")
+        shim.__path__ = [os.path.join(_ROOT, "dt_tpu")]
+        sys.modules["dt_tpu"] = shim
+    import dt_tpu.analysis as analysis
+    return analysis
+
+
+_CACHE_NAME = ".dtlint_cache.json"
+
+
+def _tree_signature(root, relpaths):
+    return {p: list(os.stat(os.path.join(root, p))[6:9:2])  # size, mtime
+            for p in relpaths}
+
+
+def _cached_findings(analysis, root, paths, select):
+    """Whole-tree result cache: reused only when every linted file AND
+    every cross-file input (PARITY.md, the DT005 registry in
+    dt_tpu/config.py, the rule engine's own sources) is byte-identical
+    by (size, mtime) — cross-file rules make per-file caching unsound."""
+    import glob
+    from dt_tpu.analysis.engine import iter_python_files
+    relpaths = iter_python_files(root, paths)
+    sig = {"paths": list(paths), "select": sorted(select or []),
+           "files": _tree_signature(root, relpaths)}
+    extras = ["PARITY.md", "dt_tpu/config.py", "tools/dtlint.py"]
+    extras += sorted(
+        os.path.relpath(p, root) for p in glob.glob(
+            os.path.join(root, "dt_tpu", "analysis", "*.py")))
+    for extra in extras:
+        if os.path.exists(os.path.join(root, extra)):
+            sig["files"][extra] = _tree_signature(root, [extra])[extra]
+    cache_path = os.path.join(root, _CACHE_NAME)
+    try:
+        with open(cache_path) as f:
+            cached = json.load(f)
+        if cached.get("sig") == sig:
+            return [analysis.Finding(**fi) for fi in cached["findings"]], sig
+    except (OSError, ValueError, TypeError, KeyError):
+        pass
+    return None, sig
+
+
+def _store_cache(root, sig, findings):
+    try:
+        with open(os.path.join(root, _CACHE_NAME), "w") as f:
+            json.dump({"sig": sig,
+                       "findings": [vars(fi) for fi in findings]}, f)
+    except OSError:
+        pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="dtlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: dt_tpu tools "
+                         "examples bench.py __graft_entry__.py)")
+    ap.add_argument("--root", default=_ROOT)
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: <root>/dtlint_baseline"
+                         ".txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather the current findings into the "
+                         "baseline file and exit 0")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="RULE", help="run only these rule ids")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per finding")
+    ap.add_argument("--no-cache", action="store_true")
+    args = ap.parse_args(argv)
+
+    analysis = _import_analysis()
+    if args.list_rules:
+        for r in analysis.all_rules():
+            print(f"{r.id} {r.name}: {(r.__doc__ or '').strip().splitlines()[0]}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    paths = args.paths or None
+    select = set(args.select) if args.select else None
+    from dt_tpu.analysis.engine import DEFAULT_PATHS
+    eff_paths = list(paths if paths is not None else DEFAULT_PATHS)
+
+    findings = None
+    sig = None
+    if not args.no_cache:
+        findings, sig = _cached_findings(analysis, root, eff_paths, select)
+    if findings is None:
+        findings = analysis.run(root, paths=eff_paths, select=select)
+        if sig is not None:
+            _store_cache(root, sig, findings)
+
+    baseline_path = args.baseline or os.path.join(root,
+                                                  "dtlint_baseline.txt")
+    if args.write_baseline:
+        analysis.Baseline.load(baseline_path).save(baseline_path, findings)
+        print(f"wrote {len(set(f.key for f in findings))} baseline "
+              f"entries to {baseline_path}")
+        return 0
+
+    baseline = analysis.Baseline() if args.no_baseline else \
+        analysis.Baseline.load(baseline_path)
+    reported = [f for f in findings if not baseline.covers(f)]
+    stale = [] if args.no_baseline else baseline.stale(findings)
+
+    for f in reported:
+        print(json.dumps(vars(f)) if args.json else f.render())
+    for key in stale:
+        print(f"{baseline_path}: stale baseline entry (fixed or moved — "
+              f"delete it): {' | '.join(key)}")
+    n_base = sum(1 for f in findings if baseline.covers(f))
+    if reported or stale:
+        print(f"dtlint: {len(reported)} finding(s), {n_base} baselined, "
+              f"{len(stale)} stale baseline entr(y/ies)", file=sys.stderr)
+        return 1
+    print(f"dtlint: clean ({n_base} baselined finding(s), "
+          f"{len(findings) - n_base} live)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

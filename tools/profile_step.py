@@ -56,7 +56,10 @@ def build_step(net, batch, size):
             loss_of, has_aux=True)(state.params)
         return state.apply_gradients(grads).replace(batch_stats=stats), loss
 
-    step = jax.jit(train_step, donate_argnums=(0,))
+    # donation segfaults on XLA CPU with multi-device collectives
+    # (CLAUDE.md gotcha; DT_FORCE_CPU runs land here too)
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    step = jax.jit(train_step, donate_argnums=donate)
     return step, state, x, y
 
 
