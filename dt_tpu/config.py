@@ -67,6 +67,9 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "DT_WORKER_ID": ("", "this worker's host identity under the launcher env contract"),
     "DT_RECOVERY": ("", "1 = re-register under the old identity after a crash (restart wrapper)"),
     "DT_SERVER_ID": ("0", "range-server index under the launcher env contract"),
+    # observability (dt_tpu/obs)
+    "DT_OBS": ("", "1 = enable dt_tpu.obs tracing (span/event ring buffer + heartbeat export)"),
+    "DT_OBS_RING": (str(4096), "obs ring-buffer capacity (records per tracer; overflow drops oldest)"),
     # fault injection / chaos
     "DT_FAULT_PLAN": ("", "fault-plan JSON (or @/path) for subprocess workers (elastic/faults.py)"),
     "DT_DROP_MSG": ("", "percent of received control messages to drop (ps-lite PS_DROP_MSG fuzz)"),

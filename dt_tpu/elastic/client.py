@@ -21,8 +21,16 @@ import numpy as np
 
 from dt_tpu import config
 from dt_tpu.elastic import faults, protocol
+from dt_tpu.obs import trace as obs_trace
 
 logger = logging.getLogger("dt_tpu.elastic")
+
+#: pending (unacked) obs records kept across failed flushes before the
+#: oldest are shed — the scheduler-side per-track ring bounds it anyway
+_OBS_PENDING_MAX = 8192
+#: records per flush message (bounded bites: a post-outage backlog drains
+#: over a few heartbeats instead of one oversized frame)
+_OBS_FLUSH_MAX = 2048
 
 
 def _row_bounds(n: int, r: int) -> List[int]:
@@ -76,6 +84,46 @@ class WorkerClient:
         # not replay a long-finished profiling session's command history
         self._prof_seq = int(resp.get("profile_seq", 0))  # guarded-by: _prof_lock
         self._prof_lock = threading.Lock()  # heartbeat vs caller thread
+        # obs export (dt_tpu/obs): span records drain from the process
+        # tracer into a pending batch that rides the next heartbeat; the
+        # batch is cleared only once the scheduler confirmed receipt
+        # (at-least-once — the scheduler dedups by record rseq), so a
+        # dropped heartbeat loses nothing.  The incarnation id (pid)
+        # names this process's track; a quick-restarted worker gets a
+        # fresh track instead of splicing into its dead predecessor's.
+        self._obs_inc = os.getpid()
+        self._obs_lock = threading.Lock()
+        self._obs_pending: list = []  # guarded-by: _obs_lock
+        self._obs_shed = 0  # pending-overflow drops; guarded-by: _obs_lock
+        self._obs_fseq = 0  # flush-payload seq (counter ordering); guarded-by: _obs_lock
+        # Export eligibility is captured at CONSTRUCTION (the launcher
+        # model: DT_OBS is set before workers start).  The process tracer
+        # is shared, so a client built while tracing was off must never
+        # become an exporter later — its heartbeat would drain records
+        # that belong to the one client constructed as the process's
+        # worker (in-process test fleets leave heartbeat threads running).
+        self._obs_export = obs_trace.enabled()
+        self._obs_hook = None
+        if self._obs_export:
+            # an injected crash (os._exit) flushes through this hook so
+            # the dying incarnation's timeline still reaches the job
+            # dump.  Weak reference: an abandoned client (e.g. the
+            # WorkerRemoved exit path skipping close()) must stay
+            # collectable, and a dead client's hook must not fire
+            # blocking wire requests inside someone else's crash flush.
+            import weakref
+            _wm = weakref.WeakMethod(self.obs_flush)
+
+            def _flush_hook(_wm=_wm):
+                fn = _wm()
+                if fn is None:
+                    # owner was GC'd without close(): self-prune so
+                    # dead entries don't accumulate across client churn
+                    obs_trace.unregister_flush(_flush_hook)
+                    return
+                fn()
+            self._obs_hook = _flush_hook
+            obs_trace.register_flush(self._obs_hook)
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval_s,),
@@ -165,16 +213,90 @@ class WorkerClient:
                     # profile_command could send a stale pseq and replay
                     # an already-applied command on this worker
                     pseq = self._prof_seq
+                msg = {"cmd": "heartbeat", "host": self.host, "pseq": pseq}
+                # span rings piggyback on the heartbeat (the channel
+                # profiler control already rides); cleared only on ack
+                payload = self._obs_payload() if self._obs_export \
+                    and obs_trace.enabled() else None
+                if payload is not None:
+                    msg["obs"] = payload
                 # retries=1: a lost heartbeat is superseded by the next
                 # interval's; a long retry loop would only delay close()
-                resp = self._req({"cmd": "heartbeat", "host": self.host,
-                                  "pseq": pseq}, timeout=10,
-                                 retries=1)
+                if obs_trace.enabled():
+                    obs_trace.tracer().counter("heartbeat.sent")
+                resp = self._req(msg, timeout=10, retries=1)
+                if payload is not None:
+                    self._obs_ack(payload)
                 for c in resp.get("profile_cmds", []):
                     self._apply_profile_cmd(c)
             except (OSError, RuntimeError):
                 pass  # scheduler gone; dead-node detection is its problem
             self._stop.wait(interval)
+
+    # -- obs export (dt_tpu/obs; rides the heartbeat like profiler
+    # control, kvstore_dist.h:102-110) ------------------------------------
+
+    def _obs_payload(self) -> Optional[dict]:
+        """Drain the process tracer into the pending batch and return the
+        flush payload (None when there is nothing to ship).  Pending is
+        cleared only by :meth:`_obs_ack` — at-least-once, dedup'd
+        scheduler-side by record rseq."""
+        tr = obs_trace.tracer()
+        with self._obs_lock:
+            self._obs_pending.extend(tr.drain())
+            over = len(self._obs_pending) - _OBS_PENDING_MAX
+            if over > 0:
+                # counted: the summary's drop column must admit timeline
+                # loss (same invariant as the scheduler-side truncation)
+                self._obs_shed += over
+                del self._obs_pending[:over]
+            if not self._obs_pending:
+                return None
+            # bounded bite: ship the oldest _OBS_FLUSH_MAX; the ack
+            # removes exactly those (by rseq) and the rest ride the
+            # following heartbeats.  fseq orders the counter/dropped
+            # gauges: a stale heartbeat delivered AFTER the close-flush
+            # must not roll them back (the scheduler applies only newer
+            # fseq; records have their own rseq dedup)
+            self._obs_fseq += 1
+            return {"inc": self._obs_inc, "fseq": self._obs_fseq,
+                    "records": list(self._obs_pending[:_OBS_FLUSH_MAX]),
+                    "counters": tr.counters(),
+                    "dropped": tr.dropped() + self._obs_shed}
+
+    def _obs_ack(self, payload: dict) -> None:
+        """The scheduler confirmed ``payload``: drop its records from the
+        pending batch (by rseq — records appended since stay)."""
+        if not payload.get("records"):
+            return
+        last = payload["records"][-1][1]
+        with self._obs_lock:
+            self._obs_pending = [r for r in self._obs_pending
+                                 if r[1] > last]
+
+    def obs_flush(self, timeout: float = 2.0) -> None:
+        """Synchronous best-effort flush over ``obs_push`` (NOT a
+        heartbeat, so heartbeat-scoped fault rules can't eat the final
+        batch).  Called from :meth:`close` and — via the registered obs
+        flush hook — from an injected ``os._exit`` crash.  The timeout
+        is short and the first failure aborts the loop: a hung scheduler
+        must not stall a closing (or dying) worker for long — the
+        "long retry loop would only delay close()" hazard the heartbeat
+        path's retries=1 guards against."""
+        if not (self._obs_export and obs_trace.enabled()):
+            return
+        # bounded-bite payloads: loop until the pending batch is empty
+        # (a post-outage backlog is at most _OBS_PENDING_MAX records)
+        for _ in range(1 + _OBS_PENDING_MAX // _OBS_FLUSH_MAX):
+            payload = self._obs_payload()
+            if payload is None:
+                return
+            try:
+                self._req({"cmd": "obs_push", "host": self.host,
+                           "obs": payload}, timeout=timeout, retries=1)
+                self._obs_ack(payload)
+            except (OSError, RuntimeError):
+                return  # observability is never fatal
 
     def _apply_profile_cmd(self, c: dict) -> None:
         """Apply one remote profiler command locally (rank-prefixed output),
@@ -203,6 +325,10 @@ class WorkerClient:
         workers apply at their next heartbeat.  ``post_seq`` makes
         at-least-once retries idempotent on the scheduler."""
         self._prof_post = getattr(self, "_prof_post", 0) + 1
+        if obs_trace.enabled():
+            # the ad-hoc post counter, mirrored as an obs counter (the
+            # _prof_post int itself stays — it is the retry-dedup key)
+            obs_trace.tracer().counter("profiler.posts")
         seq = self._req({"cmd": "profile", "action": action,
                          "params": params or {}, "host": self.host,
                          "post_seq": self._prof_post})["seq"]
@@ -221,8 +347,12 @@ class WorkerClient:
         # the epoch-boundary window: a crash HERE (before the scheduler
         # sees our arrival) is the quick-restart re-admission race's trigger
         faults.crash_point("client.mc_barrier", host=self.host, epoch=epoch)
+        t0 = obs_trace.tracer().now()
         resp = self._req({"cmd": "mc_barrier", "host": self.host,
                           "epoch": epoch, "info": info})
+        obs_trace.tracer().complete_span(
+            "mc_barrier", t0,
+            {"epoch": epoch, "removed": bool(resp.get("you_are_removed"))})
         if resp.get("you_are_removed"):
             raise WorkerRemoved(self.host)
         self.workers = resp["workers"]
@@ -239,6 +369,7 @@ class WorkerClient:
         epoch in lockstep.  The scheduler bumps our stale ``resume_epoch``
         to its live barrier, so re-sending is safe."""
         deadline = time.time() + timeout_s
+        t0 = obs_trace.tracer().now()
         while self.recovery_pending:
             if time.time() > deadline:
                 raise TimeoutError("recovery re-admission timed out")
@@ -256,6 +387,9 @@ class WorkerClient:
                 self.workers = resp["workers"]
                 self.rank = resp["rank"]
                 self.recovery_pending = False
+                obs_trace.tracer().complete_span(
+                    "recovery.rejoin", t0,
+                    {"epoch": int(resp["epoch"]), "rank": self.rank})
                 return int(resp["epoch"])
             # a removal won this barrier; recovery stays queued
         return self.resume_epoch
@@ -323,6 +457,22 @@ class WorkerClient:
 
     def allreduce(self, key: str, value, _route: Optional[int] = None
                   ) -> np.ndarray:
+        """Exact average across live workers — see :meth:`_allreduce`.
+        This wrapper only adds the obs span: one ``allreduce`` record per
+        TOP-LEVEL round (chunk sub-rounds ride inside it; their transport
+        shows up as ``wire.request`` spans)."""
+        if _route is None and obs_trace.enabled():
+            tr = obs_trace.tracer()
+            t0 = tr.now()
+            try:
+                return self._allreduce(key, value, _route)
+            finally:
+                tr.counter("allreduce.rounds")
+                tr.complete_span("allreduce", t0, {"key": key})
+        return self._allreduce(key, value, _route)
+
+    def _allreduce(self, key: str, value, _route: Optional[int] = None
+                   ) -> np.ndarray:
         """Exact average across live workers (CPU-cluster data plane; on a
         TPU pod gradients ride ICI inside the jit step instead).  ``value``
         is an array, or a ``{"packed", "n", "threshold"}`` dict for
@@ -360,12 +510,17 @@ class WorkerClient:
                 thr = float(value["threshold"])
                 base = zlib.crc32(key.encode())
                 chunks = packed_chunks(packed, n, per)
+                if obs_trace.enabled():
+                    obs_trace.tracer().event(
+                        "allreduce.chunked",
+                        {"key": key, "chunks": len(chunks), "per": per,
+                         "compressed": True})
                 parts = self._stream_chunks([
                     (lambda i=i, words=words, cn=cn:
-                     self.allreduce(f"{key}#c{i}",
-                                    {"packed": words, "n": cn,
-                                     "threshold": thr},
-                                    (base + i) if nsrv else None))
+                     self._allreduce(f"{key}#c{i}",
+                                     {"packed": words, "n": cn,
+                                      "threshold": thr},
+                                     (base + i) if nsrv else None))
                     for i, (words, cn) in enumerate(chunks)])
                 return np.concatenate(parts)
         elif not isinstance(value, dict):
@@ -379,11 +534,16 @@ class WorkerClient:
             if value.size > per:
                 flat = value.ravel()
                 base = zlib.crc32(key.encode())
+                if obs_trace.enabled():
+                    obs_trace.tracer().event(
+                        "allreduce.chunked",
+                        {"key": key, "per": per,
+                         "chunks": -(-flat.size // per)})
                 parts = self._stream_chunks([
                     (lambda i=i, start=start:
-                     self.allreduce(f"{key}#c{i}",
-                                    flat[start:start + per],
-                                    (base + i) if nsrv else None))
+                     self._allreduce(f"{key}#c{i}",
+                                     flat[start:start + per],
+                                     (base + i) if nsrv else None))
                     for i, start in enumerate(
                         range(0, flat.size, per))])
                 return np.concatenate(parts).reshape(value.shape)
@@ -411,6 +571,7 @@ class WorkerClient:
         everywhere (a warning is logged)."""
         from dt_tpu.ops.sparse import RowSparse
         import jax.numpy as jnp
+        _obs_t0 = obs_trace.tracer().now()
         nsrv = len(self.servers)
         if nsrv > 1:
             # partition the touched rows by the contiguous row-range →
@@ -467,6 +628,8 @@ class WorkerClient:
                         np.asarray(out["vals"]).dtype)
         ids[:n] = out["ids"][:n]
         vals[:n] = out["vals"][:n]
+        obs_trace.tracer().complete_span("allreduce_sparse", _obs_t0,
+                                         {"key": key, "merged": merged})
         return RowSparse(jnp.asarray(ids), jnp.asarray(vals), rs.num_rows)
 
     # -- dist_async data plane --------------------------------------------
@@ -651,6 +814,12 @@ class WorkerClient:
             {"cmd": "async_pull_rows", "key": key, "ids": ids})
 
     def close(self):
+        # final obs flush BEFORE stopping the heartbeat thread: the tail
+        # of the span ring (records since the last heartbeat) would
+        # otherwise never reach the scheduler's job timeline
+        if self._obs_hook is not None:
+            obs_trace.unregister_flush(self._obs_hook)
+        self.obs_flush()
         self._stop.set()
         # bounded join: an in-flight heartbeat would otherwise release
         # its channel back into the pool AFTER the purge below (the

@@ -31,7 +31,12 @@ class DataPlane:
     """
 
     def __init__(self, expected_fn: Callable[[], Set[str]],
-                 confirm_fn: Optional[Callable[[], Set[str]]] = None):
+                 confirm_fn: Optional[Callable[[], Set[str]]] = None,
+                 tracer=None):
+        # observability sink (dt_tpu/obs): the embedding server passes its
+        # control-plane tracer so round counters/events land on its track
+        from dt_tpu.obs import trace as obs_trace
+        self._obs = tracer if tracer is not None else obs_trace.tracer()
         self.expected_fn = expected_fn
         # called right before a round completes, for an AUTHORITATIVE
         # membership recheck: a range server serves allreduce against a
@@ -124,6 +129,9 @@ class DataPlane:
                 if slot["vals"] and live and set(slot["vals"]) >= live:
                     contributors = [h for h in order if h in slot["vals"]]
                     self._finish_round_locked(slot, contributors)
+                    self._obs.event("dataplane.survivor_complete",
+                                    {"key": key,
+                                     "contributors": len(contributors)})
             self._cv.notify_all()
 
     # ------------------------------------------------------------------
@@ -207,6 +215,7 @@ class DataPlane:
             slot["served"][h] = (h_seq, slot["result"])
         slot["vals"] = {}
         slot["gen"] += 1
+        self._obs.counter("dataplane.rounds")
 
     @staticmethod
     def _merge_sparse(stacked) -> dict:

@@ -77,8 +77,29 @@ import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from dt_tpu import config
+from dt_tpu.obs import trace as obs_trace
 
 KINDS = ("drop", "dup", "delay", "reorder", "reset", "partition", "crash")
+
+
+def _obs_fault(kind: str, op: str, idx: int, cmd: Optional[str] = None,
+               host: Optional[str] = None, site: Optional[str] = None,
+               **extra: Any) -> None:
+    """Every APPLIED fault becomes a trace event (``fault.<kind>``) on the
+    process tracer — the chaos harness's ``--trace`` run cross-checks
+    these against ``applied_summary()`` so the fault harness and the obs
+    subsystem verify each other."""
+    if not obs_trace.enabled():
+        return
+    attrs: Dict[str, Any] = {"op": op, "rule": idx}
+    if cmd is not None:
+        attrs["cmd"] = cmd
+    if host is not None:
+        attrs["host"] = host
+    if site is not None:
+        attrs["site"] = site
+    attrs.update(extra)
+    obs_trace.tracer().event(f"fault.{kind}", attrs)
 OPS = ("send", "recv")
 
 
@@ -219,6 +240,7 @@ class FaultPlan:
             if not r.matches("send", cmd, host) or \
                     not self._fire(idx, r, host):
                 continue
+            _obs_fault(r.kind, "send", idx, cmd=cmd, host=host)
             if r.kind == "delay":
                 time.sleep(r.delay_s)
             elif r.kind == "reorder":
@@ -238,6 +260,7 @@ class FaultPlan:
             if not r.matches("recv", cmd, host) or \
                     not self._fire(idx, r, host):
                 continue
+            _obs_fault(r.kind, "recv", idx, cmd=cmd, host=host)
             if r.kind == "delay":
                 time.sleep(r.delay_s)
             elif r.kind == "reorder":
@@ -279,7 +302,14 @@ class FaultPlan:
                 continue
             if not self._fire(idx, r, host):
                 continue
+            _obs_fault("crash", "crash", idx, host=host, site=site,
+                       **{k: v for k, v in ctx.items() if k == "epoch"})
             if r.action == "exit":
+                # push buffered trace records to the scheduler first (the
+                # dying incarnation's timeline would otherwise vanish);
+                # best-effort and obs-gated, so the exit stays
+                # SIGKILL-equivalent for everything but the trace
+                obs_trace.flush()
                 os._exit(137)  # SIGKILL-equivalent: no cleanup, no goodbye
             raise CrashInjected(
                 f"fault injection: crash at {site} (host={host}, {ctx})")

@@ -79,6 +79,9 @@ class MeshManager:
         self.coordinator_address = coordinator_address
         self.local_device_count = local_device_count
         self._initialized = False
+        # CPU collectives impl (gloo/mpi) parked while in a solo world —
+        # restored when a multi-process world re-forms (see initialize)
+        self._saved_cpu_collectives: Optional[str] = None
         self.mesh = None
 
     def initialize(self, num_processes: int = 1, process_id: int = 0,
@@ -98,10 +101,32 @@ class MeshManager:
                     "multi-process world needs a coordinator_address; "
                     "refusing to build a local-only mesh that would silently "
                     "skip cross-host gradient averaging")
+            if self._saved_cpu_collectives:
+                # growing back from a solo world: restore the collectives
+                # impl the solo rebuild parked, BEFORE the new backend
+                # builds (gradient psums would otherwise stay local-only)
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  self._saved_cpu_collectives)
+                self._saved_cpu_collectives = None
             jax.distributed.initialize(
                 coordinator_address=self.coordinator_address,
                 num_processes=num_processes, process_id=process_id)
             self._initialized = True
+        else:
+            # Rebuilding down to a SOLO world: a CPU collectives backend
+            # (gloo/mpi) requires a live jax.distributed client, which a
+            # 1-process world never creates — backend init would raise in
+            # make_gloo_tcp_collectives(distributed_client=None).  Park
+            # the impl (restored on the next multi-process initialize)
+            # and reset to local before the new backend builds.
+            try:
+                impl = jax.config._read("jax_cpu_collectives_implementation")
+            except (AttributeError, KeyError):
+                impl = None
+            if impl and impl != "none":
+                self._saved_cpu_collectives = impl
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "none")
         self.mesh = mesh_lib.make_mesh()
         return self.mesh
 

@@ -82,6 +82,7 @@ import numpy as np
 
 from dt_tpu import config
 from dt_tpu.elastic import faults
+from dt_tpu.obs import trace as obs_trace
 
 _LEN = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
@@ -220,6 +221,9 @@ def _send_segments(sock: socket.socket, segments) -> None:
     """Vectored ``sendmsg`` of a segment list (bytes / memoryviews)
     without concatenating — partial sends advance through the vector."""
     segs = [memoryview(s).cast("B") for s in segments if len(s)]
+    if obs_trace.enabled():  # wire byte meter (single funnel for all frames)
+        obs_trace.tracer().counter("wire.bytes_sent",
+                                   sum(s.nbytes for s in segs))
     while segs:
         sent = sock.sendmsg(segs[:_SENDMSG_MAX_SEGS])
         i = 0
@@ -309,6 +313,8 @@ def _recv_into(sock: socket.socket, n: int):
     buffers come from ``numpy.empty`` — uninitialized, so the recv
     doesn't pay a zero-fill memset pass over memory it fully
     overwrites."""
+    if obs_trace.enabled():
+        obs_trace.tracer().counter("wire.bytes_recv", n)
     if n >= _UNINIT_MIN:
         buf = memoryview(np.empty(n, np.uint8)).cast("B")
     else:
@@ -460,6 +466,13 @@ def pool() -> ChannelPool:
 
 def _request_once(host: str, port: int, msg: Dict[str, Any],
                   timeout: float, reset: bool = False) -> Dict[str, Any]:
+    # wire span: one record per attempt (cmd + whether the channel was a
+    # pooled reuse or a fresh connect); byte meters live in the framing.
+    # The obs export channel itself is exempt: an obs_push's own span
+    # would re-fill the very ring the flush is draining (the flush loop
+    # would never see an empty payload and always run to its bound).
+    t0 = obs_trace.tracer().now() \
+        if msg.get("cmd") != "obs_push" else None
     addr = (host, port)
     sock, reused = _POOL.acquire(addr, timeout)
     try:
@@ -497,6 +510,8 @@ def _request_once(host: str, port: int, msg: Dict[str, Any],
         _POOL.discard(sock)
         raise
     _POOL.release(addr, sock)
+    obs_trace.tracer().complete_span(
+        "wire.request", t0, {"cmd": msg.get("cmd"), "reused": reused})
     return resp
 
 
@@ -591,6 +606,11 @@ def request(host: str, port: int, msg: Dict[str, Any],
                 time.monotonic() + delay >= deadline
             if attempt > retries or past_deadline:
                 raise
+            if obs_trace.enabled():
+                tr = obs_trace.tracer()
+                tr.counter("wire.retries")
+                tr.event("wire.retry", {"cmd": cmd, "attempt": attempt,
+                                        "backoff_s": delay})
             time.sleep(delay)
             delay = min(delay * 2, backoff_max_s)
 

@@ -40,6 +40,7 @@ from typing import List, Optional, Set
 
 from dt_tpu.elastic import faults, protocol
 from dt_tpu.elastic.dataplane import DataPlane
+from dt_tpu.obs import trace as obs_trace
 
 logger = logging.getLogger("dt_tpu.elastic")
 _drop_rng = random.Random(0x5EED)  # deterministic fault injection
@@ -63,17 +64,18 @@ class RangeServer:
         self._members_ts = 0.0  # guarded-by: _members_lock
         self._members_lock = threading.Lock()
         self._ttl = membership_ttl_s
+        # observability (dt_tpu/obs): per-instance tracer; the old ad-hoc
+        # _bytes_in/_rounds ints (load-balance evidence: with R servers
+        # each should carry ~1/R of the bytes) are obs counters now, and
+        # the "stats" command is a thin view over them
+        self._obs = obs_trace.Tracer(name=f"range-server-{self.index}")
         # confirm_fn forces a synchronous scheduler read right before a
         # round completes, closing the stale-cache join race (one extra
         # RTT per completing round; contributions are already seconds
         # apart on this plane)
         self._dp = DataPlane(expected_fn=self._expected,
-                             confirm_fn=self._refresh_members)
-        # data bytes received (gradient payloads), for load-balance
-        # evidence: with R servers each should carry ~1/R of the bytes
-        self._bytes_in = 0  # guarded-by: _stats_lock
-        self._rounds = 0  # guarded-by: _stats_lock
-        self._stats_lock = threading.Lock()
+                             confirm_fn=self._refresh_members,
+                             tracer=self._obs)
         self._tokens = protocol.TokenCache()
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -168,6 +170,7 @@ class RangeServer:
         if token is not None:
             cached = self._tokens.get(token)
             if cached is not None:
+                self._obs.counter("tokens.dedup_hits")
                 return cached
         try:
             resp = self._dispatch(msg)
@@ -207,9 +210,8 @@ class RangeServer:
             elif isinstance(val, dict):
                 size = sum(int(v.nbytes) for v in val.values()
                            if hasattr(v, "nbytes"))
-            with self._stats_lock:
-                self._bytes_in += size
-                self._rounds += 1
+            self._obs.counter("data.bytes_in", size)
+            self._obs.counter("data.requests")
             out = self._dp.dispatch(msg)
             if out is not None:
                 return out
@@ -220,11 +222,10 @@ class RangeServer:
                 keys = len(self._dp._async_store)
                 bytes_stored = sum(int(v.nbytes)
                                    for v in self._dp._async_store.values())
-            with self._stats_lock:
-                bytes_in, rounds = self._bytes_in, self._rounds
             return {"index": self.index, "async_keys": keys,
                     "async_bytes": bytes_stored,
-                    "data_bytes_in": bytes_in, "data_requests": rounds}
+                    "data_bytes_in": self._obs.get_counter("data.bytes_in"),
+                    "data_requests": self._obs.get_counter("data.requests")}
         if cmd == "shutdown":
             self.close()
             return {}

@@ -42,6 +42,7 @@ import numpy as np
 
 from dt_tpu.elastic import faults, protocol
 from dt_tpu.elastic.dataplane import DataPlane
+from dt_tpu.obs import trace as obs_trace
 
 logger = logging.getLogger("dt_tpu.elastic")
 _drop_rng = random.Random(0xD207)  # deterministic fault injection
@@ -53,7 +54,11 @@ _drop_rng = random.Random(0xD207)  # deterministic fault injection
 _TOKEN_EXEMPT = frozenset({"fetch_snapshot", "allreduce", "async_init",
                            "async_push", "async_pull_rows", "async_stats",
                            "heartbeat", "num_dead", "membership",
-                           "servers"})
+                           "servers", "obs_push", "obs_dump"})
+
+#: bound on retained (host, incarnation) obs tracks — LRU-evicted so a
+#: job with heavy restart churn can't grow scheduler memory unboundedly
+_OBS_MAX_TRACKS = 64
 
 
 class Scheduler:
@@ -123,11 +128,24 @@ class Scheduler:
         # snapshot
         self._snapshot = None  # guarded-by: _snapshot_lock
         self._snapshot_lock = threading.Lock()
+        # observability (dt_tpu/obs): this instance's control-plane tracer
+        # holds the scheduler's own spans/events AND the always-on
+        # transport counters the old ad-hoc _tstats ints became
+        # (transport_stats() is now a thin view over these); workers'
+        # span rings arrive on the heartbeat channel and accumulate in
+        # _obs_tracks, one track per (host, incarnation) — obs_dump()
+        # merges everything into one job timeline
+        self._obs = obs_trace.Tracer(name="control-plane")
+        self._obs_lock = threading.Lock()
+        self._obs_tracks: Dict[str, dict] = {}  # guarded-by: _obs_lock
+        self._obs_cap = self._obs._cap
+        self._barrier_t0 = None  # mc_barrier window span start; guarded-by: _lock
         # the single-funnel data plane (allreduce rounds + dist_async
         # store), shared machinery with RangeServer (dataplane.py).  When
         # range servers register, workers route bulk data to THEM and this
         # embedded plane goes idle (kvstore_dist.h:547-589 key sharding).
-        self._dp = DataPlane(expected_fn=lambda: list(self._workers))
+        self._dp = DataPlane(expected_fn=lambda: list(self._workers),
+                             tracer=self._obs)
         # range-server registry: index -> (host, port); fixed after launch
         # (the reference's server count is DMLC_NUM_SERVER, not elastic).
         # Own lock: _server_list() is called from inside _register, which
@@ -140,11 +158,6 @@ class Scheduler:
         self._profile_posted: Dict[tuple, int] = {}  # retry dedup; guarded-by: _lock
         # idempotency-token response cache (protocol.request reliable mode)
         self._tokens = protocol.TokenCache()
-        # transport stats: with pooled client channels many requests ride
-        # each accepted connection (chaos_run asserts requests >> conns)
-        self._tstats_lock = threading.Lock()
-        self._conns_accepted = 0  # guarded-by: _tstats_lock
-        self._requests_served = 0  # guarded-by: _tstats_lock
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -188,16 +201,14 @@ class Scheduler:
                              daemon=True).start()
 
     def _handle_conn(self, conn: socket.socket):
-        with self._tstats_lock:
-            self._conns_accepted += 1
+        self._obs.counter("transport.connections")
         protocol.serve_connection(conn, self._handle_one)
 
     def _handle_one(self, msg: dict) -> Optional[dict]:
         """One request on a persistent connection; ``None`` closes the
         channel without answering (receive-side drop injection — the
         pooled client sees EOF and retries on a fresh channel)."""
-        with self._tstats_lock:
-            self._requests_served += 1
+        self._obs.counter("transport.requests")
         # Fault injection: DT_DROP_MSG=<percent> drops received
         # requests BEFORE dispatch (the ps-lite PS_DROP_MSG
         # transport fuzz, van.cc:430-431,563-570); clients retry.
@@ -218,6 +229,7 @@ class Scheduler:
         if token is not None:
             cached = self._tokens.get(token)
             if cached is not None:
+                self._obs.counter("tokens.dedup_hits")
                 return cached
         try:
             resp = self._dispatch(msg)
@@ -231,10 +243,79 @@ class Scheduler:
 
     def transport_stats(self) -> dict:
         """{connections, requests}: pooled channels make requests greatly
-        exceed accepted connections (chaos_run asserts this)."""
-        with self._tstats_lock:
-            return {"connections": self._conns_accepted,
-                    "requests": self._requests_served}
+        exceed accepted connections (chaos_run asserts this).  Thin
+        backwards-compat view over the obs counters the old ad-hoc ints
+        folded into."""
+        return {"connections": self._obs.get_counter(
+                    "transport.connections"),
+                "requests": self._obs.get_counter("transport.requests")}
+
+    # ------------------------------------------------------------------
+    # observability ingest/export (dt_tpu/obs)
+    # ------------------------------------------------------------------
+
+    def _obs_ingest(self, host: str, payload: dict) -> None:
+        """Fold one worker's flushed span-ring batch into its
+        (host, incarnation) track.  At-least-once safe: records carry a
+        strictly increasing ``rseq`` (dt_tpu/obs/trace.py schema) and a
+        replayed batch's already-ingested prefix is skipped."""
+        key = f"{host}#{payload.get('inc', 0)}"
+        records = payload.get("records") or ()
+        with self._obs_lock:
+            tr = self._obs_tracks.setdefault(
+                key, {"records": [], "counters": {}, "dropped": 0,
+                      "trunc": 0, "rseq": -1, "fseq": -1})
+            # LRU by update order, bounded track count: a long-running
+            # job with restart churn mints a fresh (host, pid) track per
+            # incarnation — without eviction the scheduler (the one
+            # process that lives for the whole job) leaks a multi-MB
+            # ring per dead incarnation
+            self._obs_tracks.pop(key)
+            self._obs_tracks[key] = tr
+            while len(self._obs_tracks) > _OBS_MAX_TRACKS:
+                evicted = next(iter(self._obs_tracks))
+                del self._obs_tracks[evicted]
+                logger.info("obs: evicted stale track %s (track cap %d)",
+                            evicted, _OBS_MAX_TRACKS)
+            last = tr["rseq"]
+            fresh = [r for r in records if r[1] > last]
+            if fresh:
+                tr["records"].extend(fresh)
+                tr["rseq"] = max(r[1] for r in fresh)
+                over = len(tr["records"]) - self._obs_cap
+                if over > 0:
+                    # count what the per-track ring sheds: the summary's
+                    # drop column must admit timeline loss, not report a
+                    # truncated track as complete
+                    tr["trunc"] += over
+                    del tr["records"][:over]
+            # counters/dropped are cumulative gauges: apply only NEWER
+            # snapshots (a heartbeat stalled in flight must not roll back
+            # the close-flush's final values — fseq orders the payloads)
+            fseq = int(payload.get("fseq", 0))
+            if fseq > tr["fseq"]:
+                tr["fseq"] = fseq
+                if payload.get("counters"):
+                    tr["counters"] = dict(payload["counters"])
+                tr["dropped"] = int(payload.get("dropped", tr["dropped"]))
+
+    def obs_dump(self) -> dict:
+        """The merged job dump: every worker incarnation's track plus the
+        control-plane track (this instance's tracer merged with the
+        process tracer, which carries scheduler-side fault-injection
+        events and wire spans recorded outside this instance)."""
+        with self._obs_lock:
+            tracks = {k: {"records": list(v["records"]),
+                          "counters": dict(v["counters"]),
+                          "dropped": v["dropped"] + v.get("trunc", 0)}
+                      for k, v in self._obs_tracks.items()}
+        own = self._obs.snapshot()
+        proc = obs_trace.tracer().snapshot()
+        ctrl = {"records": own["records"] + proc["records"],
+                "counters": {**proc["counters"], **own["counters"]},
+                "dropped": own["dropped"] + proc["dropped"]}
+        tracks["control-plane"] = ctrl
+        return {"tracks": tracks}
 
     def close(self):
         self._stop.set()
@@ -253,13 +334,23 @@ class Scheduler:
             return self._register(msg["host"], bool(msg.get("is_new")),
                                   bool(msg.get("is_recovery")))
         if cmd == "heartbeat":
+            # worker span rings piggyback on the heartbeat, exactly like
+            # profiler control already does (kvstore_dist.h:102-110)
+            ob = msg.get("obs")
+            if ob is not None:
+                self._obs_ingest(msg["host"], ob)
             with self._lock:
                 self._heartbeats[msg["host"]] = time.time()
                 pseq = int(msg.get("pseq", 0))
                 newer = [c for c in self._profile_cmds if c["seq"] > pseq]
-            # profiler control rides the heartbeat (the reference's
-            # KVStoreServerProfilerCommand round, kvstore_dist.h:102-110)
             return {"profile_cmds": newer} if newer else {}
+        if cmd == "obs_push":
+            # synchronous flush (worker close / injected-crash path);
+            # rseq dedup makes replays idempotent
+            self._obs_ingest(msg["host"], msg.get("obs") or {})
+            return {}
+        if cmd == "obs_dump":
+            return {"job": self.obs_dump()}
         if cmd == "profile":
             # rank-0-drives-all profiling (kvstore_dist_server.h:275-322):
             # record the command; every worker picks it up on its next
@@ -376,6 +467,7 @@ class Scheduler:
                             if k[0] == host]:
                     del self._profile_posted[key]
                 self._cv.notify_all()
+                self._obs.event("recovery.registered", {"host": host})
                 logger.info("recovery registration from %s: pending "
                             "re-admission at the next barrier", host)
                 return {"rank": -1, "workers": list(self._workers),
@@ -495,6 +587,10 @@ class Scheduler:
             self._last_completed_epoch = epoch
             self._barrier_epoch = None
             self._barrier_arrived = set()
+            self._obs.complete_span("mc_barrier.window", self._barrier_t0,
+                                    {"epoch": epoch,
+                                     "released_by": "survivors"})
+            self._barrier_t0 = None
         # pending plain barrier
         if self._plain_arrived and live and self._plain_arrived >= live:
             self._plain_arrived = set()
@@ -534,17 +630,27 @@ class Scheduler:
 
             if self._barrier_epoch is None:
                 self._barrier_epoch = epoch
+                # the barrier WINDOW span: first arrival -> release (the
+                # job-level "how long does a membership change stall
+                # training" number the reference never measured)
+                self._barrier_t0 = self._obs.now()
             self._barrier_arrived.add(host)
             faults.crash_point("sched.barrier_arrived", host=host,
                                epoch=epoch)
 
             if self._barrier_arrived >= set(self._workers):
                 # everyone is here: apply at most one membership change
+                arrived = len(self._barrier_arrived)
                 result = self._apply_membership_change(epoch)
                 self._barrier_result[epoch] = result
                 self._last_completed_epoch = epoch
                 self._barrier_epoch = None
                 self._barrier_arrived = set()
+                self._obs.complete_span("mc_barrier.window",
+                                        self._barrier_t0,
+                                        {"epoch": epoch,
+                                         "arrived": arrived})
+                self._barrier_t0 = None
                 self._cv.notify_all()
                 return self._result_for(host, result)
 
@@ -570,6 +676,7 @@ class Scheduler:
         (count comparison) and ``MeshManager.depart``'s collective
         matching both depend on this; if this ever applies mixed changes
         in one barrier, fit must switch to comparing the member LIST."""
+        t0 = self._obs.now()
         if self._pre_change_hook is not None:
             try:
                 self._pre_change_hook(epoch)
@@ -636,6 +743,10 @@ class Scheduler:
                     threading.Thread(target=self._launch_callback,
                                      args=(h, epoch), daemon=True).start()
         if removed or added or recovered:
+            self._obs.complete_span(
+                "membership_change", t0,
+                {"epoch": epoch, "removed": removed, "added": added,
+                 "recovered": recovered})
             logger.info("Epoch[%d] membership change: removed=%s added=%s "
                         "recovered=%s -> %s", epoch, removed, added,
                         recovered, self._workers)
@@ -646,6 +757,11 @@ class Scheduler:
         """``SEQ ADDED|REMOVED IP TIME`` (``elastic_training.cc:108-126``).
         Caller holds the lock (the seq must be unique and ordered)."""
         self._log_seq += 1
+        # every audit line is also a timeline event: ADDED / REMOVED /
+        # RECOVERED (covers operator removals, auto-evictions, and the
+        # quick-restart eviction, which all funnel through here)
+        self._obs.event(f"membership.{action}",
+                        {"host": host, "seq": self._log_seq})
         if self._log_path:
             with open(self._log_path, "a") as f:
                 f.write(f"{self._log_seq} {action} {host} "

@@ -1,0 +1,9 @@
+"""dt_tpu.obs — structured tracing + metrics for the elastic control/data
+plane (see ``dt_tpu/obs/trace.py`` for the core API and
+``dt_tpu/obs/export.py`` for the merged chrome://tracing export)."""
+
+from dt_tpu.obs.trace import (Tracer, enabled, flush, register_flush,
+                              set_enabled, tracer, unregister_flush)
+
+__all__ = ["Tracer", "enabled", "flush", "register_flush", "set_enabled",
+           "tracer", "unregister_flush"]

@@ -1,0 +1,172 @@
+"""Merged chrome://tracing export + job-level summary.
+
+The reference emitted one chrome-trace file per process and left the
+operator to eyeball N files (``src/profiler/profiler.h:256``; the remote
+dump command ``kvstore_dist_server.h:275-322`` only triggered per-process
+writes).  Here the scheduler aggregates every worker incarnation's span
+ring (shipped over the heartbeat channel, ``dt_tpu/elastic/client.py``)
+and this module renders ONE timeline:
+
+- :func:`chrome_trace` — a ``{"traceEvents": [...]}`` dict with one named
+  *process* track per worker incarnation (``host#pid``) plus the
+  scheduler's ``control-plane`` track, loadable in chrome://tracing or
+  Perfetto.
+- :func:`summarize_chrome` — step-time percentiles, stall attribution
+  (time under barrier / allreduce / wire spans), per-track retry/fault
+  counts, and the membership-change timeline; consumed by
+  ``tools/dtop.py`` and the chaos harness's ``--trace`` checks.
+- :func:`write` — chrome trace to ``PATH`` and the metrics/summary
+  snapshot to ``PATH`` with a ``.metrics.json`` suffix.
+
+Input ``job`` dicts come from ``Scheduler.obs_dump()``::
+
+    {"tracks": {"w0#4242": {"records": [...], "counters": {...},
+                            "dropped": 0}, ...,
+                "control-plane": {...}}}
+
+with records in the flat-tuple schema of ``dt_tpu/obs/trace.py``.  This
+module is deliberately jax/numpy-free so ``tools/dtop.py`` stays a
+lightweight operator tool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+#: span names attributed to "stall" (time training waited on the control
+#: or data plane) in the summary.  Deliberately only the TOP-LEVEL
+#: blocking spans: wire.request spans are excluded because (a) transport
+#: time inside an allreduce/barrier is already inside that span (adding
+#: it would double-count) and (b) background heartbeat RTTs are not
+#: training stall at all.
+STALL_SPANS = ("mc_barrier", "allreduce", "allreduce_sparse",
+               "recovery.rejoin")
+
+
+def chrome_trace(job: Dict[str, Any]) -> Dict[str, Any]:
+    """Render a job dump into one chrome://tracing JSON object."""
+    events: List[dict] = []
+    other: Dict[str, Any] = {"tracks": {}}
+    for pid, (track, data) in enumerate(sorted(
+            (job.get("tracks") or {}).items()), start=1):
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": track}})
+        for rec in data.get("records", ()):
+            ph, rseq, name, ts_us, dur_us, tid, sid, parent, attrs = rec
+            args = dict(attrs or {})
+            args["seq"] = rseq
+            if parent is not None:
+                args["parent"] = parent
+            ev = {"ph": "X" if ph == "X" else "i", "name": name,
+                  "cat": "obs", "pid": pid, "tid": tid, "ts": ts_us,
+                  "args": args}
+            if ph == "X":
+                ev["dur"] = dur_us
+            else:
+                ev["s"] = "t"
+            events.append(ev)
+        other["tracks"][track] = {
+            "counters": dict(data.get("counters") or {}),
+            "dropped": int(data.get("dropped") or 0)}
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list (numpy-free)."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
+    """Job summary off the chrome schema (the one format both the live
+    path and dump files share)."""
+    track_of_pid: Dict[int, str] = {}
+    for ev in chrome.get("traceEvents", ()):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            track_of_pid[ev["pid"]] = ev["args"]["name"]
+
+    tracks: Dict[str, Any] = {}
+    membership: List[dict] = []
+    total_faults = 0
+    for ev in chrome.get("traceEvents", ()):
+        if ev.get("ph") == "M":
+            continue
+        track = track_of_pid.get(ev.get("pid"), f"pid{ev.get('pid')}")
+        tr = tracks.setdefault(track, {"steps_ms": [], "stall_ms": {},
+                                       "faults": {}, "events": 0,
+                                       "spans": 0})
+        name = ev.get("name", "")
+        if ev.get("ph") == "X":
+            tr["spans"] += 1
+            dur_ms = ev.get("dur", 0) / 1000.0
+            if name == "step":
+                tr["steps_ms"].append(dur_ms)
+            if name in STALL_SPANS:
+                tr["stall_ms"][name] = tr["stall_ms"].get(name, 0.0) \
+                    + dur_ms
+            if name == "membership_change":
+                membership.append({"track": track, "ts": ev.get("ts"),
+                                   **{k: v for k, v in ev["args"].items()
+                                      if k in ("epoch", "removed", "added",
+                                               "recovered")}})
+        else:
+            tr["events"] += 1
+            if name.startswith("fault."):
+                kind = name[len("fault."):]
+                tr["faults"][kind] = tr["faults"].get(kind, 0) + 1
+                total_faults += 1
+
+    meta = (chrome.get("otherData") or {}).get("tracks") or {}
+    out_tracks: Dict[str, Any] = {}
+    for track, tr in tracks.items():
+        steps = sorted(tr["steps_ms"])
+        counters = dict((meta.get(track) or {}).get("counters") or {})
+        out_tracks[track] = {
+            "steps": {"count": len(steps),
+                      "p50_ms": round(_percentile(steps, 50), 3),
+                      "p90_ms": round(_percentile(steps, 90), 3),
+                      "p99_ms": round(_percentile(steps, 99), 3)},
+            "stall_ms": {k: round(v, 3)
+                         for k, v in sorted(tr["stall_ms"].items())},
+            "faults": tr["faults"],
+            "retries": counters.get("wire.retries", 0),
+            "counters": counters,
+            "dropped": (meta.get(track) or {}).get("dropped", 0),
+            "spans": tr["spans"], "events": tr["events"],
+        }
+    for track, m in meta.items():  # tracks with counters but no records
+        if track not in out_tracks:
+            out_tracks[track] = {
+                "steps": {"count": 0, "p50_ms": 0.0, "p90_ms": 0.0,
+                          "p99_ms": 0.0},
+                "stall_ms": {}, "faults": {},
+                "retries": (m.get("counters") or {}).get("wire.retries", 0),
+                "counters": dict(m.get("counters") or {}),
+                "dropped": m.get("dropped", 0), "spans": 0, "events": 0}
+    return {"tracks": out_tracks,
+            "membership_changes": sorted(membership,
+                                         key=lambda m: m.get("ts") or 0),
+            "total_fault_events": total_faults}
+
+
+def metrics_path(trace_path: str) -> str:
+    root, _ = os.path.splitext(trace_path)
+    return root + ".metrics.json"
+
+
+def write(trace_path: str, job: Dict[str, Any]) -> Dict[str, Any]:
+    """Write the merged chrome trace to ``trace_path`` and the metrics/
+    summary snapshot next to it; returns the summary."""
+    chrome = chrome_trace(job)
+    with open(trace_path, "w") as f:
+        json.dump(chrome, f)
+    summary = summarize_chrome(chrome)
+    with open(metrics_path(trace_path), "w") as f:
+        json.dump(summary, f, indent=2)
+    return summary

@@ -1,0 +1,305 @@
+"""Near-zero-overhead structured tracing + metrics core.
+
+The reference's only observability was the per-process chrome-trace
+profiler (``src/profiler/profiler.h:256``) with remote control plumbed
+through kvstore commands (``KVStoreServerProfilerCommand``,
+``kvstore_dist.h:102-110``, ``kvstore_dist_server.h:275-322``) — op-level
+timelines, nothing about the *job*: how long a membership change stalls
+training, where allreduce rounds wait, which retries/faults fired.  This
+module is the job-level substrate: a thread-safe per-process span /
+counter / event API over a bounded ring buffer, exported through the
+elastic heartbeat channel (the same channel the profiler control already
+rides) and merged by the scheduler into one chrome://tracing timeline
+(``dt_tpu/obs/export.py``).
+
+Design points
+-------------
+
+- **Hard-off by default.**  Tracing is enabled by ``DT_OBS=1``
+  (``dt_tpu.config.ENV_REGISTRY``) or :func:`set_enabled`; disabled
+  ``span()``/``event()`` calls return a shared no-op and retain nothing
+  (``tests/test_obs.py`` asserts the fast path allocates nothing
+  measurable).  *Counters* stay live either way — they replace ad-hoc
+  always-on counters like the scheduler's transport stats.
+- **Bounded ring.**  At most ``DT_OBS_RING`` records are retained;
+  overflow drops the OLDEST record and bumps ``dropped`` (never raises,
+  never blocks the instrumented path on a slow consumer).
+- **Clocks.**  Timestamps are wall-clock (cross-process mergeable on one
+  machine — same trust model as the reference's per-node traces);
+  durations come from the monotonic clock.  Both are injectable for
+  deterministic tests.
+- **Nesting** rides a per-tracer ``contextvars.ContextVar``: a span's
+  record carries its parent span id, and events attach to the enclosing
+  span, without any thread-local bookkeeping at the call sites.
+
+Record schema (flat tuples, ring/wire-compact)::
+
+    ("X", rseq, name, ts_us, dur_us, tid, span_id, parent_id, attrs)  span
+    ("i", rseq, name, ts_us, 0,      tid, event_id, parent_id, attrs) event
+
+``rseq`` increases strictly in buffer order — the heartbeat export's
+at-least-once dedup key (the scheduler ignores records at-or-below the
+last ``rseq`` it ingested for a (host, incarnation) track).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from dt_tpu import config
+
+# ---------------------------------------------------------------------------
+# process-wide enable gate (DT_OBS, overridable in-process)
+# ---------------------------------------------------------------------------
+
+_ENABLED_OVERRIDE: Optional[bool] = None
+_ENV_ENABLED: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Whether tracing is on for this process (``DT_OBS=1`` or an explicit
+    :func:`set_enabled`).  One global-read + compare on the fast path."""
+    if _ENABLED_OVERRIDE is not None:
+        return _ENABLED_OVERRIDE
+    global _ENV_ENABLED
+    if _ENV_ENABLED is None:
+        _ENV_ENABLED = config.env("DT_OBS").strip().lower() in ("1", "true")
+    return _ENV_ENABLED
+
+
+def set_enabled(on: Optional[bool]) -> None:
+    """Process-local override (``None`` = follow the env var again) — the
+    in-process analog of exporting ``DT_OBS`` to a subprocess worker."""
+    global _ENABLED_OVERRIDE, _ENV_ENABLED
+    _ENABLED_OVERRIDE = on
+    if on is None:
+        _ENV_ENABLED = None
+
+
+# ---------------------------------------------------------------------------
+# flush hooks (crash-path export: a worker about to os._exit pushes its
+# buffered records to the scheduler so injected crashes still appear on
+# the job timeline — registered by WorkerClient)
+# ---------------------------------------------------------------------------
+
+_FLUSH_HOOKS: List[Callable[[], None]] = []
+_FLUSH_LOCK = threading.Lock()
+
+
+def register_flush(fn: Callable[[], None]) -> None:
+    with _FLUSH_LOCK:
+        if fn not in _FLUSH_HOOKS:
+            _FLUSH_HOOKS.append(fn)
+
+
+def unregister_flush(fn: Callable[[], None]) -> None:
+    with _FLUSH_LOCK:
+        if fn in _FLUSH_HOOKS:
+            _FLUSH_HOOKS.remove(fn)
+
+
+def flush() -> None:
+    """Best-effort: run every registered flush hook (never raises — the
+    caller may be half a millisecond from ``os._exit``)."""
+    with _FLUSH_LOCK:
+        hooks = list(_FLUSH_HOOKS)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            pass
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the disabled fast path returns
+    this singleton, so a skipped span allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """A live span; created only when the tracer is enabled."""
+
+    __slots__ = ("_tr", "name", "attrs", "_t0w", "_t0m", "_sid", "_parent",
+                 "_tok")
+
+    def __init__(self, tr: "Tracer", name: str, attrs: Optional[dict]):
+        self._tr = tr
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        tr = self._tr
+        self._t0w = tr._wall()
+        self._t0m = tr._mono()
+        self._parent = tr._ctx.get()
+        self._sid = tr._next_seq()
+        self._tok = tr._ctx.set(self._sid)
+        return self
+
+    def __exit__(self, *exc):
+        tr = self._tr
+        tr._ctx.reset(self._tok)
+        dur_us = max(tr._mono() - self._t0m, 0) // 1000
+        tr._push(("X", None, self.name, self._t0w // 1000, dur_us,
+                  threading.get_ident(), self._sid, self._parent,
+                  self.attrs))
+        return False
+
+
+class Tracer:
+    """One span/event/counter sink with a bounded ring buffer.
+
+    The process has one default instance (:func:`tracer`); servers that
+    aggregate (Scheduler, RangeServer) construct their own so their
+    control-plane records and counters stay per-instance (tests churn
+    through many servers in one process).
+    """
+
+    def __init__(self, name: str = "process",
+                 capacity: Optional[int] = None,
+                 wall_clock: Optional[Callable[[], int]] = None,
+                 mono_clock: Optional[Callable[[], int]] = None,
+                 enabled: Optional[bool] = None):
+        """``enabled``: ``True``/``False`` pins this instance regardless of
+        the process gate; ``None`` follows :func:`enabled`.  Clocks return
+        integer nanoseconds (injectable for deterministic tests)."""
+        self.name = name
+        self._cap = max(1, int(capacity if capacity is not None
+                               else int(config.env("DT_OBS_RING"))))
+        self._wall = wall_clock or time.time_ns
+        self._mono = mono_clock or time.monotonic_ns
+        self._enabled = enabled
+        self._lock = threading.Lock()
+        self._records: deque = deque()  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._counters: Dict[str, int] = {}  # guarded-by: _lock
+        self._ctx: contextvars.ContextVar = contextvars.ContextVar(
+            f"dt_obs_span_{id(self)}", default=None)
+
+    # -- gate -------------------------------------------------------------
+
+    def on(self) -> bool:
+        return self._enabled if self._enabled is not None else enabled()
+
+    # -- recording --------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _push(self, rec: tuple) -> None:
+        """Append one record, assigning its ``rseq`` (strictly increasing
+        in buffer order — the export dedup key); overflow drops the
+        oldest record and counts it, never raises."""
+        with self._lock:
+            self._seq += 1
+            rec = (rec[0], self._seq) + rec[2:]
+            if len(self._records) >= self._cap:
+                self._records.popleft()
+                self._dropped += 1
+            self._records.append(rec)
+
+    def span(self, name: str, attrs: Optional[dict] = None):
+        """Context manager recording a complete ("X") span on exit; the
+        disabled path returns a shared no-op singleton."""
+        if not self.on():
+            return _NOOP_SPAN
+        return _Span(self, name, attrs)
+
+    def now(self) -> Optional[Tuple[int, int]]:
+        """(wall_ns, mono_ns) start token for :meth:`complete_span`, or
+        ``None`` when tracing is off — lets call sites thread a span
+        through code that can't be re-indented under a ``with``."""
+        if not self.on():
+            return None
+        return (self._wall(), self._mono())
+
+    def complete_span(self, name: str, t0: Optional[Tuple[int, int]],
+                      attrs: Optional[dict] = None) -> None:
+        """Record a span begun at ``t0`` (= :meth:`now`); no-op on
+        ``None`` (tracing was off when the span would have started)."""
+        if t0 is None or not self.on():
+            return
+        dur_us = max(self._mono() - t0[1], 0) // 1000
+        self._push(("X", None, name, t0[0] // 1000, dur_us,
+                    threading.get_ident(), None, self._ctx.get(), attrs))
+
+    def event(self, name: str, attrs: Optional[dict] = None) -> None:
+        """Instant ("i") event, attached to the enclosing span if any."""
+        if not self.on():
+            return
+        self._push(("i", None, name, self._wall() // 1000, 0,
+                    threading.get_ident(), None, self._ctx.get(), attrs))
+
+    # -- counters (live even when tracing is off) -------------------------
+
+    def counter(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def get_counter(self, name: str, default: int = 0) -> int:
+        with self._lock:
+            return self._counters.get(name, default)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    # -- export -----------------------------------------------------------
+
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Non-destructive view: {name, records, counters, dropped}."""
+        with self._lock:
+            return {"name": self.name, "records": list(self._records),
+                    "counters": dict(self._counters),
+                    "dropped": self._dropped}
+
+    def drain(self, max_records: Optional[int] = None) -> List[tuple]:
+        """Remove and return up to ``max_records`` OLDEST records (the
+        heartbeat flush takes bounded bites so one message stays small)."""
+        with self._lock:
+            if max_records is None or max_records >= len(self._records):
+                out = list(self._records)
+                self._records.clear()
+            else:
+                out = [self._records.popleft()
+                       for _ in range(max_records)]
+            return out
+
+
+# ---------------------------------------------------------------------------
+# process-default tracer
+# ---------------------------------------------------------------------------
+
+_DEFAULT: Optional[Tracer] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def tracer() -> Tracer:
+    """The process-wide default tracer (one worker process = one track)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Tracer(name="process")
+    return _DEFAULT

@@ -39,6 +39,7 @@ import numpy as np
 import optax
 
 from dt_tpu import config as config_lib
+from dt_tpu.obs import trace as obs_trace
 from dt_tpu.ops import losses as losses_lib
 from dt_tpu.parallel import kvstore as kvstore_lib
 from dt_tpu.parallel import mesh as mesh_lib
@@ -549,7 +550,9 @@ class Module:
                 params=self._unravel(jnp.asarray(cur)))
 
         from dt_tpu.elastic import faults as faults_lib
+        _obs = obs_trace.tracer()  # epoch/step spans (off unless DT_OBS)
         for epoch in range(begin_epoch, num_epoch):
+            _obs_ep_t0 = _obs.now()
             # chaos-harness hook: a crash rule pinned to this epoch dies
             # HERE — exactly the epoch-boundary window the quick-restart
             # recovery path must survive (elastic/faults.py)
@@ -615,6 +618,10 @@ class Module:
                     batch = train_data.next()
                 except StopIteration:
                     break
+                # step span: dispatch + host-side sync points of one
+                # batch (device programs run async — this is the control
+                # view, not a kernel timeline; jax.profiler has those)
+                _obs_st_t0 = _obs.now()
                 data = self._place(batch.data)
                 labels = self._place(batch.label)
                 if is_async:
@@ -664,6 +671,7 @@ class Module:
                 else:
                     self.state, loss, logits = self._train_step(
                         self.state, data, labels, rng)
+                _obs.complete_span("step", _obs_st_t0, {"epoch": epoch})
                 # flush the PREVIOUS step's metric + its callback (its
                 # logits are ready by now; this step already runs on device)
                 if pending is not None:
@@ -679,6 +687,8 @@ class Module:
             if eval_metric.num_inst > 0:  # empty when Speedometer auto_reset
                 for name, val in eval_metric.get_name_value():
                     logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            _obs.complete_span("epoch", _obs_ep_t0,
+                               {"epoch": epoch, "nbatch": nbatch})
             logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
 
             # --- epoch end: publish snapshot (store_aux_params analog,
@@ -749,6 +759,7 @@ class Module:
         """Reference ``BaseModule.score`` (``base_module.py:613-620``)."""
         if self._eval_step is None:
             self._build_steps()
+        _obs_t0 = obs_trace.tracer().now()
         eval_metric = metrics_lib.create(eval_metric)
         eval_metric.reset()
         eval_data.reset()
@@ -763,6 +774,7 @@ class Module:
             probs = _softmax_np(_local_np(logits))
             eval_metric.update(np.asarray(batch.label)[:n_real],
                                probs[:n_real])
+        obs_trace.tracer().complete_span("eval", _obs_t0)
         return eval_metric.get_name_value()
 
     def predict(self, data) -> np.ndarray:

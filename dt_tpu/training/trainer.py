@@ -21,6 +21,7 @@ import jax.flatten_util
 import jax.numpy as jnp
 import optax
 
+from dt_tpu.obs import trace as obs_trace
 from dt_tpu.parallel import kvstore as kvstore_lib
 
 
@@ -108,13 +109,18 @@ class Trainer:
              ignore_stale_grad: bool = False):
         """Rescale by 1/batch_size, sync, update (reference
         ``Trainer.step``)."""
+        _obs_t0 = obs_trace.tracer().now()
         if self.kv.type == "dist_async":
-            return self._async_step(grads, 1.0 / batch_size)
+            out = self._async_step(grads, 1.0 / batch_size)
+            obs_trace.tracer().complete_span("trainer.step", _obs_t0,
+                                             {"mode": "dist_async"})
+            return out
         if self._step_fn is None:
             self._build()
         grads = self.allreduce_grads(grads)
         self.params, self.opt_state = self._step_fn(
             self.params, self.opt_state, grads, 1.0 / batch_size)
+        obs_trace.tracer().complete_span("trainer.step", _obs_t0)
         return self.params
 
     @property

@@ -24,7 +24,7 @@ import numpy as np  # noqa: E402
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from dt_tpu import data, models  # noqa: E402
-from dt_tpu.elastic import WorkerClient  # noqa: E402
+from dt_tpu.elastic import WorkerClient, faults  # noqa: E402
 from dt_tpu.parallel import kvstore as kvstore_lib  # noqa: E402
 from dt_tpu.training import Module  # noqa: E402
 
@@ -172,6 +172,13 @@ def main():
         "num_workers_at_end": kv.num_workers,
         "bootstrap_step": bootstrap_step,
     }
+    # (kind, host, count) of every fault THIS incarnation applied — the
+    # chaos harness's --trace mode cross-checks these against the fault
+    # events on the merged obs timeline
+    plan = faults.active_plan()
+    result["faults_applied"] = (
+        [[plan.rules[i].kind, h, n] for i, h, n in plan.applied_summary()]
+        if plan else [])
     with open(args.out, "w") as f:
         json.dump(result, f)
     ctrl.close()

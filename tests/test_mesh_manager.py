@@ -42,6 +42,39 @@ def test_multiprocess_without_coordinator_raises():
         mm.initialize(num_processes=4, process_id=1)
 
 
+def test_solo_rebuild_parks_and_restores_cpu_collectives(monkeypatch):
+    """Shrinking to a solo world must reset a gloo/mpi CPU-collectives
+    config (the backend would otherwise demand a distributed client that
+    a 1-process world never creates), and growing back must RESTORE it —
+    a regrown world with impl 'none' would silently skip cross-host
+    gradient averaging."""
+    def read_impl():
+        try:
+            return jax.config._read("jax_cpu_collectives_implementation")
+        except (AttributeError, KeyError):
+            return None
+    if read_impl() is None:
+        import pytest
+        pytest.skip("jax version lacks jax_cpu_collectives_implementation")
+    orig = read_impl()
+    # jax.distributed.initialize would need real peers; the regrow path
+    # under test is the config handling AROUND it
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: None)
+    mm = MeshManager(coordinator_address="127.0.0.1:1")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        mm.initialize(num_processes=1, process_id=0)
+        assert read_impl() == "none"  # parked: solo backend builds clean
+        assert mm._saved_cpu_collectives == "gloo"
+        mm.initialize(num_processes=2, process_id=0)
+        assert read_impl() == "gloo"  # restored for the regrown world
+        assert mm._saved_cpu_collectives is None
+    finally:
+        mm._initialized = False  # initialize() was monkeypatched
+        jax.config.update("jax_cpu_collectives_implementation", orig)
+
+
 def test_restore_with_explicit_shardings():
     mesh = mesh_lib.make_mesh()
     host = {"w": np.arange(16.0).reshape(16, 1)}

@@ -1,0 +1,286 @@
+"""dt_tpu.obs — tracing core, heartbeat export merge, fault-event
+timeline (reference analog: the per-process profiler + its remote control
+plumbing, ``src/profiler/profiler.h:256``,
+``kvstore_dist_server.h:275-322``; obs is the job-level counterpart)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dt_tpu.obs import export as obs_export
+from dt_tpu.obs import trace as obs_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    """Deterministic ns clock serving both wall and monotonic reads."""
+
+    def __init__(self, start_ns=1_000_000_000_000):
+        self.t = start_ns
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, ns):
+        self.t += ns
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_tracer():
+    """Each test starts (and leaves) the process tracer empty and the
+    process gate at its default."""
+    obs_trace.tracer().drain()
+    yield
+    obs_trace.set_enabled(None)
+    obs_trace.tracer().drain()
+
+
+def _mk(capacity=64):
+    fc = FakeClock()
+    tr = obs_trace.Tracer(name="t", capacity=capacity, wall_clock=fc,
+                          mono_clock=fc, enabled=True)
+    return tr, fc
+
+
+# record tuple indices (dt_tpu/obs/trace.py schema)
+PH, RSEQ, NAME, TS, DUR, TID, SID, PARENT, ATTRS = range(9)
+
+
+def test_span_nesting_and_ordering_under_fake_clock():
+    tr, fc = _mk()
+    with tr.span("outer"):
+        fc.tick(1_000_000)  # 1 ms
+        with tr.span("inner", {"k": 1}):
+            fc.tick(2_000_000)
+        fc.tick(1_000_000)
+    tr.event("after")
+    recs = tr.snapshot()["records"]
+    assert [r[NAME] for r in recs] == ["inner", "outer", "after"]
+    inner, outer, after = recs
+    # ids: outer span opened first (sid 1), inner second (sid 2); rseqs
+    # assigned at record time, strictly increasing in buffer order
+    assert outer[SID] == 1 and inner[SID] == 2
+    assert inner[RSEQ] < outer[RSEQ] < after[RSEQ]
+    assert inner[PARENT] == outer[SID] and outer[PARENT] is None
+    assert after[PARENT] is None  # event outside any span
+    # exact durations/timestamps from the fake clock (us)
+    assert outer[DUR] == 4000 and inner[DUR] == 2000
+    assert inner[TS] - outer[TS] == 1000
+    assert inner[ATTRS] == {"k": 1}
+    # events inside a span attach to it
+    with tr.span("s3"):
+        tr.event("e3")
+    recs = tr.snapshot()["records"]
+    assert recs[-2][NAME] == "e3" and recs[-2][PARENT] == recs[-1][SID]
+
+
+def test_ring_overflow_drops_oldest_with_counter_never_raises():
+    tr, _ = _mk(capacity=8)
+    for i in range(20):
+        tr.event(f"ev{i}")
+    snap = tr.snapshot()
+    assert len(snap["records"]) == 8
+    assert snap["dropped"] == 12
+    assert [r[NAME] for r in snap["records"]] == \
+        [f"ev{i}" for i in range(12, 20)]
+    # drain in bounded bites preserves order
+    first = tr.drain(max_records=3)
+    assert [r[NAME] for r in first] == ["ev12", "ev13", "ev14"]
+    assert [r[NAME] for r in tr.drain()] == \
+        [f"ev{i}" for i in range(15, 20)]
+
+
+def test_disabled_fast_path_allocates_nothing_measurable():
+    import tracemalloc
+    tr = obs_trace.Tracer(enabled=False)
+    for _ in range(64):  # warm every code path first
+        with tr.span("x"):
+            pass
+        tr.event("x")
+        tr.now()
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(5000):
+        with tr.span("x"):
+            pass
+        tr.event("x")
+        tr.now()
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    retained = sum(
+        s.size_diff for s in after.compare_to(before, "filename")
+        if s.size_diff > 0 and s.traceback and
+        s.traceback[0].filename.endswith(os.path.join("obs", "trace.py")))
+    assert retained < 512, f"disabled path retained {retained} bytes"
+    snap = tr.snapshot()
+    assert snap["records"] == [] and snap["dropped"] == 0
+
+
+def test_enabled_gate_follows_env_and_override():
+    assert obs_trace.enabled() is False  # DT_OBS unset in the test env
+    obs_trace.set_enabled(True)
+    assert obs_trace.enabled() is True
+    obs_trace.set_enabled(None)
+    assert obs_trace.enabled() is False
+
+
+def test_heartbeat_export_merges_two_workers_into_chrome_trace():
+    from dt_tpu.elastic import Scheduler, protocol
+    sched = Scheduler(initial_workers=["w0", "w1"])
+    try:
+        payloads = {}
+        for host in ("w0", "w1"):
+            tr, fc = _mk()
+            with tr.span("step", {"epoch": 0}):
+                fc.tick(5_000_000)
+            tr.event("fault.drop", {"cmd": "heartbeat", "host": host})
+            payloads[host] = {"inc": 7, "records": tr.drain(),
+                              "counters": {"wire.retries": 2},
+                              "dropped": 0}
+            protocol.request("127.0.0.1", sched.port,
+                             {"cmd": "heartbeat", "host": host, "pseq": 0,
+                              "obs": payloads[host]})
+        # at-least-once: a replayed batch must not duplicate records
+        protocol.request("127.0.0.1", sched.port,
+                         {"cmd": "obs_push", "host": "w0",
+                          "obs": payloads["w0"]})
+        job = sched.obs_dump()
+        assert set(job["tracks"]) >= {"w0#7", "w1#7", "control-plane"}
+        assert len(job["tracks"]["w0#7"]["records"]) == 2  # deduped
+
+        chrome = obs_export.chrome_trace(job)
+        json.dumps(chrome)  # must be JSON-serializable as-is
+        evs = chrome["traceEvents"]
+        track_names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert {"w0#7", "w1#7", "control-plane"} <= track_names
+        for e in evs:  # schema check
+            assert e["ph"] in ("X", "i", "M")
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], int) and isinstance(
+                    e["dur"], int) and e["dur"] >= 0
+            if e["ph"] == "i":
+                assert e["s"] == "t"
+        summary = obs_export.summarize_chrome(chrome)
+        for host in ("w0", "w1"):
+            t = summary["tracks"][f"{host}#7"]
+            assert t["steps"]["count"] == 1
+            assert t["steps"]["p50_ms"] == pytest.approx(5.0)
+            assert t["faults"] == {"drop": 1}
+            assert t["retries"] == 2
+    finally:
+        sched.close()
+
+
+def test_seeded_fault_events_land_on_timeline():
+    """test_faults.py-style scenario: a seeded plan's APPLIED faults all
+    appear as ``fault.<kind>`` events, counts matching
+    ``applied_summary()`` exactly (the two subsystems verify each
+    other)."""
+    from dt_tpu.elastic import faults
+    from dt_tpu.elastic.faults import FaultPlan, FaultRule
+    obs_trace.set_enabled(True)
+    plan = faults.install(FaultPlan([
+        FaultRule("drop", op="send", cmd="allreduce", prob=0.5),
+        FaultRule("dup", op="send", cmd="mc_barrier"),
+        FaultRule("delay", op="recv", cmd="heartbeat", times=2,
+                  delay_s=0.0),
+    ], seed=3))
+    try:
+        for _ in range(20):
+            plan.on_send("allreduce", "w0")
+        for _ in range(3):
+            plan.on_send("mc_barrier", "w1")
+        for _ in range(5):
+            plan.on_recv("heartbeat", "w0")
+        applied = plan.applied_summary()
+        events = [r for r in obs_trace.tracer().drain()
+                  if r[PH] == "i" and r[NAME].startswith("fault.")]
+        assert len(events) == sum(n for _, _, n in applied)
+        by = {}
+        for r in events:
+            key = (r[NAME], r[ATTRS]["host"])
+            by[key] = by.get(key, 0) + 1
+        applied_by = {(plan.rules[i].kind, h): n for i, h, n in applied}
+        assert by == {(f"fault.{k}", h): n
+                      for (k, h), n in applied_by.items()}
+        assert applied_by[("dup", "w1")] == 3
+        assert applied_by[("delay", "w0")] == 2
+    finally:
+        faults.clear()
+
+
+def test_worker_client_timeline_reaches_scheduler_dump():
+    """End to end in one process: WorkerClient spans ride the heartbeat /
+    close-flush to the scheduler; the control-plane track records the
+    barrier window; a seeded drop shows up as both a retry and a fault
+    event."""
+    from dt_tpu.elastic import Scheduler, WorkerClient, faults
+    from dt_tpu.elastic.faults import FaultPlan, FaultRule
+    obs_trace.set_enabled(True)
+    faults.install(FaultPlan([
+        FaultRule("drop", op="send", cmd="barrier", times=1)], seed=0))
+    sched = Scheduler(initial_workers=["w0"])
+    try:
+        c = WorkerClient("127.0.0.1", sched.port, host="w0",
+                         heartbeat_interval_s=0.05)
+        c.membership_change_barrier({"EPOCH_BEGIN": 0})
+        c.barrier()  # first attempt dropped -> retried
+        out = c.allreduce("g", np.arange(4, dtype=np.float32))
+        np.testing.assert_allclose(out, np.arange(4, dtype=np.float32))
+        c.close()  # final flush via obs_push
+        job = sched.obs_dump()
+        track = f"w0#{os.getpid()}"
+        assert track in job["tracks"]
+        names = {r[NAME] for r in job["tracks"][track]["records"]}
+        assert {"mc_barrier", "allreduce", "wire.request",
+                "fault.drop"} <= names
+        assert job["tracks"][track]["counters"].get("wire.retries", 0) >= 1
+        assert job["tracks"][track]["counters"].get(
+            "allreduce.rounds") == 1
+        ctrl = {r[NAME] for r in
+                job["tracks"]["control-plane"]["records"]}
+        assert "mc_barrier.window" in ctrl
+        # the transport view folded into obs counters still serves
+        stats = sched.transport_stats()
+        assert stats["requests"] > 0 and stats["connections"] > 0
+    finally:
+        faults.clear()
+        sched.close()
+
+
+def test_dtop_renders_a_dump_file(tmp_path):
+    job = {"tracks": {}}
+    for host in ("w0", "w1"):
+        tr, fc = _mk()
+        with tr.span("step"):
+            fc.tick(3_000_000)
+        tr.event("fault.dup", {"host": host})
+        job["tracks"][f"{host}#1"] = {"records": tr.drain(),
+                                      "counters": {"wire.retries": 1},
+                                      "dropped": 0}
+    ctr, cfc = _mk()
+    with ctr.span("membership_change", {"epoch": 2, "removed": [],
+                                        "added": [], "recovered": ["w1"]}):
+        cfc.tick(1000)
+    job["tracks"]["control-plane"] = {"records": ctr.drain(),
+                                      "counters": {}, "dropped": 0}
+    path = str(tmp_path / "trace.json")
+    summary = obs_export.write(path, job)
+    assert summary["tracks"]["w0#1"]["steps"]["count"] == 1
+    assert os.path.exists(obs_export.metrics_path(path))
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "dtop.py"), path],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "w0#1" in r.stdout and "membership changes: 1" in r.stdout
+    assert "recovered=['w1']" in r.stdout
+    r2 = subprocess.run([sys.executable,
+                         os.path.join(REPO, "tools", "dtop.py"), path,
+                         "--json"],
+                        capture_output=True, text=True, timeout=120)
+    assert json.loads(r2.stdout)["tracks"]["w1#1"]["faults"] == {"dup": 1}

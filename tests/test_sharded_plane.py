@@ -117,8 +117,8 @@ def test_fleet_split_is_one_level():
         res = _parallel([lambda i=i: clients[i].allreduce("one", vs[i])
                          for i in range(2)])
         np.testing.assert_allclose(res[0], np.mean(vs, axis=0))
-        with servers[0]._stats_lock, servers[1]._stats_lock:
-            reqs = servers[0]._rounds + servers[1]._rounds
+        reqs = sum(s._obs.get_counter("data.requests")
+                   for s in servers[:2])
         # 2 workers x 2 chunks (one per server) + 2 host_reset-free data
         # reqs only; anything like 2 x 100 means the recursion re-split
         assert reqs == 4, reqs

@@ -110,7 +110,18 @@ def main():
                     choices=["default", "noise", "crash-only", "none"])
     ap.add_argument("--num-epoch", type=int, default=8)
     ap.add_argument("--timeout-s", type=float, default=1200.0)
+    ap.add_argument("--trace", default="",
+                    help="write the merged dt_tpu.obs chrome trace here "
+                         "(+ .metrics.json sidecar); enables DT_OBS for "
+                         "the in-process scheduler AND the workers, and "
+                         "cross-checks the timeline against the fault "
+                         "plan's applied counts")
     args = ap.parse_args()
+
+    if args.trace:
+        # before any dt_tpu.obs use: the scheduler reads it in-process,
+        # workers inherit it through _spawn's env copy
+        os.environ["DT_OBS"] = "1"
 
     from dt_tpu.elastic import Scheduler, faults
     from dt_tpu.elastic.faults import FaultPlan
@@ -200,6 +211,54 @@ def main():
         tstats = sched.transport_stats()
         checks["pooled_connections"] = \
             tstats["requests"] > 2 * tstats["connections"]
+
+        summary = None
+        if args.trace:
+            # merged job timeline: the obs subsystem and the fault
+            # harness verify each other — every fault the plan APPLIED
+            # must appear as a fault.<kind> event on the right track
+            from dt_tpu.obs import export as obs_export
+            summary = obs_export.write(args.trace, sched.obs_dump())
+            json.load(open(args.trace))  # the trace must reload as JSON
+            tracks = summary["tracks"]
+            worker_tracks = [t for t in tracks if t != "control-plane"]
+            checks["trace_tracks"] = (len(worker_tracks) >= 2
+                                      and "control-plane" in tracks)
+            if expect_crash:
+                checks["trace_membership_span"] = \
+                    len(summary["membership_changes"]) >= 1
+            ev = {}
+            drops = {}
+            for t in worker_tracks:
+                whost = t.split("#")[0]
+                for kind, n in tracks[t].get("faults", {}).items():
+                    ev[(whost, kind)] = ev.get((whost, kind), 0) + n
+                drops[whost] = drops.get(whost, 0) + \
+                    tracks[t].get("dropped", 0)
+            ok_w = True
+            for h, r in results.items():
+                for kind, fh, n in r.get("faults_applied", []):
+                    # a lossy ring/pending buffer (dropped > 0) may
+                    # legitimately hold fewer events than were applied —
+                    # same tolerance as the scheduler-side check below
+                    if ev.get((fh or h, kind), 0) < n and \
+                            not drops.get(fh or h):
+                        ok_w = False
+            checks["trace_faults_worker"] = ok_w
+            ctrl = sum(tracks.get("control-plane", {})
+                       .get("faults", {}).values())
+            ctrl_drop = tracks.get("control-plane", {}).get("dropped", 0)
+            applied_sched = sum(
+                n for _, _, n in (sched_plan.applied_summary()
+                                  if sched_plan else []))
+            # exact when the ring held everything; a lossy ring (dropped
+            # > 0) may legitimately hold fewer events than were applied
+            checks["trace_faults_sched"] = ctrl == applied_sched or \
+                (ctrl_drop > 0 and ctrl < applied_sched)
+            if expect_crash:
+                checks["trace_crash_event"] = \
+                    ev.get((CRASH_HOST, "crash"), 0) >= 1
+
         ok = bool(checks) and all(checks.values())
         print(json.dumps({
             "ok": ok, "plan": args.plan, "seed": args.seed,
@@ -211,6 +270,11 @@ def main():
                           for h, r in results.items()},
             "scheduler_faults_applied":
                 sched_plan.applied_summary() if sched_plan else [],
+            "trace": args.trace or None,
+            "trace_membership_changes":
+                len(summary["membership_changes"]) if summary else None,
+            "trace_fault_events":
+                summary["total_fault_events"] if summary else None,
             "workdir": tmp,
         }))
         return 0 if ok else 1

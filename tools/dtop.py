@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""dtop — terminal summary of a dt_tpu.obs job timeline.
+
+Renders step-time percentiles, stall attribution, per-worker retry/fault
+counts, and the membership-change timeline from either a merged chrome
+trace written by ``dt_tpu.obs.export`` (e.g. ``tools/chaos_run.py
+--trace out.json``) or a LIVE scheduler (the ``obs_dump`` control
+command — the job-level counterpart of the reference's remote profiler
+dump, ``kvstore_dist_server.h:275-322``).
+
+Usage::
+
+    python tools/dtop.py /tmp/trace.json
+    python tools/dtop.py --scheduler 127.0.0.1:9091
+    python tools/dtop.py /tmp/trace.json --json   # machine-readable
+
+jax-free: loads only ``dt_tpu.obs.export`` (and the wire protocol for
+``--scheduler``).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# Import dt_tpu.obs/.elastic WITHOUT executing dt_tpu/__init__.py (which
+# pulls the ops surface and therefore jax): register a path-only shim for
+# the parent package first — same trick as tools/dtlint.py.  Under pytest
+# dt_tpu is already real and the shim is skipped.
+if "dt_tpu" not in sys.modules:
+    import types
+    _shim = types.ModuleType("dt_tpu")
+    _shim.__path__ = [os.path.join(_ROOT, "dt_tpu")]
+    sys.modules["dt_tpu"] = _shim
+
+
+def _load_chrome(args):
+    from dt_tpu.obs import export as obs_export
+    if args.scheduler:
+        host, _, port = args.scheduler.rpartition(":")
+        from dt_tpu.elastic import protocol
+        resp = protocol.request(host or "127.0.0.1", int(port),
+                                {"cmd": "obs_dump"}, timeout=30)
+        if "error" in resp:
+            raise SystemExit(f"scheduler error: {resp['error']}")
+        return obs_export.chrome_trace(resp["job"])
+    if not args.trace:
+        raise SystemExit("give a trace file or --scheduler host:port")
+    with open(args.trace) as f:
+        return json.load(f)
+
+
+def _fmt_ms(v):
+    return f"{v:10.1f}"
+
+
+def render(summary) -> str:
+    lines = []
+    tracks = summary.get("tracks", {})
+    worker_tracks = sorted(t for t in tracks if t != "control-plane")
+    lines.append(f"{'track':<22}{'steps':>7}{'p50 ms':>10}{'p90 ms':>10}"
+                 f"{'p99 ms':>10}{'stall ms':>10}{'retries':>9}"
+                 f"{'faults':>8}{'drop':>6}")
+    for name in worker_tracks + (["control-plane"]
+                                 if "control-plane" in tracks else []):
+        t = tracks[name]
+        st = t["steps"]
+        stall = sum(t.get("stall_ms", {}).values())
+        nfaults = sum(t.get("faults", {}).values())
+        lines.append(
+            f"{name:<22}{st['count']:>7}{_fmt_ms(st['p50_ms'])}"
+            f"{_fmt_ms(st['p90_ms'])}{_fmt_ms(st['p99_ms'])}"
+            f"{_fmt_ms(stall)}{t.get('retries', 0):>9}{nfaults:>8}"
+            f"{t.get('dropped', 0):>6}")
+    # stall attribution: where did waiting time go, per worker
+    lines.append("")
+    lines.append("stall attribution (ms):")
+    for name in worker_tracks:
+        stall = tracks[name].get("stall_ms", {})
+        if stall:
+            parts = "  ".join(f"{k}={v:.1f}"
+                              for k, v in sorted(stall.items()))
+            lines.append(f"  {name:<20}{parts}")
+    faults_any = any(tracks[n].get("faults") for n in tracks)
+    if faults_any:
+        lines.append("")
+        lines.append("fault events:")
+        for name in sorted(tracks):
+            f = tracks[name].get("faults", {})
+            if f:
+                parts = "  ".join(f"{k}={v}" for k, v in sorted(f.items()))
+                lines.append(f"  {name:<20}{parts}")
+    mem = summary.get("membership_changes", [])
+    lines.append("")
+    lines.append(f"membership changes: {len(mem)}")
+    for m in mem:
+        lines.append(
+            f"  epoch {m.get('epoch')}: removed={m.get('removed')} "
+            f"added={m.get('added')} recovered={m.get('recovered')}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="dtop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("trace", nargs="?", default="",
+                    help="merged chrome trace JSON (obs.export.write)")
+    ap.add_argument("--scheduler", default="",
+                    help="live scheduler host:port (obs_dump)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary dict instead of the table")
+    args = ap.parse_args(argv)
+
+    from dt_tpu.obs import export as obs_export
+    chrome = _load_chrome(args)
+    summary = obs_export.summarize_chrome(chrome)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
