@@ -64,6 +64,9 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "DT_AR_CHUNK_BYTES": (str(4 << 20), "represented-gradient bytes per chunked-allreduce round"),
     "DT_AR_SHARD_MIN_BYTES": (str(64 << 10), "tensors above this split across ALL range servers"),
     "DT_AR_WINDOW": ("0", "in-flight chunk-round window (0 = 2x fleet, min 4)"),
+    "DT_AR_BUCKET_BYTES": (str(4 << 20), "represented-gradient bytes per overlap-pipeline bucket (D2H/wire/H2D granularity)"),
+    "DT_AR_OVERLAP": ("1", "0 = serial host-sync step (no bucketed D2H/wire/H2D overlap); must be identical job-wide"),
+    "DT_AR_STAGING_MB": ("64", "cap on reusable host staging-buffer bytes held by the overlap pipeline"),
     "DT_WORKER_ID": ("", "this worker's host identity under the launcher env contract"),
     "DT_RECOVERY": ("", "1 = re-register under the old identity after a crash (restart wrapper)"),
     "DT_SERVER_ID": ("0", "range-server index under the launcher env contract"),

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import socket
 import threading
 import time
@@ -79,6 +80,7 @@ class WorkerClient:
         self._key_rows: Dict[str, int] = {}  # key -> total rows (sharding)
         self._ar_seq: Dict[str, int] = {}
         self._pool = None  # lazy persistent pool for fleet fan-outs
+        self._pipe_pool = None  # lazy executor for bucket rounds (overlap)
         self._announce_to_servers()
         # profiler sync starts AT the current command seq: a joiner must
         # not replay a long-finished profiling session's command history
@@ -434,26 +436,72 @@ class WorkerClient:
             per = max(quantum, (per // quantum) * quantum)
         return per
 
-    def _stream_chunks(self, tasks) -> List[np.ndarray]:
-        """Run chunk-round thunks through the persistent fan-out pool
-        with a BOUNDED in-flight window (``DT_AR_WINDOW``, default
-        2xfleet): chunk i+W is submitted only once chunk i completed, so
-        serialization, socket I/O, and server-side reduction overlap
-        while per-server peak memory stays O(workers x chunk x window).
-        Results come back in submission order."""
-        import collections
-        window = int(config.env("DT_AR_WINDOW")) or \
+    def _ar_window(self) -> int:
+        """The bounded in-flight round window (``DT_AR_WINDOW``, default
+        2xfleet, min 4) shared by chunk streaming and bucket pipelining."""
+        return int(config.env("DT_AR_WINDOW")) or \
             max(4, 2 * max(len(self.servers), 1))
-        pool = self._fanout_pool()
-        out: List[np.ndarray] = []
+
+    def _stream_iter(self, tasks, pool=None, window: Optional[int] = None):
+        """Run round thunks through an executor with a BOUNDED in-flight
+        window: task i+W is submitted only once task i completed, so
+        serialization, socket I/O, and server-side reduction overlap
+        while per-server peak memory stays O(workers x round x window).
+        Yields results in submission order as they complete; ``tasks``
+        may be a lazy iterator (the overlap pipeline feeds it from a
+        queue bucket-by-bucket)."""
+        import collections
+        window = window or self._ar_window()
+        pool = pool if pool is not None else self._fanout_pool()
         inflight = collections.deque()
-        for t in tasks:
-            inflight.append(pool.submit(t))
-            if len(inflight) >= window:
-                out.append(inflight.popleft().result())
-        while inflight:
-            out.append(inflight.popleft().result())
-        return out
+        try:
+            for t in tasks:
+                inflight.append(pool.submit(t))
+                if len(inflight) >= window:
+                    yield inflight.popleft().result()
+            while inflight:
+                yield inflight.popleft().result()
+        finally:
+            # error/early-exit path: wait out the already-submitted
+            # rounds (their thunks may still be serializing caller-owned
+            # staging buffers — see AllreducePipeline's drain contract)
+            for f in inflight:
+                try:
+                    f.result()
+                except Exception:
+                    pass
+
+    def _stream_chunks(self, tasks) -> List[np.ndarray]:
+        """Ordered-list convenience over :meth:`_stream_iter` (the r7
+        chunk-window machinery; the overlap pipeline streams the same way
+        but consumes incrementally)."""
+        return list(self._stream_iter(tasks))
+
+    def allreduce_pipeline(self, key: str,
+                           window: Optional[int] = None
+                           ) -> "AllreducePipeline":
+        """Open a bucketed-allreduce pipeline for ``key`` — the
+        data-plane half of the overlapped host-sync step (the reference
+        overlaps per-layer kvstore push/pull with backward compute via
+        the dependency engine, ``src/kvstore/kvstore_dist.h:326-449``;
+        here the unit is a size-bounded bucket of the flat gradient).
+        See :class:`AllreducePipeline`."""
+        return AllreducePipeline(self, key, window=window)
+
+    def _pipeline_pool(self):
+        """Executor for bucket rounds, SEPARATE from :meth:`_fanout_pool`:
+        a bucket larger than DT_AR_CHUNK_BYTES re-enters
+        :meth:`_allreduce` and streams chunk sub-rounds through the
+        fan-out pool — if bucket thunks ran on that same pool, a
+        saturated window would deadlock on its own sub-rounds (the
+        nested-submit hazard the fan-out pool's no-resubmit rule
+        exists to prevent)."""
+        if self._pipe_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pipe_pool = ThreadPoolExecutor(
+                max_workers=max(4, self._ar_window()),
+                thread_name_prefix=f"dt-ar-pipe-{self.host}")
+        return self._pipe_pool
 
     def allreduce(self, key: str, value, _route: Optional[int] = None
                   ) -> np.ndarray:
@@ -828,11 +876,242 @@ class WorkerClient:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        if self._pipe_pool is not None:
+            self._pipe_pool.shutdown(wait=False)
+            self._pipe_pool = None
         # drop this client's idle pooled channels: the server side's
         # per-connection threads see EOF and exit (fd/thread hygiene
         # when tests churn through schedulers)
         for addr in [self.addr] + list(self.servers):
             protocol.pool().close_addr(tuple(addr))
+
+
+class AllreducePipeline:
+    """One step's bucketed-allreduce scheduler — the wire stage of the
+    overlapped host-sync pipeline (reference overlap: the dependency
+    engine runs per-layer ZPush/ZPull concurrently with backward compute,
+    ``src/kvstore/kvstore_dist.h:326-449``; chunked-collective layout as
+    in EQuARX, arXiv:2506.17615).
+
+    The caller (the D2H stage) ``submit()``s bucket payloads IN ORDER as
+    it stages them off the device; a background comm thread feeds them
+    through the r7 window machinery (:meth:`WorkerClient._stream_iter`
+    over the dedicated pipeline executor) and completed averages stream
+    back via :meth:`poll`/:meth:`next_result` in bucket order — the
+    caller's H2D stage consumes bucket k-1 while bucket k is on the wire
+    and bucket k+1 is still being staged.  Aux rounds (the ``"stats"``
+    allreduce) ride the same window concurrently via :meth:`submit_aux`.
+
+    Bucket k ships as subkey ``key#b<k>`` through the NORMAL
+    :meth:`WorkerClient.allreduce` machinery, so every per-round
+    guarantee is inherited unchanged: per-(host, seq) dedup, idempotency
+    tokens (a ``reset``/drop mid-bucket retries only that bucket's round
+    through the replay window), chunk splitting for oversized buckets,
+    and fleet routing.  Every worker must run the same mode
+    (``DT_AR_OVERLAP`` is job-wide): bucket subkeys only pair with
+    bucket subkeys.
+
+    Failure drains, never leaks: the first bucket error is recorded, the
+    comm thread finishes (or swallows) every already-submitted round —
+    so caller-owned staging buffers are no longer referenced by the wire
+    — discards the rest of the input to unblock a backpressured
+    producer, and the error re-raises from the next ``submit``/
+    ``next_result``.  ``close()`` is idempotent and safe in ``finally``.
+    """
+
+    _END = ("end",)
+
+    def __init__(self, client: WorkerClient, key: str,
+                 window: Optional[int] = None):
+        self._client = client
+        self.key = key
+        self._window = max(2, window or client._ar_window())
+        # input backpressure: at most window staged-but-unsubmitted
+        # buckets queue here while window more are on the wire, so the
+        # caller's staging footprint is bounded at ~2*window buckets
+        self._in: "queue.Queue" = queue.Queue(maxsize=self._window)
+        self._out: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None  # guarded-by: _lock
+        self._aux: Dict[str, object] = {}  # caller thread only
+        self._submitted = 0   # caller thread only
+        self._consumed = 0    # caller thread only
+        self._input_done = False  # caller thread only
+        self._drained = False     # caller thread only
+        self._thread = threading.Thread(
+            target=self._comm_loop, daemon=True,
+            name=f"dt-ar-pipeline-{client.host}-{key}")
+        self._thread.start()
+
+    # -- caller-side producer/consumer surface ---------------------------
+
+    def _check_error(self) -> None:
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+
+    def submit(self, payload) -> int:
+        """Queue bucket ``self._submitted`` (payload: array or packed
+        2-bit dict).  Blocks when the window backpressure is full —
+        that bound is what keeps staging memory O(window x bucket)."""
+        self._check_error()
+        if self._input_done:
+            raise RuntimeError("pipeline input already closed")
+        idx = self._submitted
+        self._submitted += 1
+        self._in.put(("bucket", idx, payload))
+        return idx
+
+    def submit_aux(self, key: str, payload) -> None:
+        """Queue a standalone concurrent round (e.g. the ``"stats"``
+        allreduce) into the same window; fetch via :meth:`aux` after the
+        pipeline drained."""
+        self._check_error()
+        if self._input_done:
+            raise RuntimeError("pipeline input already closed")
+        self._in.put(("aux", key, payload))
+
+    def done_submitting(self) -> None:
+        """No more input; the comm thread finishes the in-flight window
+        and ends the result stream."""
+        if not self._input_done:
+            self._input_done = True
+            self._in.put(None)
+
+    def poll(self):
+        """[(idx, averaged_bucket), ...] ready right now (never blocks)."""
+        out = []
+        while True:
+            try:
+                item = self._out.get_nowait()
+            except queue.Empty:
+                return out
+            got = self._deliver(item)
+            if got is not None:
+                out.append(got)
+            elif self._drained:
+                return out
+            # else: an aux result was folded in; keep polling
+
+    def next_result(self, timeout: Optional[float] = None):
+        """Next (idx, averaged_bucket) in bucket order; ``None`` once the
+        stream ended.  Raises the pipeline error, or ``queue.Empty`` on
+        timeout."""
+        while True:
+            if self._drained:
+                return None
+            item = self._out.get(timeout=timeout) if timeout is not None \
+                else self._out.get()
+            got = self._deliver(item)
+            if got is not None:
+                return got
+            if self._drained:
+                return None
+            # an aux result landed; keep waiting for the bucket
+
+    def _deliver(self, item):
+        """Fold one comm-loop output item; returns a bucket result or
+        None (aux / terminal)."""
+        kind = item[0]
+        if kind == "bucket":
+            self._consumed += 1
+            return (item[1], item[2])
+        if kind == "aux":
+            self._aux[item[1]] = item[2]
+            return None
+        if kind == "error":
+            self._drained = True
+            raise item[1]
+        self._drained = True  # _END
+        return None
+
+    def aux(self, key: str):
+        """Result of a :meth:`submit_aux` round; valid once
+        :meth:`next_result` returned ``None`` (the stream drained)."""
+        if key not in self._aux:
+            raise KeyError(f"aux round {key!r} not completed (drain the "
+                           "pipeline first)")
+        return self._aux[key]
+
+    def close(self, timeout: float = 120.0) -> bool:
+        """Idempotent shutdown: close the input, wait for the comm
+        thread (bounded).  Returns True when the thread exited — only
+        then may the caller RECYCLE staging buffers it submitted (on
+        False, drop the buffers instead: the wire may still be reading
+        them)."""
+        self.done_submitting()
+        try:
+            self._in.put_nowait(None)  # wake an error-drain loop, if any
+        except queue.Full:
+            pass
+        self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
+
+    # -- comm thread ------------------------------------------------------
+
+    def _tasks(self):
+        """Lazy thunk iterator over the input queue (runs on the comm
+        thread; ends at the sentinel)."""
+        while True:
+            item = self._in.get()
+            if item is None:
+                return
+            kind, a, payload = item
+            if kind == "bucket":
+                yield (lambda i=a, p=payload:
+                       ("bucket", i, self._round(i, p)))
+            else:
+                yield (lambda k=a, p=payload:
+                       ("aux", k, self._aux_round(k, p)))
+
+    def _round(self, idx: int, payload):
+        """One bucket's wire round: the plain allreduce of subkey
+        ``key#b<idx>`` (chunking/routing/dedup inherited)."""
+        tr = obs_trace.tracer()
+        t0 = tr.now()
+        out = self._client._allreduce(f"{self.key}#b{idx}", payload)
+        if obs_trace.enabled():  # trace counter, not a stats-view one —
+            # gated like the serial allreduce.rounds so the process-wide
+            # tracer only meters traced runs (test_obs exact counts)
+            tr.counter("pipeline.buckets")
+        tr.complete_span("pipeline.wire", t0,
+                         {"key": self.key, "bucket": idx})
+        return out
+
+    def _aux_round(self, key: str, payload):
+        """A concurrent standalone round.  Uses the UNWRAPPED allreduce:
+        the top-level ``allreduce`` span is a stall-attribution signal
+        (obs/export.py STALL_SPANS), and this round runs overlapped with
+        the step, not as training stall."""
+        tr = obs_trace.tracer()
+        t0 = tr.now()
+        out = self._client._allreduce(key, payload)
+        if obs_trace.enabled():  # gated like pipeline.buckets above
+            tr.counter("pipeline.aux_rounds")
+        tr.complete_span("pipeline.wire", t0, {"key": key, "aux": True})
+        return out
+
+    def _comm_loop(self):
+        try:
+            for item in self._client._stream_iter(
+                    self._tasks(), pool=self._client._pipeline_pool(),
+                    window=self._window):
+                self._out.put(item)
+            self._out.put(self._END)
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            with self._lock:
+                self._error = e
+            # _stream_iter's finally already waited out every submitted
+            # round.  End the result stream FIRST (a consumer may be
+            # blocked in next_result and is the one who will call
+            # close()), then discard the rest of the input so a producer
+            # blocked on backpressure wakes up; close()'s extra sentinel
+            # terminates this drain when the producer never sent one.
+            self._out.put(("error", e))
+            while True:
+                item = self._in.get()
+                if item is None:
+                    break
 
 
 def auto_client(**kwargs) -> Optional[WorkerClient]:

@@ -128,7 +128,7 @@ class DataPlane:
             for key, slot in self._reduce.items():
                 if slot["vals"] and live and set(slot["vals"]) >= live:
                     contributors = [h for h in order if h in slot["vals"]]
-                    self._finish_round_locked(slot, contributors)
+                    self._finish_round_locked(slot, contributors, key)
                     self._obs.event("dataplane.survivor_complete",
                                     {"key": key,
                                      "contributors": len(contributors)})
@@ -178,7 +178,7 @@ class DataPlane:
                 expected = self.confirm_fn()
             if expected and set(slot["vals"]) >= set(expected):
                 contributors = [h for h in expected if h in slot["vals"]]
-                self._finish_round_locked(slot, contributors)
+                self._finish_round_locked(slot, contributors, key)
                 self._cv.notify_all()
                 return {"value": slot["result"]}
             while slot["gen"] == gen:
@@ -186,7 +186,8 @@ class DataPlane:
                     raise TimeoutError(f"allreduce {key} stuck")
             return {"value": slot["result"]}
 
-    def _finish_round_locked(self, slot: dict, contributors) -> None:
+    def _finish_round_locked(self, slot: dict, contributors,
+                             key: str = "") -> None:
         stacked = [slot["vals"][h][1] for h in contributors]
         if any(isinstance(a, tuple) and a[0] == "rsp" for a in stacked):
             slot["result"] = self._merge_sparse(stacked)
@@ -216,6 +217,11 @@ class DataPlane:
         slot["vals"] = {}
         slot["gen"] += 1
         self._obs.counter("dataplane.rounds")
+        if "#b" in key:
+            # overlap-pipeline bucket round (subkey ``key#b<i>``, possibly
+            # with chunk suffixes): per-bucket accounting for the step
+            # pipeline (chaos --trace asserts the overlapped path ran)
+            self._obs.counter("dataplane.bucket_rounds")
 
     @staticmethod
     def _merge_sparse(stacked) -> dict:

@@ -48,6 +48,19 @@ def snapshot_state(state: Any) -> Any:
     ``multihost_utils.process_allgather`` (a collective: every process
     must reach this snapshot, which the epoch-boundary contract
     guarantees).  Caught by the 2-process x 4-device ZeRO test."""
+    # Drain every queued program that writes these buffers BEFORE the
+    # gather collectives hit the wire: the caller's last train step can
+    # still be executing when this dispatches (the block_until_ready
+    # gotcha, collective edition), and its in-flight psums then
+    # interleave with the allgather ops on the SAME gloo tcp pairs in
+    # thread-scheduling order — which differs across ranks under CPU
+    # contention, desyncing the pair framing (gloo EnforceNotMet
+    # ``op.preamble.length <= op.nbytes``, observed at the 4-process
+    # lifecycle's remove boundary and cascading into peer SIGABRTs).
+    live = [x for x in jax.tree_util.tree_leaves(state)
+            if isinstance(x, jax.Array)]
+    if live:
+        jax.block_until_ready(live)
     def pull(x):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
             from jax.experimental import multihost_utils
@@ -62,13 +75,32 @@ def restore_state(host_state: Any, mesh, shardings: Any = None) -> Any:
 
     ``shardings``: optional pytree of per-leaf ``NamedSharding`` matching
     ``host_state`` for model-parallel layouts; default replicates every leaf
-    (the DP case)."""
+    (the DP case).
+
+    Multi-process placement is COLLECTIVE-FREE: every process holds the
+    full leaf (``snapshot_state`` allgathers, so blobs are bit-identical
+    across ranks by contract) and each device's shard is sliced locally
+    via ``make_array_from_callback``.  ``jax.device_put`` of a numpy
+    value onto a non-addressable sharding instead runs a
+    ``broadcast_one_to_all`` psum per leaf just to assert cross-process
+    equality — a gloo round-trip per leaf that, under CPU contention,
+    can interleave with neighbouring collectives on the same tcp pairs
+    and desync the pair framing (observed as ``gloo::EnforceNotMet
+    op.preamble.length <= op.nbytes`` killing the 4-process lifecycle
+    test's joiner mid-rebuild).  The equality assert moves into the
+    contract: feed every rank the SAME blob (a rank restoring a
+    different value now diverges silently instead of tripping jax's
+    device_put check — the snapshot path guarantees it)."""
+    def put(x, s):
+        if getattr(s, "is_fully_addressable", True):
+            return jax.device_put(x, s)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, s, lambda idx: arr[idx])
     if shardings is None:
         rep = mesh_lib.replicate_sharding(mesh)
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, rep), host_state)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, s), host_state, shardings)
+        return jax.tree_util.tree_map(lambda x: put(x, rep), host_state)
+    return jax.tree_util.tree_map(put, host_state, shardings)
 
 
 class MeshManager:

@@ -225,7 +225,11 @@ class RangeServer:
             return {"index": self.index, "async_keys": keys,
                     "async_bytes": bytes_stored,
                     "data_bytes_in": self._obs.get_counter("data.bytes_in"),
-                    "data_requests": self._obs.get_counter("data.requests")}
+                    "data_requests": self._obs.get_counter("data.requests"),
+                    # overlap-pipeline rounds served by THIS shard (the
+                    # per-bucket accounting of the r10 streaming step)
+                    "bucket_rounds": self._obs.get_counter(
+                        "dataplane.bucket_rounds")}
         if cmd == "shutdown":
             self.close()
             return {}

@@ -44,6 +44,14 @@ from typing import Any, Dict, List, Optional
 STALL_SPANS = ("mc_barrier", "allreduce", "allreduce_sparse",
                "recovery.rejoin")
 
+#: overlap-pipeline stage spans (``training/overlap.py`` +
+#: ``elastic/client.py`` AllreducePipeline).  NOT stall spans: they run
+#: concurrently with (and inside the wall-clock of) the top-level
+#: ``allreduce`` span, so summing them alongside it would double-count;
+#: the summary reports them as a separate per-stage attribution split —
+#: where the overlapped step's time went (d2h / wire / h2d).
+PIPELINE_PREFIX = "pipeline."
+
 
 def chrome_trace(job: Dict[str, Any]) -> Dict[str, Any]:
     """Render a job dump into one chrome://tracing JSON object."""
@@ -99,8 +107,8 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
             continue
         track = track_of_pid.get(ev.get("pid"), f"pid{ev.get('pid')}")
         tr = tracks.setdefault(track, {"steps_ms": [], "stall_ms": {},
-                                       "faults": {}, "events": 0,
-                                       "spans": 0})
+                                       "pipeline_ms": {}, "faults": {},
+                                       "events": 0, "spans": 0})
         name = ev.get("name", "")
         if ev.get("ph") == "X":
             tr["spans"] += 1
@@ -110,6 +118,10 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
             if name in STALL_SPANS:
                 tr["stall_ms"][name] = tr["stall_ms"].get(name, 0.0) \
                     + dur_ms
+            if name.startswith(PIPELINE_PREFIX):
+                stage = name[len(PIPELINE_PREFIX):]
+                tr["pipeline_ms"][stage] = \
+                    tr["pipeline_ms"].get(stage, 0.0) + dur_ms
             if name == "membership_change":
                 membership.append({"track": track, "ts": ev.get("ts"),
                                    **{k: v for k, v in ev["args"].items()
@@ -134,6 +146,9 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
                       "p99_ms": round(_percentile(steps, 99), 3)},
             "stall_ms": {k: round(v, 3)
                          for k, v in sorted(tr["stall_ms"].items())},
+            "pipeline_ms": {k: round(v, 3)
+                            for k, v in sorted(tr["pipeline_ms"].items())},
+            "pipeline_buckets": counters.get("pipeline.buckets", 0),
             "faults": tr["faults"],
             "retries": counters.get("wire.retries", 0),
             "counters": counters,
@@ -145,7 +160,10 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
             out_tracks[track] = {
                 "steps": {"count": 0, "p50_ms": 0.0, "p90_ms": 0.0,
                           "p99_ms": 0.0},
-                "stall_ms": {}, "faults": {},
+                "stall_ms": {}, "pipeline_ms": {},
+                "pipeline_buckets": (m.get("counters") or {}).get(
+                    "pipeline.buckets", 0),
+                "faults": {},
                 "retries": (m.get("counters") or {}).get("wire.retries", 0),
                 "counters": dict(m.get("counters") or {}),
                 "dropped": m.get("dropped", 0), "spans": 0, "events": 0}

@@ -181,6 +181,10 @@ class Module:
         self._apply_step = None
         self._unravel = None
         self._unravel_stats = None
+        # overlapped host-sync engine (training/overlap.py): bucketed
+        # D2H -> wire -> H2D pipeline, lazy — built on first host-sync
+        # step when DT_AR_OVERLAP is on and the controller supports it
+        self._overlap = None
 
     # ------------------------------------------------------------------
     # Binding / init
@@ -442,6 +446,26 @@ class Module:
                 _, self._unravel_stats = jax.flatten_util.ravel_pytree(
                     self.state.batch_stats)
 
+    def _overlap_engine(self):
+        if self._overlap is None:
+            from dt_tpu.training import overlap as overlap_lib
+            self._overlap = overlap_lib.GradSyncEngine()
+        return self._overlap
+
+    def _prefetch_batch(self, train_data):
+        """Double-buffered input: dispatch the NEXT batch's host->device
+        placement right after the current step's compute is in flight, so
+        its H2D copies overlap the current step's sync/metric phase
+        instead of serializing in front of the next step (the input half
+        of the overlap design; the reference's engine overlapped IO the
+        same way, SURVEY §3.4).  Returns (batch, data_dev, labels_dev)
+        or None when the epoch's iterator is exhausted."""
+        try:
+            batch = train_data.next()
+        except StopIteration:
+            return None
+        return (batch, self._place(batch.data), self._place(batch.label))
+
     def _place(self, arr):
         if jax.process_count() > 1:
             # multi-host: this process holds only ITS batch shard; assemble
@@ -613,17 +637,27 @@ class Module:
             # pipeline never drains for metrics (the async-dispatch analog
             # of the reference engine's compute/update overlap, SURVEY §3.4).
             pending = None  # (label_np, n_real, logits_device)
+            # double-buffered input: () = nothing prefetched yet, None =
+            # iterator exhausted, tuple = batch k+1 already placed on
+            # device while step k's sync phase ran (_prefetch_batch)
+            prefetched = ()
             while True:
-                try:
-                    batch = train_data.next()
-                except StopIteration:
+                if prefetched:
+                    batch, data, labels = prefetched
+                elif prefetched is None:
                     break
+                else:
+                    try:
+                        batch = train_data.next()
+                    except StopIteration:
+                        break
+                    data = self._place(batch.data)
+                    labels = self._place(batch.label)
+                prefetched = ()
                 # step span: dispatch + host-side sync points of one
                 # batch (device programs run async — this is the control
                 # view, not a kernel timeline; jax.profiler has those)
                 _obs_st_t0 = _obs.now()
-                data = self._place(batch.data)
-                labels = self._place(batch.label)
                 if is_async:
                     # dist_async step: local grad -> push -> adopt the
                     # post-update master weights.  No peer barrier; the
@@ -634,6 +668,7 @@ class Module:
                     self._ensure_unravel()  # None after elastic rebuilds
                     flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
+                    prefetched = self._prefetch_batch(train_data)
                     new_p = self.kv.push_flat(
                         self.async_key, np.asarray(jax.device_get(flat_g)))
                     self.state = self.state.replace(
@@ -642,35 +677,55 @@ class Module:
                         if self._unravel_stats else self.state.batch_stats,
                         step=self.state.step + 1)
                 elif self.sync_mode == "host" and self.kv.num_workers > 1:
-                    if getattr(self.kv, "_controller", None) is None:
+                    ctrl = getattr(self.kv, "_controller", None)
+                    if ctrl is None:
                         raise RuntimeError(
                             "sync_mode='host' needs an elastic controller "
                             "(kv.set_controller) to carry the allreduce")
                     self._ensure_unravel()
                     flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
+                    prefetched = self._prefetch_batch(train_data)
                     gc = self.kv._gradient_compression
-                    if gc is not None:
-                        # quantize ON DEVICE, fetch only the packed words
-                        # (16x fewer boundary bytes; residual stays in HBM)
-                        packed = gc.compress_on_device(flat_g)
-                        payload = {"packed":
-                                   np.asarray(jax.device_get(packed)),
-                                   "n": int(flat_g.size),
-                                   "threshold": gc.threshold}
+                    from dt_tpu.training import overlap as overlap_lib
+                    if overlap_lib.enabled(ctrl):
+                        # bucketed D2H -> wire -> H2D pipeline; the
+                        # stats round rides concurrently.  Bit-identical
+                        # to the serial branch below (overlap.py); the
+                        # DT_AR_OVERLAP=0 escape hatch restores it.
+                        avg_g_dev, avg_s = self._overlap_engine().sync(
+                            ctrl, gc, flat_g,
+                            flat_s if self._unravel_stats is not None
+                            else None)
+                        if avg_s is None:
+                            avg_s = np.zeros((0,), np.float32)
+                        self.state = self._apply_step(
+                            self.state, avg_g_dev, jnp.asarray(avg_s))
                     else:
-                        payload = np.asarray(jax.device_get(flat_g))
-                    avg_g = self.kv._controller.allreduce("grads", payload)
-                    if self._unravel_stats is not None:
-                        avg_s = self.kv._controller.allreduce(
-                            "stats", np.asarray(jax.device_get(flat_s)))
-                    else:
-                        avg_s = np.zeros((0,), np.float32)
-                    self.state = self._apply_step(
-                        self.state, jnp.asarray(avg_g), jnp.asarray(avg_s))
+                        if gc is not None:
+                            # quantize ON DEVICE, fetch only the packed
+                            # words (16x fewer boundary bytes; residual
+                            # stays in HBM)
+                            packed = gc.compress_on_device(flat_g)
+                            payload = {"packed":
+                                       np.asarray(jax.device_get(packed)),
+                                       "n": int(flat_g.size),
+                                       "threshold": gc.threshold}
+                        else:
+                            payload = np.asarray(jax.device_get(flat_g))
+                        avg_g = ctrl.allreduce("grads", payload)
+                        if self._unravel_stats is not None:
+                            avg_s = ctrl.allreduce(
+                                "stats", np.asarray(jax.device_get(flat_s)))
+                        else:
+                            avg_s = np.zeros((0,), np.float32)
+                        self.state = self._apply_step(
+                            self.state, jnp.asarray(avg_g),
+                            jnp.asarray(avg_s))
                 else:
                     self.state, loss, logits = self._train_step(
                         self.state, data, labels, rng)
+                    prefetched = self._prefetch_batch(train_data)
                 _obs.complete_span("step", _obs_st_t0, {"epoch": epoch})
                 # flush the PREVIOUS step's metric + its callback (its
                 # logits are ready by now; this step already runs on device)

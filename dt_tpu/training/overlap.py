@@ -1,0 +1,262 @@
+"""Overlapped host-sync gradient pipeline: bucketed D2H → wire → H2D.
+
+The reference's core perf mechanism is that the dependency engine runs
+per-layer kvstore push/pull CONCURRENTLY with backward compute — worker
+ZPush/ZPull against the server fleet overlaps the rest of the backward
+pass (``src/kvstore/kvstore_dist.h:326-449``; the DT fork's whole
+throughput story, SURVEY §1).  The dt_tpu host-sync step was fully
+serial instead: ``device_get`` of the ENTIRE flat gradient, one
+monolithic controller allreduce, then apply — device idle during the
+wire phase, wire idle during the boundary copies
+(``training/module.py`` sync_mode='host').
+
+This module restores the overlap for the flat-gradient plane, following
+the pipelined-collective designs characterized in *Scalable Distributed
+DNN Training using CUDA-Aware MPI* (arXiv:1810.11112) and the chunked
+quantized-collective layout of *EQuARX* (arXiv:2506.17615):
+
+- the flat gradient splits into size-bounded buckets
+  (``DT_AR_BUCKET_BYTES``; boundaries cached per unravel spec, aligned
+  to whole 2-bit packing words when compression is on);
+- a three-stage pipeline runs per bucket — ``jax.device_get`` into a
+  preallocated, reused host staging buffer (:class:`StagingPool`) →
+  pooled-channel wire allreduce
+  (:class:`dt_tpu.elastic.client.AllreducePipeline`, the r7 window
+  machinery fed bucket-by-bucket from a background comm thread) →
+  per-bucket H2D staging for the jitted apply step — so bucket k's wire
+  round overlaps bucket k+1's D2H and bucket k-1's H2D;
+- the ``"stats"`` allreduce and the 2-bit ``compress_on_device`` path
+  ride the same pipeline concurrently.
+
+Semantics are bit-identical to the serial path: bucket boundaries only
+re-tile the SAME elementwise per-contributor summation the data plane
+performs either way (``elastic/dataplane.py`` accumulates contributions
+in worker order per element; 2-bit quantization is elementwise with the
+residual held on device), and ``DT_AR_OVERLAP=0`` degrades cleanly to
+the serial step.  Fault semantics are inherited per bucket round:
+idempotency-token replay covers a reset/drop mid-bucket, and a failure
+mid-pipeline drains the comm thread without leaking staging buffers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dt_tpu import config
+from dt_tpu.obs import trace as obs_trace
+
+
+def enabled(controller) -> bool:
+    """Whether the overlapped step applies: ``DT_AR_OVERLAP`` != 0 (the
+    escape hatch; must be identical job-wide — bucket subkeys only pair
+    with bucket subkeys) and the controller speaks the pipeline API
+    (duck-typed test controllers fall back to the serial path)."""
+    if config.env("DT_AR_OVERLAP").strip().lower() in ("0", "false"):
+        return False
+    return hasattr(controller, "allreduce_pipeline")
+
+
+@functools.lru_cache(maxsize=256)
+def bucket_bounds(n_elems: int, elem_bytes: int, bucket_bytes: int,
+                  quantum: int = 1) -> Tuple[Tuple[int, int], ...]:
+    """((start, stop), ...) element ranges of the bucket grid for a flat
+    vector of ``n_elems`` — cached per unravel spec, so the per-step cost
+    is one dict hit.  ``quantum`` aligns boundaries to whole 2-bit
+    packing words (16 codes per uint32) so every bucket's packed words
+    slice cleanly; the last bucket carries the remainder."""
+    if n_elems <= 0:
+        return ((0, 0),)
+    per = max(1, bucket_bytes // max(elem_bytes, 1))
+    if quantum > 1:
+        per = max(quantum, (per // quantum) * quantum)
+    return tuple((start, min(start + per, n_elems))
+                 for start in range(0, n_elems, per))
+
+
+class StagingPool:
+    """Preallocated, reused host staging buffers for the D2H stage.
+
+    The serial step allocated a fresh host copy of the whole gradient
+    every batch; here at most ~2 x window buckets are live at once (the
+    pipeline's input backpressure bounds it) and buffers recycle across
+    steps.  ``max_bytes`` (``DT_AR_STAGING_MB``) caps what the FREE list
+    retains — beyond it, returned buffers are dropped to the allocator
+    instead of pooled.  Single-owner discipline: the engine acquires on
+    the caller thread and releases a bucket's buffer only after its wire
+    round completed (result delivered, or the pipeline's drain joined),
+    so a pooled buffer is never handed out while the wire still reads
+    it; :meth:`forfeit` covers the drain-timeout path by dropping the
+    buffer instead of recycling it.
+    """
+
+    def __init__(self, max_bytes: int):
+        self._max_bytes = int(max_bytes)
+        self._free: Dict[tuple, list] = {}  # (nelems, dtype) -> [arr, ...]
+        self._free_bytes = 0
+        self.outstanding = 0  # acquired and not yet released/forfeited
+        self.allocated = 0    # total buffers ever malloc'd (reuse metric)
+
+    def acquire(self, n: int, dtype) -> np.ndarray:
+        key = (int(n), np.dtype(dtype).str)
+        lst = self._free.get(key)
+        if lst:
+            buf = lst.pop()
+            self._free_bytes -= buf.nbytes
+        else:
+            buf = np.empty(int(n), np.dtype(dtype))
+            self.allocated += 1
+        self.outstanding += 1
+        return buf
+
+    def release(self, buf: np.ndarray) -> None:
+        self.outstanding -= 1
+        if self._free_bytes + buf.nbytes > self._max_bytes:
+            return  # cap: hand it back to the allocator
+        key = (buf.size, buf.dtype.str)
+        self._free.setdefault(key, []).append(buf)
+        self._free_bytes += buf.nbytes
+
+    def forfeit(self, buf: np.ndarray) -> None:
+        """Account a buffer that must NOT be recycled (a wire thread may
+        still be reading it after a drain timeout): the reference is
+        dropped, the allocator reclaims it when the wire lets go."""
+        self.outstanding -= 1
+
+
+def _prefetch_d2h(dev_array) -> None:
+    """Start the device→host copy without blocking (overlaps the
+    PREVIOUS bucket's staging copy / wire dispatch); jax arrays expose
+    ``copy_to_host_async`` — harmless no-op elsewhere."""
+    try:
+        dev_array.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass
+
+
+class GradSyncEngine:
+    """One Module/Trainer's overlapped gradient synchronizer.
+
+    ``sync`` runs a single step's host-sync: D2H → wire → H2D per
+    bucket, the stats round concurrent, returning DEVICE arrays ready
+    for the jitted apply step.  Holds the staging pool across steps so
+    buffers recycle.
+    """
+
+    def __init__(self):
+        self._staging = StagingPool(
+            int(config.env("DT_AR_STAGING_MB")) * (1 << 20))
+
+    @property
+    def staging(self) -> StagingPool:
+        return self._staging
+
+    def _window(self, controller, bucket_bytes: int) -> Optional[int]:
+        """Clamp the pipeline window so live staging (~2 x window x
+        bucket) respects ``DT_AR_STAGING_MB``."""
+        base = getattr(controller, "_ar_window", None)
+        base = base() if callable(base) else 4
+        cap = self._staging._max_bytes // max(2 * bucket_bytes, 1)
+        return max(1, min(base, cap)) if cap else 1
+
+    def sync(self, controller, gc, flat_g, flat_s=None, key: str = "grads"):
+        """Exact-average ``flat_g`` (and optionally ``flat_s``) across
+        workers through the bucketed pipeline.
+
+        ``flat_g``/``flat_s`` are DEVICE arrays (the grad step's
+        outputs); ``gc`` is the kvstore's ``GradientCompression`` or
+        None.  Returns ``(avg_flat_dev, avg_stats_np_or_None)`` —
+        the gradient re-assembled on device (per-bucket H2D dispatched
+        as results arrived), bit-identical to the serial
+        ``controller.allreduce(key, ...)`` result.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        tr = obs_trace.tracer()
+        t0 = tr.now()
+        n = int(flat_g.size)
+        elem_bytes = int(np.dtype(flat_g.dtype).itemsize)
+        bucket_bytes = int(config.env("DT_AR_BUCKET_BYTES"))
+        thr = None
+        if gc is not None:
+            from dt_tpu.parallel.compression import CODES_PER_WORD
+            quantum = CODES_PER_WORD
+            packed = gc.compress_on_device(flat_g)  # residual stays in HBM
+            thr = float(gc.threshold)
+        else:
+            quantum = 1
+        bounds = bucket_bounds(n, elem_bytes, bucket_bytes, quantum)
+        nb = len(bounds)
+        if gc is not None:
+            slices = [packed[a // quantum: -(-b // quantum)]
+                      for a, b in bounds]
+        else:
+            slices = [flat_g[a:b] for a, b in bounds]
+        _prefetch_d2h(slices[0])
+
+        pipe = controller.allreduce_pipeline(
+            key, window=self._window(controller, bucket_bytes))
+        out_dev = [None] * nb
+        outstanding: Dict[int, np.ndarray] = {}  # idx -> staging buffer
+
+        def h2d(i, avg):
+            th = tr.now()
+            out_dev[i] = jnp.asarray(avg)  # async dispatch; apply consumes
+            tr.complete_span("pipeline.h2d", th, {"bucket": i})
+            buf = outstanding.pop(i, None)
+            if buf is not None:  # round i done: the wire released it
+                self._staging.release(buf)
+
+        stats_avg = None
+        try:
+            if flat_s is not None:
+                # the stats round rides the same window, concurrent with
+                # the grad buckets (never compressed, same as serial)
+                pipe.submit_aux("stats",
+                                np.asarray(jax.device_get(flat_s)))
+            for k, (a, b) in enumerate(bounds):
+                if k + 1 < nb:
+                    _prefetch_d2h(slices[k + 1])
+                td = tr.now()
+                if gc is not None:
+                    buf = self._staging.acquire(int(slices[k].size),
+                                                np.uint32)
+                    np.copyto(buf, np.asarray(slices[k]))
+                    payload = {"packed": buf, "n": b - a, "threshold": thr}
+                else:
+                    buf = self._staging.acquire(b - a, flat_g.dtype)
+                    np.copyto(buf, np.asarray(slices[k]))
+                    payload = buf
+                tr.complete_span("pipeline.d2h", td,
+                                 {"bucket": k, "elems": b - a})
+                outstanding[k] = buf
+                pipe.submit(payload)
+                for i, avg in pipe.poll():  # H2D overlaps later buckets
+                    h2d(i, avg)
+            pipe.done_submitting()
+            while True:
+                got = pipe.next_result()
+                if got is None:
+                    break
+                h2d(*got)
+            if flat_s is not None:
+                stats_avg = pipe.aux("stats")
+        finally:
+            joined = pipe.close()
+            # failure drain: every buffer either recycles (comm thread
+            # provably done with it) or is forfeited — never leaked,
+            # never recycled while the wire might still read it
+            for buf in outstanding.values():
+                (self._staging.release if joined
+                 else self._staging.forfeit)(buf)
+            outstanding.clear()
+            if obs_trace.enabled():  # gated exactly like the serial
+                # path's allreduce.rounds (elastic/client.py allreduce)
+                tr.counter("allreduce.rounds")
+            tr.complete_span("allreduce", t0,
+                             {"key": key, "pipelined": True, "buckets": nb})
+        avg_dev = out_dev[0] if nb == 1 else jnp.concatenate(out_dev)
+        return avg_dev, stats_avg

@@ -53,6 +53,7 @@ class Trainer:
         self._step_fn = None
         self._async_key = async_key
         self._unravel = None  # dist_async flat-vector plane (set on attach)
+        self._overlap = None  # bucketed host-sync engine (overlap.py), lazy
 
     def _build(self):
         tx = self.tx
@@ -68,12 +69,22 @@ class Trainer:
         """Average grads across workers (reference
         ``Trainer.allreduce_grads``); on a mesh this is a no-op — gradients
         were already psum'd inside jit — so this only acts under a
-        host-sync controller."""
+        host-sync controller.  Rides the bucketed D2H -> wire -> H2D
+        overlap pipeline (``training/overlap.py``) when ``DT_AR_OVERLAP``
+        is on and the controller supports it; falls back to the serial
+        whole-gradient round otherwise — both bit-identical."""
         ctrl = self.kv._controller
         if ctrl is None or self.kv.num_workers <= 1:
             return grads
         import numpy as np
+        from dt_tpu.training import overlap as overlap_lib
         flat, unravel = jax.flatten_util.ravel_pytree(grads)
+        if overlap_lib.enabled(ctrl):
+            if self._overlap is None:
+                self._overlap = overlap_lib.GradSyncEngine()
+            avg_dev, _ = self._overlap.sync(ctrl, None, flat,
+                                            key="trainer_grads")
+            return unravel(avg_dev)
         avg = ctrl.allreduce("trainer_grads",
                              np.asarray(jax.device_get(flat)))
         return unravel(jnp.asarray(avg))

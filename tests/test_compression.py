@@ -148,40 +148,73 @@ def test_module_host_sync_with_compression_end_to_end():
     """Two Modules under sync_mode='host' with 2-bit compression: the
     on-device quantize path carries the whole run and both workers end
     bit-identical (the reference's dist_sync + gradient compression
-    contract, dist_sync_kvstore.py compressed section)."""
+    contract, dist_sync_kvstore.py compressed section).
+
+    Each worker gets a DISJOINT 4-device submesh and its jit steps are
+    compiled on the MAIN thread before the fit threads start: two
+    threads concurrently executing programs that span all 8 CPU devices
+    share every device thread, and XLA CPU can wedge one program behind
+    the other indefinitely (same hazard — and same medicine — as
+    ``tests/test_overlap.py::_run_host_pair``; real deployments run one
+    process per worker)."""
     import jax
     from dt_tpu import data, models, parallel
     from dt_tpu.elastic import Scheduler, WorkerClient
+    from dt_tpu.parallel import mesh as mesh_lib
     from dt_tpu.training import Module
 
     s = Scheduler(initial_workers=["w0", "w1"])
     rng = np.random.RandomState(5)
     X = rng.uniform(-1, 1, (64, 12)).astype(np.float32)
     Y = rng.randint(0, 3, 64)
-    params_out = {}
+    params_out, errs = {}, {}
 
-    def worker(host):
-        cli = WorkerClient("127.0.0.1", s.port, host=host)
-        kv = parallel.create("dist_sync")
-        kv.set_controller(cli)
-        kv.set_gradient_compression({"type": "2bit", "threshold": 0.05})
-        mod = Module(models.create("mlp", num_classes=3, hidden=(16,)),
-                     optimizer="sgd",
-                     optimizer_params={"learning_rate": 0.1},
-                     kvstore=kv, seed=9)
-        mod.sync_mode = "host"
-        mod.fit(data.NDArrayIter(X, Y, batch_size=16), num_epoch=2)
-        params_out[host] = [np.asarray(p) for p in
-                            jax.tree_util.tree_leaves(mod.state.params)]
-        cli.close()
-
+    mods = {}
+    devs = jax.devices()
     try:
+        for wi, host in enumerate(("w0", "w1")):
+            cli = WorkerClient("127.0.0.1", s.port, host=host)
+            kv = parallel.create("dist_sync")
+            kv.set_controller(cli)
+            kv.set_gradient_compression({"type": "2bit",
+                                         "threshold": 0.05})
+            mod = Module(models.create("mlp", num_classes=3, hidden=(16,)),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1},
+                         kvstore=kv, seed=9,
+                         mesh=mesh_lib.make_mesh(
+                             devices=devs[wi * 4:(wi + 1) * 4]))
+            mod.sync_mode = "host"
+            # pre-compile grad/apply on the main thread (exact fit-batch
+            # shapes via the iterator); outputs discarded, state untouched
+            b = data.NDArrayIter(X, Y, batch_size=16).next()
+            mod.init_params(b.data)
+            mod._build_steps()
+            mod._ensure_unravel()
+            fg, fs, _, _ = mod._grad_step(
+                mod.state, mod._place(b.data), mod._place(b.label),
+                jax.random.PRNGKey(0))
+            mod._apply_step(mod.state, fg, fs)
+            mods[host] = (cli, mod)
+
+        def worker(host):
+            try:
+                cli, mod = mods[host]
+                mod.fit(data.NDArrayIter(X, Y, batch_size=16), num_epoch=2)
+                params_out[host] = [np.asarray(p) for p in
+                                    jax.tree_util.tree_leaves(
+                                        mod.state.params)]
+                cli.close()
+            except Exception as e:  # noqa: BLE001 - surfaced by the assert
+                errs[host] = e
+
         ts = [threading.Thread(target=worker, args=(h,))
               for h in ("w0", "w1")]
         for t in ts:
             t.start()
         for t in ts:
             t.join(timeout=180)
+        assert not errs, errs
         assert set(params_out) == {"w0", "w1"}
         for a, b in zip(params_out["w0"], params_out["w1"]):
             np.testing.assert_array_equal(a, b)

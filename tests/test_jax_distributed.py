@@ -17,6 +17,7 @@ suite's conftest initializes the 8-device CPU backend.
 """
 
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -123,12 +124,16 @@ def test_four_process_full_elastic_lifecycle(tmp_path):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own (2 devices/process)
     env["PYTHONPATH"] = os.path.dirname(_HERE)
+    # each worker gets its OWN session/process group: phase 4 survivors
+    # Popen a restarted self and os._exit, so on a failure/timeout those
+    # DETACHED grandchildren outlive p.kill() and poison the next run's
+    # ports + gloo rendezvous — killpg reaps the whole tree
     procs = {
         wid: subprocess.Popen(
             [sys.executable, os.path.join(_HERE, "jaxdist_worker_4p.py"),
              str(tmp_path), str(wid)] + ports,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
+            env=env, start_new_session=True)
         for wid in (0, 1, 2, 3, 4)
     }
     outs = {}
@@ -139,6 +144,11 @@ def test_four_process_full_elastic_lifecycle(tmp_path):
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
+                p.wait(timeout=30)
+            try:  # phase4-child grandchildren share the worker's pgid
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass  # whole group already gone — the healthy-run case
     for wid, p in procs.items():
         assert p.returncode == 0, \
             f"w{wid} failed:\n{outs.get(wid, '')[-5000:]}"

@@ -116,6 +116,15 @@ def main():
                          "the in-process scheduler AND the workers, and "
                          "cross-checks the timeline against the fault "
                          "plan's applied counts")
+    ap.add_argument("--expect-param-hash", default="",
+                    help="assert the job's final param_hash equals this "
+                         "(the r10 overlap acceptance: run the SAME "
+                         "plan/seed with DT_AR_OVERLAP=0 first, then "
+                         "overlapped with the serial run's hash — the "
+                         "pipeline under faults must land on identical "
+                         "params; a faulted run does NOT match --plan "
+                         "none bitwise: the crash shrinks membership "
+                         "for some rounds, in both modes, by design)")
     args = ap.parse_args()
 
     if args.trace:
@@ -190,11 +199,19 @@ def main():
                 results[h] = json.load(open(outs[h]))
             except (OSError, ValueError):
                 checks[f"result_{h}"] = False
+        param_hash = None
         if len(results) == len(HOSTS):
             losses = [r["final_loss"] for r in results.values()]
             checks["loss_finite"] = all(math.isfinite(l) for l in losses)
             checks["params_identical"] = \
                 len({r["param_hash"] for r in results.values()}) == 1
+            if checks["params_identical"]:
+                param_hash = next(iter(results.values()))["param_hash"]
+            if args.expect_param_hash:
+                # the overlapped host-sync pipeline under the fault plan
+                # must be bit-identical to the fault-free baseline run
+                checks["params_match_baseline"] = \
+                    repr(param_hash) == args.expect_param_hash
             checks["steps_identical"] = \
                 len({r["final_step"] for r in results.values()}) == 1
             checks["membership_converged"] = (
@@ -213,6 +230,7 @@ def main():
             tstats["requests"] > 2 * tstats["connections"]
 
         summary = None
+        pipeline_buckets = None
         if args.trace:
             # merged job timeline: the obs subsystem and the fault
             # harness verify each other — every fault the plan APPLIED
@@ -258,11 +276,30 @@ def main():
             if expect_crash:
                 checks["trace_crash_event"] = \
                     ev.get((CRASH_HOST, "crash"), 0) >= 1
+            # the r10 overlap engine actually ran: every worker's step
+            # loop pushed gradient buckets through AllreducePipeline
+            # (DT_AR_OVERLAP defaults on; a silent fall-back to the
+            # serial path would zero this counter) — unless the operator
+            # asked for the serial path, e.g. the DT_AR_OVERLAP=0
+            # baseline leg of the --expect-param-hash workflow, where
+            # a zero count is the healthy expectation
+            from dt_tpu import config as dt_config
+            serial_requested = dt_config.env(
+                "DT_AR_OVERLAP").strip().lower() in ("0", "false")
+            pipeline_buckets = sum(
+                tracks[t].get("pipeline_buckets", 0)
+                for t in worker_tracks)
+            checks["pipeline_buckets"] = (
+                pipeline_buckets == 0 if serial_requested
+                else pipeline_buckets > 0)
 
         ok = bool(checks) and all(checks.values())
         print(json.dumps({
             "ok": ok, "plan": args.plan, "seed": args.seed,
             "num_epoch": args.num_epoch, "checks": checks,
+            "param_hash": param_hash,
+            "pipeline_buckets":
+                pipeline_buckets if summary else None,
             "transport": tstats,
             "final_loss": {h: r.get("final_loss")
                            for h, r in results.items()},

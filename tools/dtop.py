@@ -85,6 +85,22 @@ def render(summary) -> str:
             parts = "  ".join(f"{k}={v:.1f}"
                               for k, v in sorted(stall.items()))
             lines.append(f"  {name:<20}{parts}")
+    # overlap-pipeline split: the allreduce stall above, broken into the
+    # d2h/wire/h2d stage spans of the bucketed host-sync pipeline (these
+    # run concurrently, so the stage sums exceed the stall wall-clock
+    # exactly when the overlap is working)
+    pipe_any = any(tracks[n].get("pipeline_ms") for n in worker_tracks)
+    if pipe_any:
+        lines.append("")
+        lines.append("pipeline stages (ms; concurrent — sums exceed the "
+                     "allreduce stall when overlap works):")
+        for name in worker_tracks:
+            pm = tracks[name].get("pipeline_ms", {})
+            if pm:
+                parts = "  ".join(f"{k}={v:.1f}"
+                                  for k, v in sorted(pm.items()))
+                nb = tracks[name].get("pipeline_buckets", 0)
+                lines.append(f"  {name:<20}{parts}  buckets={nb}")
     faults_any = any(tracks[n].get("faults") for n in tracks)
     if faults_any:
         lines.append("")
