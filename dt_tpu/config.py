@@ -70,6 +70,13 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "DT_WORKER_ID": ("", "this worker's host identity under the launcher env contract"),
     "DT_RECOVERY": ("", "1 = re-register under the old identity after a crash (restart wrapper)"),
     "DT_SERVER_ID": ("0", "range-server index under the launcher env contract"),
+    # control-plane HA (scheduler journal / warm standby / client failover)
+    "DT_CTRL_JOURNAL": ("", "control-state write-ahead journal path (enables scheduler HA replay)"),
+    "DT_CTRL_LEASE": ("", "leader lease file path (default <journal>.lease)"),
+    "DT_CTRL_LEASE_S": ("2.0", "leader lease duration; the standby takes over after this much silence"),
+    "DT_CTRL_TOKEN_TTL_S": ("300", "idempotency-token response-cache TTL (LRU cap + TTL bound scheduler memory)"),
+    "DT_CTRL_ENDPOINTS": ("", "ordered scheduler endpoints host:port[,host:port] for client failover (leader first)"),
+    "DT_CTRL_FAILOVER_S": ("60", "client-side wall budget for failing a request over across the endpoint list"),
     # observability (dt_tpu/obs)
     "DT_OBS": ("", "1 = enable dt_tpu.obs tracing (span/event ring buffer + heartbeat export)"),
     "DT_OBS_RING": (str(4096), "obs ring-buffer capacity (records per tracer; overflow drops oldest)"),

@@ -15,8 +15,9 @@ import queue
 import socket
 import threading
 import time
+import uuid
 import zlib
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -32,6 +33,19 @@ _OBS_PENDING_MAX = 8192
 #: records per flush message (bounded bites: a post-outage backlog drains
 #: over a few heartbeats instead of one oversized frame)
 _OBS_FLUSH_MAX = 2048
+
+
+def _parse_endpoints(spec: str) -> List[Tuple[str, int]]:
+    """``host:port[,host:port]`` -> ordered address list (the
+    ``DT_CTRL_ENDPOINTS`` contract: leader first, standbys after)."""
+    out: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
 
 
 def _row_bounds(n: int, r: int) -> List[int]:
@@ -55,8 +69,28 @@ class WorkerClient:
     def __init__(self, scheduler_host: str, scheduler_port: int,
                  host: Optional[str] = None, is_new: Optional[bool] = None,
                  heartbeat_interval_s: float = 1.0,
-                 is_recovery: Optional[bool] = None):
-        self.addr = (scheduler_host, scheduler_port)
+                 is_recovery: Optional[bool] = None,
+                 endpoints: Optional[Sequence[Tuple[str, int]]] = None):
+        # ordered scheduler endpoint list (r11 control-plane HA): the
+        # leader first, warm standbys after.  ``endpoints`` (or
+        # ``DT_CTRL_ENDPOINTS`` from the launcher) turns every control
+        # request into a transparently failing-over call: a dead or
+        # deposed leader rotates the client to the next endpoint, where
+        # it re-registers under the new fencing incarnation and replays
+        # the in-flight request through the existing idempotency-token /
+        # (host, seq) dedup machinery — barriers and allreduce rounds
+        # complete exactly once across the switch (docs/ha.md).
+        eps = endpoints
+        if eps is None:
+            spec = config.env("DT_CTRL_ENDPOINTS")
+            if spec:
+                eps = _parse_endpoints(spec)
+        self.addrs: List[Tuple[str, int]] = \
+            [tuple(a) for a in eps] if eps \
+            else [(scheduler_host, scheduler_port)]
+        self._leader = 0  # index into addrs; guarded-by: _addr_lock
+        self._addr_lock = threading.Lock()  # heartbeat vs caller thread
+        self.fence = 0  # leader incarnation we registered under
         self.host = host or f"{socket.gethostname()}:{os.getpid()}"
         if is_new is None:
             is_new = os.environ.get("NEW_WORKER", "") in ("1", "true")
@@ -67,6 +101,7 @@ class WorkerClient:
         faults.crash_point("client.register", host=self.host)
         resp = self._req({"cmd": "register", "host": self.host,
                           "is_new": is_new, "is_recovery": is_recovery})
+        self.fence = int(resp.get("fence", 0))
         self.rank: int = resp["rank"]
         self.workers: List[str] = resp["workers"]
         # recovery re-entry: rank -1 until the next membership barrier
@@ -136,6 +171,12 @@ class WorkerClient:
     def num_workers(self) -> int:
         return len(self.workers)
 
+    @property
+    def addr(self) -> Tuple[str, int]:
+        """The endpoint this client currently believes is the leader."""
+        with self._addr_lock:
+            return self.addrs[self._leader]
+
     def _req_addr(self, addr, msg: dict, timeout: float = 600.0,
                   retries: int = 8) -> dict:
         """Request with at-least-once retry — the Resender role
@@ -144,7 +185,16 @@ class WorkerClient:
         the SAME idempotency token, so a replay whose first dispatch
         completed is served the cached response (the per-command
         (host, seq) dedup covers the data plane).  ``retries`` is the
-        total attempt count, matching the historical signature."""
+        total attempt count, matching the historical signature.
+
+        HA: when an endpoint list is configured and ``addr`` IS a
+        scheduler endpoint (data-plane rounds land here whenever no
+        range servers registered), the request rides the failover
+        machinery — a dead leader rotates instead of erroring out of an
+        allreduce mid-epoch.  Range-server addresses never rotate."""
+        if len(self.addrs) > 1 and tuple(addr) in \
+                {tuple(a) for a in self.addrs}:
+            return self._req_failover(msg, timeout, retries)
         resp = protocol.request(addr[0], addr[1], msg, timeout=timeout,
                                 retries=max(retries - 1, 0))
         if "error" in resp:
@@ -153,7 +203,110 @@ class WorkerClient:
 
     def _req(self, msg: dict, timeout: float = 600.0,
              retries: int = 8) -> dict:
-        return self._req_addr(self.addr, msg, timeout, retries)
+        if len(self.addrs) == 1:
+            return self._req_addr(self.addr, msg, timeout, retries)
+        return self._req_failover(msg, timeout, retries)
+
+    # -- scheduler failover (r11 control-plane HA) -------------------------
+
+    def _req_failover(self, msg: dict, timeout: float,
+                      retries: int) -> dict:
+        """One control request against the ordered endpoint list.  The
+        idempotency token is pinned BEFORE the first attempt so a replay
+        that crosses endpoints (old leader acted, response lost, retry
+        lands on the successor) dedups exactly like a same-endpoint
+        retry; ``not_leader``/``fenced`` answers rotate like dead
+        connections.  Rotation re-registers this host under the new
+        leader's fencing incarnation before the in-flight request is
+        replayed.  Backoff between rotations uses the decorrelated
+        jitter (:func:`protocol.next_backoff`) so a whole fleet failing
+        over does not arrive at the standby in lockstep waves."""
+        msg = dict(msg)
+        msg.setdefault("token", uuid.uuid4().hex)
+        msg.setdefault("fence", self.fence)
+        # DT_CTRL_FAILOVER_S bounds the ROTATION budget, not one
+        # attempt: each attempt runs with the caller's full request
+        # timeout (barriers legitimately park minutes on a healthy
+        # leader, so per-attempt capping would cause spurious
+        # rotations).  A black-holed (partitioned, no RST) leader is
+        # therefore detected only after the caller's timeout — but the
+        # deadline must never stop us trying EVERY endpoint at least
+        # once, or a single long-blocked attempt would exhaust the
+        # budget without the standby ever seeing the request.
+        deadline = time.monotonic() + \
+            float(config.env("DT_CTRL_FAILOVER_S"))
+        attempts = max(2, retries) * len(self.addrs)
+        delay = 0.1
+        tried: set = set()
+        last_exc: Optional[Exception] = None
+        for _ in range(attempts):
+            addr = self.addr
+            tried.add(tuple(addr))
+            try:
+                resp = protocol.request(addr[0], addr[1], msg,
+                                        timeout=timeout, retries=1)
+            except (ConnectionError, socket.timeout, OSError) as e:
+                last_exc = e
+                resp = None
+            if resp is not None:
+                err = resp.get("error")
+                if err is None:
+                    return resp
+                if not (str(err).startswith("not_leader")
+                        or str(err).startswith("fenced")):
+                    raise RuntimeError(f"scheduler error: {err}")
+                last_exc = ConnectionError(
+                    f"scheduler at {addr} refused: {err}")
+            if len(tried) >= len(self.addrs) and \
+                    time.monotonic() + delay > deadline:
+                break
+            time.sleep(delay)
+            delay = protocol.next_backoff(delay, 0.1, 1.0)
+            self._rotate_leader(addr, msg.get("cmd"))
+        raise last_exc if last_exc is not None else \
+            ConnectionError("control plane unreachable")
+
+    def _rotate_leader(self, failed_addr: Tuple[str, int],
+                       cmd: Optional[str]) -> None:
+        """Advance to the next endpoint (first thread to observe the
+        failure wins; laggards see the rotation already happened) and
+        re-establish identity there."""
+        with self._addr_lock:
+            rotated = self.addrs[self._leader] == tuple(failed_addr)
+            if rotated:
+                self._leader = (self._leader + 1) % len(self.addrs)
+            target = self.addrs[self._leader]
+        if rotated and obs_trace.enabled():
+            tr = obs_trace.tracer()
+            tr.counter("client.failover")
+            tr.event("client.failover", {"to": f"{target[0]}:{target[1]}",
+                                         "cmd": cmd})
+        if rotated and cmd != "register":
+            self._reattach(target)
+
+    def _reattach(self, addr: Tuple[str, int]) -> None:
+        """Re-register under the (possibly new) leader — refreshing our
+        fencing incarnation so subsequent requests carry it.  Membership
+        is journal-replayed on the successor, so this never perturbs
+        rank or the live set; best-effort (a passive standby refuses it,
+        and the very refusal is what triggers its on-demand takeover)."""
+        try:
+            resp = protocol.request(
+                addr[0], addr[1],
+                {"cmd": "register", "host": self.host, "is_new": False,
+                 "is_recovery": False, "reattach": True,
+                 "token": uuid.uuid4().hex},
+                timeout=10.0, retries=1)
+        except (ConnectionError, socket.timeout, OSError):
+            return
+        if "error" in resp:
+            return
+        fence = int(resp.get("fence", 0))
+        if fence != self.fence:
+            self.fence = fence
+            if obs_trace.enabled():
+                obs_trace.tracer().event("client.reattached",
+                                         {"fence": fence})
 
     # -- sharded-plane routing (kvstore_dist.h:547-589) --------------------
 
@@ -882,7 +1035,7 @@ class WorkerClient:
         # drop this client's idle pooled channels: the server side's
         # per-connection threads see EOF and exit (fd/thread hygiene
         # when tests churn through schedulers)
-        for addr in [self.addr] + list(self.servers):
+        for addr in list(self.addrs) + list(self.servers):
             protocol.pool().close_addr(tuple(addr))
 
 

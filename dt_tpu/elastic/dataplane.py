@@ -32,12 +32,21 @@ class DataPlane:
 
     def __init__(self, expected_fn: Callable[[], Set[str]],
                  confirm_fn: Optional[Callable[[], Set[str]]] = None,
-                 tracer=None):
+                 tracer=None, replicate_fn=None):
         # observability sink (dt_tpu/obs): the embedding server passes its
         # control-plane tracer so round counters/events land on its track
         from dt_tpu.obs import trace as obs_trace
         self._obs = tracer if tracer is not None else obs_trace.tracer()
         self.expected_fn = expected_fn
+        # HA round replication (scheduler warm-standby, docs/ha.md):
+        # called with (key, gen, {host: seq}, result) AFTER a round's
+        # result is computed and BEFORE any waiter is released, so a
+        # standby that takes over can serve an at-least-once retry of an
+        # already-completed round the IDENTICAL average instead of
+        # folding the stale contribution into a fresh (wrong) round.
+        # Best-effort: a dead standby degrades HA, never the round.
+        self._replicate = replicate_fn
+        self._replicate_warned = False  # one log line per outage, not per round
         # called right before a round completes, for an AUTHORITATIVE
         # membership recheck: a range server serves allreduce against a
         # TTL-cached mirror, and completing a round off a stale cache
@@ -119,6 +128,28 @@ class DataPlane:
             for key in [k for k in self._async_last_seen
                         if k[0] in hosts]:
                 del self._async_last_seen[key]
+
+    def install_round(self, key: str, gen: int, seqs: Dict[str, int],
+                      result) -> None:
+        """Install a completed round replicated by the live primary
+        (``ha_round``, docs/ha.md): advance the slot generation and seed
+        the per-host served cache so a post-failover retry of that round
+        is answered the identical result.  Idempotent — an older or
+        duplicate replica (gen at-or-below ours) is a no-op, and any
+        pending contribution at-or-below a served seq is dropped (it
+        belongs to the replicated round, not a fresh one)."""
+        with self._cv:
+            slot = self._reduce.setdefault(
+                key, {"vals": {}, "gen": 0, "result": None, "served": {}})
+            if int(gen) <= slot["gen"]:
+                return
+            slot["gen"] = int(gen)
+            for h, s in seqs.items():
+                slot["served"][h] = (int(s), result)
+                pend = slot["vals"].get(h)
+                if pend is not None and pend[0] <= int(s):
+                    del slot["vals"][h]
+            self._cv.notify_all()
 
     def complete_with(self, live: Set[str], ordered=None) -> None:
         """After membership shrank, finish any allreduce round now
@@ -214,6 +245,23 @@ class DataPlane:
             slot["result"] = acc.astype(out_dtype, copy=False)
         for h, (h_seq, _) in slot["vals"].items():
             slot["served"][h] = (h_seq, slot["result"])
+        if self._replicate is not None:
+            # ship the served results to the warm standby BEFORE any
+            # waiter sees them (under the CV — a loopback RTT per round
+            # is the price of exactly-once rounds across a failover;
+            # deployments without a standby never pay it)
+            try:
+                self._replicate(key, slot["gen"] + 1,
+                                {h: s for h, (s, _) in slot["vals"].items()},
+                                slot["result"])
+                self._replicate_warned = False
+            except Exception as e:
+                if not self._replicate_warned:
+                    self._replicate_warned = True
+                    import logging
+                    logging.getLogger("dt_tpu.elastic").warning(
+                        "HA round replication to standby failed (%s); "
+                        "continuing unreplicated", e)
         slot["vals"] = {}
         slot["gen"] += 1
         self._obs.counter("dataplane.rounds")

@@ -43,6 +43,15 @@ Crash sites currently instrumented:
 - ``client.heartbeat``   — kills the heartbeat thread only
 - ``sched.register``     — scheduler dies mid-registration
 - ``sched.barrier_arrived`` — scheduler dies after recording an arrival
+  (the arrival is journaled, so HA failover resumes the barrier —
+  mid-barrier scheduler kill, ``chaos_run --plan scheduler_kill_barrier``)
+- ``sched.allreduce``    — scheduler dies on receipt of a data-plane
+  round contribution (mid-epoch scheduler kill, possibly mid-round;
+  ``chaos_run --plan scheduler_kill``)
+- ``sched.membership_change`` — scheduler dies INSIDE
+  ``_apply_membership_change``, between journaled membership ops (the
+  partial-change prefix the successor must resume;
+  ``chaos_run --plan scheduler_kill_mc``)
 - ``module.epoch_begin`` — worker dies exactly at an epoch boundary
   (rule ``epoch=`` pins which one)
 

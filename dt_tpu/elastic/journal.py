@@ -1,0 +1,565 @@
+"""Control-plane durability: write-ahead journal, leader lease, fencing,
+and the :class:`ControlState` the scheduler's guarded state lives behind.
+
+The reference's scheduler kept every piece of job state — membership,
+barrier arrivals, the recovery queue, the audit seq — in one process's
+memory (``ps-lite/src/elastic_training.cc:1-158``, ``van.cc:256-315``):
+scheduler death killed the job.  This module makes every control-state
+transition a named, durably replayable *op*:
+
+- :class:`ControlState` owns the state the round-3 scheduler kept as bare
+  attributes (``scheduler.py`` worker registry / barrier / recovery-queue
+  / snapshot fields) and mutates ONLY through :meth:`ControlState.apply`
+  — a small op vocabulary (``init``, ``worker_add``, ``mc_remove``,
+  ``barrier_complete``, ...) designed so that replaying a journal is
+  deterministic and **idempotent** (applying a journal twice equals
+  applying it once; every op guards its own effects and absolute
+  sequence numbers ride in the record, never recomputed).
+- :class:`JournalWriter` appends ``u32 len | u32 crc32 | pickle((fence,
+  op, kwargs))`` records with ``fsync`` before the state mutates (WAL
+  discipline: what the scheduler acknowledged is on disk).  A torn final
+  record — the crash-mid-``fsync`` case — fails its CRC/length check and
+  replay stops cleanly before it.
+- :class:`Lease` + fencing: leadership is a lease file carrying a
+  monotonic **incarnation**.  A standby that observes lease expiry
+  acquires it with ``incarnation + 1``; the journal writer re-reads the
+  lease on every append and raises :class:`Fenced` when a newer
+  incarnation exists — a deposed primary cannot write a single further
+  record (the ZooKeeper/chubby fencing-token discipline the reference
+  never needed because it simply died).
+
+See ``docs/ha.md`` for the failover timeline and the op catalog.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import pickle
+import struct
+import threading
+import time
+import zlib
+
+try:  # posix-only; the HA pair targets linux hosts (CLAUDE.md)
+    import fcntl
+except ImportError:  # pragma: no cover - non-posix fallback
+    fcntl = None  # type: ignore[assignment]
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+logger = logging.getLogger("dt_tpu.elastic")
+
+_HDR = struct.Struct("<II")  # record length, crc32(payload)
+#: sanity bound on one journal record (snapshots dominate; GB-scale blobs
+#: should live in a checkpoint, not the control journal)
+MAX_RECORD = 1 << 31
+
+
+class JournalError(RuntimeError):
+    """A malformed journal record in a non-tail position (true
+    corruption, as opposed to the benign torn tail replay tolerates)."""
+
+
+class Fenced(RuntimeError):
+    """This writer's incarnation is no longer the lease's: a newer leader
+    exists and every further write must be refused."""
+
+
+# ---------------------------------------------------------------------------
+# journal framing
+# ---------------------------------------------------------------------------
+
+
+class JournalWriter:
+    """Append-only fsync'd op log.  ``fence`` is the writer's leader
+    incarnation, stamped into every record; when a ``lease`` is given the
+    writer re-reads it per append and raises :class:`Fenced` the moment a
+    newer incarnation holds it (control traffic is a handful of ops per
+    epoch — one tiny-file read per op is noise)."""
+
+    def __init__(self, path: str, fence: int = 0,
+                 lease: Optional["Lease"] = None):
+        self.path = path
+        self.fence = int(fence)
+        self._lease = lease
+        # appends arrive under DIFFERENT scheduler locks (membership ops
+        # under the CV, snapshot publishes under the snapshot lock) —
+        # serialize the record writes here so frames never interleave
+        self._wlock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def append(self, op: str, kw: Dict[str, Any]) -> None:
+        if self._lease is not None:
+            cur = self._lease.incarnation()
+            if cur > self.fence:
+                raise Fenced(
+                    f"journal write refused: lease incarnation {cur} > "
+                    f"this writer's {self.fence} (a newer leader exists)")
+        payload = pickle.dumps((self.fence, op, kw),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        if len(payload) > MAX_RECORD:
+            raise JournalError(f"journal record too large: {len(payload)}")
+        with self._wlock:
+            # cross-PROCESS writer exclusion (a deposed ex-leader and
+            # the successor both hold "ab" handles): without it, a
+            # stale tell() under O_APPEND could make the fenced-append
+            # truncation below chop the successor's records
+            if fcntl is not None:
+                fcntl.flock(self._f.fileno(), fcntl.LOCK_EX)
+            try:
+                self._f.seek(0, os.SEEK_END)  # true EOF under the flock
+                start = self._f.tell()
+                self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+                self._f.write(payload)
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                if self._lease is not None:
+                    # re-verify AFTER the bytes are durable: the pre-
+                    # check alone is check-then-act — a writer stalled
+                    # between check and fsync could land one record
+                    # after a standby already did its takeover catch-up,
+                    # silently losing the op from the successor's live
+                    # state.  Deposed mid-append: un-write the record
+                    # (ours is provably last — we hold the writer lock)
+                    # and refuse.
+                    cur = self._lease.incarnation()
+                    if cur > self.fence:
+                        self._f.truncate(start)
+                        self._f.flush()
+                        os.fsync(self._f.fileno())
+                        raise Fenced(
+                            f"journal write fenced mid-append: lease "
+                            f"incarnation {cur} > this writer's "
+                            f"{self.fence}; record withdrawn")
+            finally:
+                if fcntl is not None:
+                    fcntl.flock(self._f.fileno(), fcntl.LOCK_UN)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+class JournalReader:
+    """Incremental reader over a journal another process may still be
+    appending to.  :meth:`read_new` returns every complete record since
+    the last call; a torn tail (truncated length/payload or CRC mismatch
+    on the FINAL record) ends the batch without advancing past it, so a
+    later completed write is picked up by the next call."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+
+    def read_new(self) -> List[Tuple[int, str, Dict[str, Any]]]:
+        out: List[Tuple[int, str, Dict[str, Any]]] = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    return out  # clean end / torn header: stop before it
+                length, crc = _HDR.unpack(hdr)
+                if length > MAX_RECORD:
+                    raise JournalError(
+                        f"journal {self.path}: absurd record length "
+                        f"{length} at offset {self._offset}")
+                payload = f.read(length)
+                if len(payload) < length:
+                    # torn tail: the writer died mid-append (a short
+                    # read on a regular file IS end-of-file); replay
+                    # stops cleanly BEFORE the bad record and a retried
+                    # read sees it again once (if ever) completed
+                    return out
+                if zlib.crc32(payload) != crc:
+                    if f.read(1) == b"":
+                        # CRC-bad FINAL record: the tail fsync never
+                        # landed — same benign torn-tail case
+                        return out
+                    # a bad record with valid bytes AFTER it cannot come
+                    # from a torn append (frames never interleave, the
+                    # writer is sequential): true mid-file corruption.
+                    # Raising here — instead of silently truncating the
+                    # replay — is what keeps a standby from quietly
+                    # rebuilding a prefix state and taking over with
+                    # members/barriers missing.
+                    raise JournalError(
+                        f"journal {self.path}: CRC mismatch at offset "
+                        f"{self._offset} with records following (mid-"
+                        f"file corruption, not a torn tail)")
+                fence, op, kw = pickle.loads(payload)
+                out.append((fence, op, kw))
+                self._offset = f.tell()
+
+
+def replay(path: str) -> Iterator[Tuple[int, str, Dict[str, Any]]]:
+    """One-shot replay of every complete record (torn tail dropped)."""
+    return iter(JournalReader(path).read_new())
+
+
+# ---------------------------------------------------------------------------
+# snapshot sidecar: parameter-snapshot blobs are model-sized and
+# superseded every publish — journaling them inline would grow the WAL
+# by model-size per epoch and put a multi-MB fsync on the publish path.
+# The blob lives in a digest-named file next to the journal; the WAL
+# carries only a tiny {"__snap_ref__": sha1} marker.
+# ---------------------------------------------------------------------------
+
+_SNAP_REF = "__snap_ref__"
+#: sidecar files retained (current + one predecessor: a standby lagging
+#: one snapshot behind still resolves; deeper lag degrades to "no
+#: snapshot yet", never to garbage)
+_SNAP_KEEP = 2
+
+
+def snapshot_marker(blob: Any) -> bool:
+    return isinstance(blob, dict) and _SNAP_REF in blob
+
+
+def write_snapshot_sidecar(journal_path: str, blob: Any) -> Dict[str, str]:
+    """Durably write ``blob`` to ``<journal>.snap.<digest16>`` (atomic
+    tmp + rename + fsync), prune all but the ``_SNAP_KEEP`` newest
+    sidecars, and return the journal marker.  Called BEFORE the marker
+    is journaled, so a marker on disk always references bytes that were
+    durable first."""
+    import hashlib
+    payload = pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha1(payload).hexdigest()
+    path = f"{journal_path}.snap.{digest[:16]}"
+    if not os.path.exists(path):
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    prefix = os.path.basename(journal_path) + ".snap."
+    d = os.path.dirname(journal_path) or "."
+    try:
+        snaps = sorted(
+            (os.path.join(d, n) for n in os.listdir(d)
+             if n.startswith(prefix) and ".tmp." not in n),
+            key=os.path.getmtime)
+        for old in snaps[:-_SNAP_KEEP]:
+            os.unlink(old)
+    except OSError:
+        pass  # GC is best-effort; an unpruned sidecar is just disk
+    return {_SNAP_REF: digest}
+
+
+def load_snapshot_sidecar(journal_path: str, digest: str) -> Any:
+    """Resolve a marker back to its blob; ``None`` when the sidecar is
+    gone (pruned past a deep standby lag) or fails its digest check."""
+    import hashlib
+    path = f"{journal_path}.snap.{digest[:16]}"
+    try:
+        with open(path, "rb") as f:
+            payload = f.read()
+    except OSError:
+        return None
+    if hashlib.sha1(payload).hexdigest() != digest:
+        return None
+    return pickle.loads(payload)
+
+
+# ---------------------------------------------------------------------------
+# leader lease (single shared filesystem — the deployment unit the CPU
+# chaos harness and the local launcher share; a pod-scale deployment
+# swaps this file for its lock service without touching the callers)
+# ---------------------------------------------------------------------------
+
+
+class Lease:
+    """Leader lease file: JSON ``{incarnation, owner, ts}``.  The leader
+    renews ``ts`` periodically; a standby that sees ``ts`` stale by the
+    lease duration acquires with ``incarnation + 1``.  Writes are atomic
+    (tmp + rename) and re-read to verify — good enough for the one-
+    standby deployments this targets; the incarnation is what actually
+    protects state (journal fencing: pre-check, plus post-fsync
+    re-verify + truncate in :meth:`JournalWriter.append`), not the
+    acquire race.  Residual window, documented not closed: a successor
+    whose takeover catch-up reads a deposed writer's record in the
+    microseconds between that writer's fsync and its fenced truncation
+    applies an op the journal no longer holds — closing it needs reader-
+    side locking a lock service would provide; the file lease trades
+    that for zero coordination."""
+
+    def __init__(self, path: str, clock=time.time):
+        self.path = path
+        self._clock = clock
+        self._wseq = itertools.count()  # per-write tmp-name uniquifier
+
+    def read(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def incarnation(self) -> int:
+        cur = self.read()
+        return int(cur["incarnation"]) if cur else 0
+
+    def expired(self, lease_s: float) -> bool:
+        cur = self.read()
+        if cur is None:
+            return True
+        return self._clock() - float(cur.get("ts", 0.0)) > lease_s
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        # tmp name unique PER WRITE, not per process: a pid-keyed name
+        # collides when two writers share a pid (a primary's renew
+        # thread racing an in-process standby's acquire — the takeover
+        # path — or pid reuse across NFS hosts); one os.replace then
+        # steals the other's tmp file and the loser dies on ENOENT
+        tmp = (f"{self.path}.tmp.{os.getpid()}."
+               f"{threading.get_ident()}.{next(self._wseq)}")
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def acquire(self, owner: str) -> int:
+        """Take the lease with the next incarnation; returns it."""
+        inc = self.incarnation() + 1
+        self._write({"incarnation": inc, "owner": owner,
+                     "ts": self._clock()})
+        got = self.read()
+        if not got or got.get("owner") != owner or \
+                int(got["incarnation"]) != inc:
+            raise Fenced(f"lease acquire lost a race on {self.path}")
+        return inc
+
+    def renew(self, incarnation: int, owner: str) -> bool:
+        """Refresh ``ts`` iff we still hold the lease; ``False`` (fenced)
+        when a newer incarnation took it."""
+        cur = self.read()
+        if cur is not None and int(cur["incarnation"]) > incarnation:
+            return False
+        self._write({"incarnation": incarnation, "owner": owner,
+                     "ts": self._clock()})
+        return True
+
+
+# ---------------------------------------------------------------------------
+# the factored control state
+# ---------------------------------------------------------------------------
+
+
+class ControlState:
+    """The scheduler's journaled state, mutated only through named ops.
+
+    Every method is a pure in-memory transition — the embedding
+    :class:`~dt_tpu.elastic.scheduler.Scheduler` holds its membership
+    lock around :meth:`apply` and owns journaling (WAL append *before*
+    apply); replay constructs a fresh instance and applies the recorded
+    ops without a journal.  Ops are idempotent by construction (absolute
+    ``seq``/``gen``/``epoch`` values ride in the record; membership
+    edits guard on current membership) so a journal applied twice equals
+    once — the property ``tests/test_ha.py`` pins.
+
+    ``mc_partial`` tracks a membership change in flight: ``mc_begin`` is
+    journaled before the host_worker diff and each applied
+    remove/recover/add lands as its own record, so a leader killed in
+    the middle of ``_apply_membership_change`` leaves a replayable
+    prefix and the successor finishes the SAME barrier in the SAME
+    change direction (one kind of change per barrier, the
+    ``elastic_training.cc:91-157`` invariant, survives the failover).
+    """
+
+    def __init__(self):
+        self.workers: List[str] = []
+        self.base: Set[str] = set()
+        self.base0: Set[str] = set()
+        self.registered: Set[str] = set()
+        self.pending_recovery: Set[str] = set()
+        self.recovered_at: Dict[str, int] = {}
+        self.removed_hosts: Set[str] = set()
+        self.log_seq = 0
+        self.expected_workers = 0
+        self.barrier_epoch: Optional[int] = None
+        self.barrier_arrived: Set[str] = set()
+        self.barrier_result: Dict[int, dict] = {}
+        self.last_completed_epoch = -1
+        self.plain_arrived: Set[str] = set()
+        self.plain_gen = 0
+        self.plain_served: Dict[str, int] = {}
+        self.snapshot = None
+        self.mc_partial: Optional[Dict[str, Any]] = None
+        # journal path for resolving snapshot sidecar markers at replay
+        # (set by the embedding scheduler and by :meth:`rebuild`)
+        self.sidecar_base: Optional[str] = None
+
+    # -- op dispatch ------------------------------------------------------
+
+    def apply(self, op: str, **kw: Any) -> None:
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            raise JournalError(f"unknown control-state op {op!r}")
+        fn(**kw)
+
+    # -- ops --------------------------------------------------------------
+
+    def _op_init(self, workers: List[str], expected: int) -> None:
+        if self.workers or self.base0:
+            return  # replayed twice: the baseline is already seeded
+        self.workers = list(workers)
+        self.base = set(workers)
+        self.base0 = set(workers)
+        self.expected_workers = int(expected)
+
+    def _op_worker_add(self, host: str, base: bool) -> None:
+        if host not in self.workers:
+            self.workers.append(host)
+            if base:
+                self.base.add(host)
+        self.registered.add(host)
+
+    def _op_recovery_pending(self, host: str) -> None:
+        self.pending_recovery.add(host)
+        self.registered.add(host)
+
+    def _op_quick_evict(self, host: str, seq: int) -> None:
+        """Quick-restart eviction (recovery registration beat the
+        auto-evictor): drop the dead incarnation, queue the new one."""
+        if host in self.workers:
+            self.workers.remove(host)
+        self.registered.discard(host)
+        self.base.discard(host)
+        self.removed_hosts.add(host)
+        self.pending_recovery.add(host)
+        self.barrier_arrived.discard(host)
+        self.log_seq = max(self.log_seq, int(seq))
+
+    def _op_evict(self, host: str, seq: int) -> None:
+        if host in self.workers:
+            self.workers.remove(host)
+        self.registered.discard(host)
+        self.base.discard(host)
+        self.removed_hosts.add(host)
+        self.log_seq = max(self.log_seq, int(seq))
+
+    def _op_barrier_arrive(self, host: str, epoch: int) -> None:
+        if epoch <= self.last_completed_epoch:
+            return  # replay raced the completion record: already released
+        if self.barrier_epoch is None:
+            self.barrier_epoch = int(epoch)
+        self.barrier_arrived.add(host)
+
+    def _op_mc_begin(self, epoch: int) -> None:
+        if self.mc_partial is not None and \
+                self.mc_partial["epoch"] == epoch:
+            return  # resumed after a mid-change crash: keep the prefix
+        self.mc_partial = {"epoch": int(epoch), "removed": [],
+                           "recovered": [], "added": []}
+
+    def _mc_track(self, kind: str, host: str) -> None:
+        if self.mc_partial is not None and \
+                host not in self.mc_partial[kind]:
+            self.mc_partial[kind].append(host)
+
+    def _op_mc_remove(self, host: str, seq: int) -> None:
+        if host in self.workers:
+            self.workers.remove(host)
+        self.removed_hosts.add(host)
+        self.registered.discard(host)
+        self.base.discard(host)
+        self.log_seq = max(self.log_seq, int(seq))
+        self._mc_track("removed", host)
+
+    def _op_mc_recover(self, host: str, epoch: int, seq: int) -> None:
+        self.pending_recovery.discard(host)
+        self.removed_hosts.discard(host)
+        if host not in self.workers:
+            self.workers.append(host)
+        if host in self.base0:
+            self.base.add(host)
+        self.recovered_at[host] = int(epoch)
+        self.log_seq = max(self.log_seq, int(seq))
+        self._mc_track("recovered", host)
+
+    def _op_mc_add(self, host: str, seq: int) -> None:
+        self.removed_hosts.discard(host)
+        if host not in self.workers:
+            self.workers.append(host)
+        self.log_seq = max(self.log_seq, int(seq))
+        self._mc_track("added", host)
+
+    def _op_barrier_complete(self, epoch: int, result: dict) -> None:
+        self.barrier_result[int(epoch)] = result
+        self.last_completed_epoch = max(self.last_completed_epoch,
+                                        int(epoch))
+        self.barrier_epoch = None
+        self.barrier_arrived = set()
+        self.mc_partial = None
+
+    def _op_recovered_clear(self, host: str) -> None:
+        self.recovered_at.pop(host, None)
+
+    def _op_plain_arrive(self, host: str, seq: int) -> None:
+        self.plain_arrived.add(host)
+        self.plain_served[host] = int(seq)
+
+    def _op_plain_release(self, gen: int) -> None:
+        if int(gen) > self.plain_gen:
+            self.plain_gen = int(gen)
+        self.plain_arrived = set()
+
+    def _op_snapshot(self, blob: Any) -> None:
+        if snapshot_marker(blob) and self.sidecar_base:
+            loaded = load_snapshot_sidecar(self.sidecar_base,
+                                           blob[_SNAP_REF])
+            # an unresolvable marker (sidecar pruned past a deep replay
+            # lag, or overwritten mid-tail) stays a marker: presence is
+            # preserved for struct() and fetch degrades to None later
+            self.snapshot = loaded if loaded is not None else blob
+            return
+        self.snapshot = blob
+
+    # -- replay / structural equality ------------------------------------
+
+    @classmethod
+    def rebuild(cls, journal_path: str, upto: Optional[int] = None
+                ) -> "ControlState":
+        """A fresh state from the journal (complete records only); the
+        deterministic-replay contract the HA design rests on."""
+        st = cls()
+        st.sidecar_base = journal_path
+        for i, (_fence, op, kw) in enumerate(replay(journal_path)):
+            if upto is not None and i >= upto:
+                break
+            st.apply(op, **kw)
+        return st
+
+    def struct(self) -> Dict[str, Any]:
+        """Canonical JSON-able view for structural equality asserts
+        (snapshot blobs compare by presence; their bytes are checked
+        separately where a test cares)."""
+        return {
+            "workers": list(self.workers),
+            "base": sorted(self.base),
+            "base0": sorted(self.base0),
+            "registered": sorted(self.registered),
+            "pending_recovery": sorted(self.pending_recovery),
+            "recovered_at": dict(sorted(self.recovered_at.items())),
+            "removed_hosts": sorted(self.removed_hosts),
+            "log_seq": self.log_seq,
+            "expected_workers": self.expected_workers,
+            "barrier_epoch": self.barrier_epoch,
+            "barrier_arrived": sorted(self.barrier_arrived),
+            "barrier_result": {int(k): v for k, v
+                               in sorted(self.barrier_result.items())},
+            "last_completed_epoch": self.last_completed_epoch,
+            "plain_arrived": sorted(self.plain_arrived),
+            "plain_gen": self.plain_gen,
+            "plain_served": dict(sorted(self.plain_served.items())),
+            "mc_partial": self.mc_partial,
+            "has_snapshot": self.snapshot is not None,
+        }

@@ -71,6 +71,7 @@ import hashlib
 import hmac as _hmac
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
@@ -612,7 +613,30 @@ def request(host: str, port: int, msg: Dict[str, Any],
                 tr.event("wire.retry", {"cmd": cmd, "attempt": attempt,
                                         "backoff_s": delay})
             time.sleep(delay)
-            delay = min(delay * 2, backoff_max_s)
+            delay = next_backoff(delay, backoff_s, backoff_max_s)
+
+
+#: process-local jitter stream for retry backoff; NOT derived from the
+#: fault-plan seeds (retry pacing must stay jittered even in seeded
+#: chaos runs — determinism there comes from idempotent replay, not
+#: from identical sleep schedules)
+_BACKOFF_RNG = random.Random()
+
+
+def next_backoff(delay: float, base_s: float, cap_s: float,
+                 rng: Optional[random.Random] = None) -> float:
+    """Decorrelated-jitter backoff: the next sleep is drawn uniformly
+    from ``[base, 3 * previous]`` and capped.  Plain exponential doubling
+    synchronizes a fleet — after a scheduler failover every worker's
+    retry clock starts at the same instant, and lockstep backoff slams
+    the standby with coordinated retry waves (thundering herd); the
+    decorrelated draw spreads the fleet across the window while keeping
+    the same expected growth.  The cap bounds the DRAW RANGE rather than
+    clamping the result — clamping would pile every saturated retry onto
+    exactly ``cap_s`` and re-synchronize the herd at the cap.  ``rng`` is
+    injectable for the spread test (tests/test_ha.py)."""
+    r = rng if rng is not None else _BACKOFF_RNG
+    return r.uniform(base_s, min(cap_s, max(delay * 3.0, base_s)))
 
 
 class TokenCache:
@@ -620,21 +644,51 @@ class TokenCache:
     receiver side of :func:`request`'s at-least-once contract.  A re-sent
     request whose first dispatch completed is served the SAME response
     instead of being dispatched again (commands with their own
-    seq-dedup or read-only semantics are exempted by the servers)."""
+    seq-dedup or read-only semantics are exempted by the servers).
 
-    def __init__(self, cap: int = 512):
+    Two bounds keep a job-lifetime scheduler's memory flat (r11): an LRU
+    entry cap, and a TTL (``ttl_s``; ``DT_CTRL_TOKEN_TTL_S`` at the
+    scheduler) — a retry only ever lands within its sender's backoff
+    horizon, so entries older than the TTL can never be replayed to and
+    are shed even when the cache is not full.  ``clock`` is injectable
+    for the TTL tests."""
+
+    def __init__(self, cap: int = 512, ttl_s: float = 300.0,
+                 clock=time.monotonic):
         self._cap = cap
+        self._ttl = float(ttl_s)
+        self._clock = clock
         self._lock = threading.Lock()
-        # token -> response, LRU order
+        # token -> (stored_at, response), LRU order
         self._cache = collections.OrderedDict()  # guarded-by: _lock
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
 
     def get(self, token: str) -> Optional[Dict[str, Any]]:
         with self._lock:
-            return self._cache.get(token)
+            ent = self._cache.get(token)
+            if ent is None:
+                return None
+            ts, resp = ent
+            if self._ttl > 0 and self._clock() - ts > self._ttl:
+                del self._cache[token]
+                return None
+            return resp
 
     def put(self, token: str, resp: Dict[str, Any]) -> None:
         with self._lock:
-            self._cache[token] = resp
+            now = self._clock()
+            self._cache[token] = (now, resp)
             self._cache.move_to_end(token)
+            # expired entries age out of the LRU end first (insertion
+            # order == age order: entries are never refreshed in place)
+            while self._cache and self._ttl > 0:
+                tok, (ts, _) = next(iter(self._cache.items()))
+                if now - ts > self._ttl:
+                    del self._cache[tok]
+                else:
+                    break
             while len(self._cache) > self._cap:
                 self._cache.popitem(last=False)

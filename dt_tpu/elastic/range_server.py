@@ -38,6 +38,7 @@ import threading
 import time
 from typing import List, Optional, Set
 
+from dt_tpu import config
 from dt_tpu.elastic import faults, protocol
 from dt_tpu.elastic.dataplane import DataPlane
 from dt_tpu.obs import trace as obs_trace
@@ -76,7 +77,8 @@ class RangeServer:
         self._dp = DataPlane(expected_fn=self._expected,
                              confirm_fn=self._refresh_members,
                              tracer=self._obs)
-        self._tokens = protocol.TokenCache()
+        self._tokens = protocol.TokenCache(
+            ttl_s=float(config.env("DT_CTRL_TOKEN_TTL_S")))
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

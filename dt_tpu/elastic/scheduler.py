@@ -26,6 +26,25 @@ Responsibilities (SURVEY.md §3.3):
   710-739``); on a real pod this path is idle (gradients ride ICI inside the
   jit step) but it gives multi-process tests the reference's exact-value
   dist-sync semantics (``tests/nightly/dist_sync_kvstore.py``).
+
+High availability (r11): the reference's scheduler was a single point of
+failure — one process held membership, barrier, recovery-queue, and
+snapshot state unreplicated (``elastic_training.cc:1-158``) and its death
+killed the job.  Here every control-state transition is a named op on a
+:class:`~dt_tpu.elastic.journal.ControlState` behind a fsync'd
+write-ahead journal (``journal_path``), leadership is a lease file with a
+monotonic fencing **incarnation** (``lease_path``/``DT_CTRL_LEASE_S``),
+and a warm standby (``standby=True``, same journal) tails the journal and
+takes over when the lease expires — replaying to the exact pre-crash
+state, seeding heartbeat grace, and serving under ``incarnation + 1``
+while the journal refuses any write from the deposed leader
+(:class:`~dt_tpu.elastic.journal.Fenced`).  Data-plane allreduce rounds
+are the one thing the journal does not carry (gradient-sized, per-step):
+a primary given ``peer=`` replicates each COMPLETED round's served
+results to the standby over the pooled wire path before answering, so an
+at-least-once retry that lands on the new leader after the switch is
+served the very same averaged result — rounds complete exactly once
+across a failover.  ``docs/ha.md`` has the full protocol.
 """
 
 from __future__ import annotations
@@ -40,7 +59,8 @@ from typing import Callable, Dict, List, Optional, Set
 
 import numpy as np
 
-from dt_tpu.elastic import faults, protocol
+from dt_tpu import config
+from dt_tpu.elastic import faults, journal, protocol
 from dt_tpu.elastic.dataplane import DataPlane
 from dt_tpu.obs import trace as obs_trace
 
@@ -54,7 +74,15 @@ _drop_rng = random.Random(0xD207)  # deterministic fault injection
 _TOKEN_EXEMPT = frozenset({"fetch_snapshot", "allreduce", "async_init",
                            "async_push", "async_pull_rows", "async_stats",
                            "heartbeat", "num_dead", "membership",
-                           "servers", "obs_push", "obs_dump"})
+                           "servers", "obs_push", "obs_dump", "ha_round",
+                           "status"})
+
+#: commands a PASSIVE instance (warm standby / fenced ex-leader) still
+#: serves: round replication from the live primary, obs ingest/export,
+#: health introspection, and shutdown — everything else is refused with
+#: ``not_leader`` so clients rotate to the real leader
+_PASSIVE_CMDS = frozenset({"ha_round", "obs_push", "obs_dump", "status",
+                           "shutdown"})
 
 #: bound on retained (host, incarnation) obs tracks — LRU-evicted so a
 #: job with heavy restart churn can't grow scheduler memory unboundedly
@@ -70,63 +98,107 @@ class Scheduler:
                  expected_workers: Optional[int] = None,
                  pre_change_hook: Optional[Callable[[int], None]] = None,
                  auto_evict_dead_s: Optional[float] = None,
-                 startup_grace_s: float = 120.0):
+                 startup_grace_s: float = 120.0,
+                 journal_path: Optional[str] = None,
+                 lease_path: Optional[str] = None,
+                 lease_s: Optional[float] = None,
+                 standby: bool = False,
+                 peer: Optional[tuple] = None):
         """``initial_workers`` seeds the base set; else the first line-set of
         ``host_worker_file`` does (``postoffice.cc:247-259`` baseline read).
         ``launch_callback(host, epoch_begin)`` starts a worker process on
         ``host`` (the reference shells out to ``launch.py --launch-worker``).
         ``expected_workers``: registrations to wait for before barriers make
-        sense (DMLC_NUM_WORKER analog)."""
+        sense (DMLC_NUM_WORKER analog).
+
+        HA: ``journal_path`` enables the control-state WAL (a restart of
+        THIS role replays it; default ``DT_CTRL_JOURNAL``).
+        ``standby=True`` builds a warm standby: state comes from the
+        journal only, the instance binds its port but answers
+        ``not_leader`` until the lease (``lease_path``, default
+        ``<journal>.lease``) goes stale for ``lease_s``
+        (``DT_CTRL_LEASE_S``) and it takes over under the next fencing
+        incarnation.  ``peer=(host, port)`` on the PRIMARY replicates
+        completed allreduce rounds to the standby before responses are
+        released (exactly-once rounds across a failover)."""
         self.host_worker_file = host_worker_file
         if initial_workers is None and host_worker_file and \
-                os.path.exists(host_worker_file):
+                not standby and os.path.exists(host_worker_file):
             initial_workers = _read_hosts(host_worker_file)
-        self._workers: List[str] = list(initial_workers or [])  # guarded-by: _lock
-        self._base: Set[str] = set(self._workers)  # guarded-by: _lock
-        # launch-time base membership, immutable: eviction removes a
-        # crashed base worker from _base (it must be evictable), but a
-        # RECOVERED one gets its base protection back from this record
-        self._base0: Set[str] = set(self._workers)  # guarded-by: _lock
-        self._registered: Set[str] = set()  # guarded-by: _lock
-        # crashed-and-evicted hosts that re-registered under their old
-        # identity (van.cc:187-218 is_recovery): re-admitted at the next
-        # membership barrier, not mid-epoch (sync rounds in flight must
-        # not change their expected contributor set)
-        self._pending_recovery: Set[str] = set()  # guarded-by: _lock
-        # host -> epoch it was re-admitted at: a wait_rejoin retry whose
-        # admitting RESPONSE was lost must be served the SAME result (its
-        # resume_epoch is stale and the pending-recovery bump no longer
-        # applies once admitted); cleared when the host reaches a later
-        # barrier through the normal fit loop
-        self._recovered_at: Dict[str, int] = {}  # guarded-by: _lock
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # ALL membership / barrier / recovery / snapshot state lives in
+        # the journaled ControlState (mutated only via _apply, under the
+        # lock); the bare attributes of rounds 3-10 are now read-only
+        # properties over it (tests/tools introspect them)
+        self._state = journal.ControlState()  # guarded-by: _lock
+
+        # -- HA plumbing (journal / lease / fencing) -----------------------
+        self.journal_path = journal_path or \
+            (config.env("DT_CTRL_JOURNAL") or None)
+        # snapshot sidecar resolution (blobs live NEXT TO the journal,
+        # the WAL carries only markers) — set before any replay applies
+        # a snapshot op
+        self._state.sidecar_base = self.journal_path
+        self.lease_s = float(lease_s if lease_s is not None
+                             else config.env("DT_CTRL_LEASE_S"))
+        lp = lease_path or config.env("DT_CTRL_LEASE") or \
+            (self.journal_path + ".lease" if self.journal_path else None)
+        self._lease = journal.Lease(lp) \
+            if (lp and self.journal_path) else None
+        self._journal: Optional[journal.JournalWriter] = None
+        self._journal_reader = journal.JournalReader(self.journal_path) \
+            if self.journal_path else None
+        self._incarnation = 0  # fencing epoch; bumped only in _takeover
+        self.standby = bool(standby)
+        self.peer = tuple(peer) if peer else None
+        self._active = threading.Event()
+        self._takeover_lock = threading.Lock()
+
+        if standby:
+            if not self.journal_path:
+                raise ValueError("standby scheduler needs a journal_path")
+            with self._cv:
+                self._refresh_from_journal_locked()
+            self._incarnation = self._lease.incarnation() \
+                if self._lease else 0
+        else:
+            if self.journal_path:
+                # cold restart of the primary role: replay our own journal
+                with self._cv:
+                    self._refresh_from_journal_locked()
+            if self._lease is not None:
+                self._incarnation = self._lease.acquire(
+                    owner=f"sched:{os.getpid()}")
+            if self.journal_path:
+                self._journal = journal.JournalWriter(
+                    self.journal_path, fence=self._incarnation,
+                    lease=self._lease)
+            if not self._state.workers and initial_workers:
+                with self._cv:
+                    self._apply("init", workers=list(initial_workers),
+                                expected=(expected_workers
+                                          or len(initial_workers)))
+
+        self.expected_workers = (expected_workers
+                                 or self._state.expected_workers
+                                 or len(self._state.workers))
         # Seed heartbeats at startup so a worker that never comes up ages
         # out and is counted dead, instead of defaulting to "alive forever".
         now = time.time()
-        self._heartbeats: Dict[str, float] = {h: now for h in self._workers}  # guarded-by: _lock
-        self._removed_hosts: Set[str] = set()  # guarded-by: _lock
+        self._heartbeats = {h: now for h in self._state.workers}  # guarded-by: _lock
         self._log_path = host_worker_log or (
             host_worker_file + "_log" if host_worker_file else None)
-        self._log_seq = 0  # guarded-by: _lock
         self._launch_callback = launch_callback
         # Called with the epoch right before the host_worker diff — the
         # in-process analog of the EC2 manager thread that rewrites the file
         # (launch.py:88-235); used by operator automation and tests.
         self._pre_change_hook = pre_change_hook
-        self.expected_workers = expected_workers or len(self._workers)
 
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
-        # barrier state
-        self._barrier_epoch: Optional[int] = None  # guarded-by: _lock
-        self._barrier_arrived: Set[str] = set()  # guarded-by: _lock
-        self._barrier_result: Dict[int, dict] = {}  # guarded-by: _lock
-        self._last_completed_epoch = -1  # guarded-by: _lock
-        # plain barrier
-        self._plain_arrived: Set[str] = set()  # guarded-by: _lock
-        self._plain_gen = 0  # guarded-by: _lock
-        self._plain_served: Dict[str, int] = {}  # guarded-by: _lock
-        # snapshot
-        self._snapshot = None  # guarded-by: _snapshot_lock
+        # snapshot publish/fetch keep their own lock so a multi-MB blob
+        # copy never blocks membership traffic (the blob itself lives in
+        # the ControlState and is journaled like every transition)
         self._snapshot_lock = threading.Lock()
         # observability (dt_tpu/obs): this instance's control-plane tracer
         # holds the scheduler's own spans/events AND the always-on
@@ -144,8 +216,10 @@ class Scheduler:
         # store), shared machinery with RangeServer (dataplane.py).  When
         # range servers register, workers route bulk data to THEM and this
         # embedded plane goes idle (kvstore_dist.h:547-589 key sharding).
-        self._dp = DataPlane(expected_fn=lambda: list(self._workers),
-                             tracer=self._obs)
+        self._dp = DataPlane(
+            expected_fn=lambda: list(self._state.workers),
+            tracer=self._obs,
+            replicate_fn=self._make_replicator() if self.peer else None)
         # range-server registry: index -> (host, port); fixed after launch
         # (the reference's server count is DMLC_NUM_SERVER, not elastic).
         # Own lock: _server_list() is called from inside _register, which
@@ -156,8 +230,10 @@ class Scheduler:
         self._profile_cmds: List[dict] = []  # guarded-by: _lock
         self._profile_seq = 0  # guarded-by: _lock
         self._profile_posted: Dict[tuple, int] = {}  # retry dedup; guarded-by: _lock
-        # idempotency-token response cache (protocol.request reliable mode)
-        self._tokens = protocol.TokenCache()
+        # idempotency-token response cache (protocol.request reliable
+        # mode); TTL + LRU bound its memory on a long-running scheduler
+        self._tokens = protocol.TokenCache(
+            ttl_s=float(config.env("DT_CTRL_TOKEN_TTL_S")))
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -165,6 +241,13 @@ class Scheduler:
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
+        self._closed = False
+        # accepted connections, severed on close() so clients parked on
+        # a dying scheduler see a reset (and fail over) instead of
+        # hanging until their own timeout — an in-process close behaves
+        # like the process death it stands in for
+        self._conns: Set[socket.socket] = set()  # guarded-by: _conns_lock
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
         # Crash recovery beyond the reference: auto-evict workers whose
@@ -180,12 +263,212 @@ class Scheduler:
         # workers that never registered get a longer leash: process startup
         # (python + jax import) takes seconds-to-minutes
         self.startup_grace_s = max(startup_grace_s, auto_evict_dead_s or 0)
-        if auto_evict_dead_s:
-            self._evict_thread = threading.Thread(
-                target=self._evict_loop, daemon=True)
-            self._evict_thread.start()
-        logger.info("scheduler listening on :%d, base workers %s",
-                    self.port, self._workers)
+        self._evict_thread: Optional[threading.Thread] = None
+        self._lease_thread: Optional[threading.Thread] = None
+        self._monitor_thread: Optional[threading.Thread] = None
+        if standby:
+            self._monitor_thread = threading.Thread(
+                target=self._monitor_loop, daemon=True)
+            self._monitor_thread.start()
+            logger.info("standby scheduler listening on :%d (journal %s)",
+                        self.port, self.journal_path)
+        else:
+            self._active.set()
+            if self._lease is not None:
+                self._obs.event("leader.elected",
+                                {"incarnation": self._incarnation,
+                                 "reason": "primary start"})
+                self._start_lease_thread()
+            if auto_evict_dead_s:
+                self._start_evict_thread()
+            logger.info("scheduler listening on :%d (incarnation %d), "
+                        "base workers %s", self.port, self._incarnation,
+                        self._state.workers)
+
+    # ------------------------------------------------------------------
+    # journaled state access (the r11 ControlState refactor)
+    # ------------------------------------------------------------------
+
+    def _apply(self, op: str, **kw) -> None:
+        """WAL-append (fsync) then apply one control-state op.
+        Caller holds the lock. (publish_snapshot holds _snapshot_lock
+        instead — the journal writer serializes appends internally, and
+        the snapshot blob is the one field read under that lock.)  Raises
+        :class:`journal.Fenced` when a newer leader holds the lease; the
+        dispatcher surfaces that to the client, which rotates."""
+        if self._journal is not None:
+            self._journal.append(op, kw)
+        self._state.apply(op, **kw)
+
+    def _refresh_from_journal_locked(self) -> None:
+        """Apply journal records appended since the last read (standby
+        tailing / cold-restart replay).  Caller holds the lock."""
+        if self._journal_reader is None:
+            return
+        for _fence, op, kw in self._journal_reader.read_new():
+            self._state.apply(op, **kw)
+
+    # read-only views kept for tests/tools that introspect the round-3
+    # attribute names (chaos_run, test_faults, test_crash_recovery);
+    # snapshot copies taken under the lock — never called from paths
+    # that already hold it (internal code reads self._state directly)
+    @property
+    def _workers(self) -> List[str]:
+        with self._lock:
+            return list(self._state.workers)
+
+    @property
+    def _registered(self) -> Set[str]:
+        with self._lock:
+            return set(self._state.registered)
+
+    @property
+    def _removed_hosts(self) -> Set[str]:
+        with self._lock:
+            return set(self._state.removed_hosts)
+
+    @property
+    def _pending_recovery(self) -> Set[str]:
+        with self._lock:
+            return set(self._state.pending_recovery)
+
+    @property
+    def _barrier_arrived(self) -> Set[str]:
+        with self._lock:
+            return set(self._state.barrier_arrived)
+
+    @property
+    def _last_completed_epoch(self) -> int:
+        with self._lock:
+            return self._state.last_completed_epoch
+
+    # ------------------------------------------------------------------
+    # leadership: lease renewal, standby monitoring, takeover
+    # ------------------------------------------------------------------
+
+    @property
+    def incarnation(self) -> int:
+        """This instance's fencing epoch (0 = no lease configured)."""
+        return self._incarnation
+
+    def is_leader(self) -> bool:
+        return self._active.is_set()
+
+    def _start_evict_thread(self) -> None:
+        self._evict_thread = threading.Thread(
+            target=self._evict_loop, daemon=True)
+        self._evict_thread.start()
+
+    def _start_lease_thread(self) -> None:
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, daemon=True)
+        self._lease_thread.start()
+
+    def _lease_loop(self):
+        """Leader-side lease heartbeat; losing the lease to a newer
+        incarnation demotes this instance (it stops serving writes —
+        the journal would refuse them anyway)."""
+        period = max(self.lease_s / 3.0, 0.05)
+        owner = f"sched:{os.getpid()}"
+        while not self._stop.wait(period):
+            if self._lease is None or not self._active.is_set():
+                return
+            if not self._lease.renew(self._incarnation, owner):
+                logger.error("lease lost to a newer incarnation; fencing "
+                             "this scheduler (was %d)", self._incarnation)
+                self._obs.event("leader.fenced",
+                                {"incarnation": self._incarnation})
+                self._active.clear()
+                return
+
+    def _primary_gone(self) -> bool:
+        """True when a leader HAS existed (lease file present) and its
+        lease lapsed.  A standby never takes over before any primary
+        ever led — the launcher starts the standby FIRST (its port goes
+        into ``DT_CTRL_ENDPOINTS``), and taking over on a missing lease
+        file would race the booting primary's first acquire."""
+        return (self._lease is not None
+                and self._lease.read() is not None
+                and self._lease.expired(self.lease_s))
+
+    def _monitor_loop(self):
+        """Standby: tail the journal (warmness) and watch the lease;
+        expiry triggers takeover."""
+        period = max(self.lease_s / 4.0, 0.05)
+        while not self._stop.wait(period):
+            if self._active.is_set():
+                return
+            try:
+                with self._cv:
+                    self._refresh_from_journal_locked()
+                if self._primary_gone():
+                    self._takeover("lease expired")
+                    return
+            except Exception:
+                # a transient shared-fs error (lease/journal read or a
+                # lost acquire race) must not kill the watch thread —
+                # that would silently reduce the standby to on-demand
+                # takeover only.  Log and keep watching.
+                logger.exception("standby monitor pass failed; retrying")
+
+    def _takeover(self, reason: str) -> bool:
+        """Promote this standby to leader: final journal catch-up, lease
+        acquire under ``incarnation + 1``, heartbeat grace reseed, and
+        the ``scheduler.failover`` span chaos_run asserts on."""
+        with self._takeover_lock:
+            if self._active.is_set():
+                return True
+            t0 = self._obs.now()
+            try:
+                inc = self._lease.acquire(owner=f"sched:{os.getpid()}") \
+                    if self._lease else self._incarnation + 1
+            except journal.Fenced:
+                return False  # another standby won; stay passive
+            with self._cv:
+                self._refresh_from_journal_locked()
+                self._incarnation = inc
+                self._journal = journal.JournalWriter(
+                    self.journal_path, fence=inc, lease=self._lease)
+                # heartbeat grace: every replayed worker gets a fresh
+                # clock, or the evictor would count the failover window
+                # as silence and evict the whole (healthy) fleet
+                now = time.time()
+                workers = list(self._state.workers)
+                for h in workers:
+                    self._heartbeats[h] = now
+                self._cv.notify_all()
+            for h in workers:
+                self._dp.host_registered(h)
+            self._active.set()
+            if self.auto_evict_dead_s:
+                self._start_evict_thread()
+            if self._lease is not None:
+                self._start_lease_thread()
+            self._obs.complete_span(
+                "scheduler.failover", t0,
+                {"incarnation": inc, "reason": reason,
+                 "workers": len(workers)})
+            self._obs.event("leader.elected",
+                            {"incarnation": inc, "reason": reason})
+            logger.warning("standby took over as leader (incarnation %d):"
+                           " %s; workers=%s", inc, reason, workers)
+            return True
+
+    def _make_replicator(self):
+        """Round-replication sender (primary -> standby): ship a
+        completed allreduce round's served results BEFORE the responses
+        go out, so a retry landing on the successor after a failover is
+        served the identical average (exactly-once rounds).  Carries our
+        fencing incarnation — a deposed primary's replica is refused."""
+        host, port = self.peer
+
+        def _rep(key: str, gen: int, seqs: Dict[str, int], result) -> None:
+            protocol.request(host, int(port),
+                             {"cmd": "ha_round",
+                              "fence": self._incarnation, "key": key,
+                              "gen": gen, "seqs": seqs, "value": result},
+                             timeout=5.0)
+        return _rep
 
     # ------------------------------------------------------------------
     # server plumbing
@@ -197,12 +480,18 @@ class Scheduler:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._handle_conn, args=(conn,),
                              daemon=True).start()
 
     def _handle_conn(self, conn: socket.socket):
         self._obs.counter("transport.connections")
-        protocol.serve_connection(conn, self._handle_one)
+        try:
+            protocol.serve_connection(conn, self._handle_one)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _handle_one(self, msg: dict) -> Optional[dict]:
         """One request on a persistent connection; ``None`` closes the
@@ -222,6 +511,18 @@ class Scheduler:
         if plan is not None and \
                 not plan.on_recv(msg.get("cmd"), msg.get("host")):
             return None
+        # leadership gate: a passive instance (standby, or a fenced
+        # ex-leader) refuses everything but the passive command set so
+        # clients rotate to the live leader.  A standby whose lease
+        # watch says the primary is gone takes over ON DEMAND here —
+        # the first failed-over client request is what completes the
+        # failover, bounding the stall by the lease duration.
+        if not self._active.is_set() and \
+                msg.get("cmd") not in _PASSIVE_CMDS:
+            if not (self.standby and self._primary_gone()
+                    and self._takeover("client demand")):
+                return {"error": "not_leader",
+                        "incarnation": self._incarnation}
         # idempotency-token dedup (protocol.request reliable
         # mode): a replay whose first dispatch completed is
         # served the SAME response instead of re-dispatching
@@ -233,7 +534,25 @@ class Scheduler:
                 return cached
         try:
             resp = self._dispatch(msg)
+        except journal.Fenced as e:
+            # a newer leader exists: stop accepting writes and tell the
+            # client to rotate (its failover layer treats this like a
+            # dead endpoint)
+            logger.error("request fenced: %s", e)
+            self._obs.event("leader.fenced",
+                            {"incarnation": self._incarnation})
+            self._active.clear()
+            return {"error": f"fenced: {e}"}
         except Exception as e:  # surface handler bugs to the worker
+            if self._stop.is_set():
+                # dying mid-request: close() raced this handler (a
+                # parked barrier wait woke into "scheduler closed", or
+                # a later step tripped over torn-down state).  Answer
+                # with a connection CLOSE, not an error frame — wire-
+                # identical to the process death close() stands in for,
+                # so the client fails over instead of surfacing a
+                # shutdown artifact as a scheduler error.
+                return None
             logger.exception("scheduler handler error")
             return {"error": repr(e)}
         if token is not None and "error" not in resp and \
@@ -318,11 +637,60 @@ class Scheduler:
         return {"tracks": tracks}
 
     def close(self):
+        """Shut the service down.  Idempotent, and bounded even when a
+        housekeeping pass is mid-flight: the evictor/monitor/lease loops
+        are woken (they park on ``_stop``), CV waiters are notified, and
+        every owned thread is joined with a timeout — the r11 fix for
+        the close-vs-evictor race where an evict pass holding ``_cv``
+        could leave ``close()`` returning with live threads still
+        mutating a half-closed scheduler."""
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        # shutdown() BEFORE close(): a plain close of an fd another
+        # thread is blocked in accept() on does NOT wake it on Linux —
+        # the kernel socket stays alive inside the in-flight syscall,
+        # the port keeps accepting, and late requests would hit a
+        # half-closed scheduler (closed journal).  shutdown wakes the
+        # accept with EINVAL and the serve loop exits.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        # sever accepted connections: a client parked at a barrier on
+        # this scheduler must see a reset NOW (it fails over / retries),
+        # not its own 300 s timeout — same wire-visible behavior as the
+        # process dying
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        me = threading.current_thread()
+        for t in (self._evict_thread, self._monitor_thread,
+                  self._lease_thread, self._thread):
+            if t is not None and t is not me and t.is_alive():
+                t.join(timeout=5.0)
+        if self._journal is not None:
+            self._journal.close()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Block until :meth:`close` is called (the standalone scheduler
+        process entrypoint parks here); True when closed."""
+        return self._stop.wait(timeout)
 
     # ------------------------------------------------------------------
     # dispatch
@@ -332,7 +700,8 @@ class Scheduler:
         cmd = msg.get("cmd")
         if cmd == "register":
             return self._register(msg["host"], bool(msg.get("is_new")),
-                                  bool(msg.get("is_recovery")))
+                                  bool(msg.get("is_recovery")),
+                                  reattach=bool(msg.get("reattach")))
         if cmd == "heartbeat":
             # worker span rings piggyback on the heartbeat, exactly like
             # profiler control already does (kvstore_dist.h:102-110)
@@ -351,6 +720,15 @@ class Scheduler:
             return {}
         if cmd == "obs_dump":
             return {"job": self.obs_dump()}
+        if cmd == "ha_round":
+            return self._ha_round(msg)
+        if cmd == "status":
+            with self._lock:
+                return {"active": self._active.is_set(),
+                        "incarnation": self._incarnation,
+                        "workers": list(self._state.workers),
+                        "last_completed_epoch":
+                            self._state.last_completed_epoch}
         if cmd == "profile":
             # rank-0-drives-all profiling (kvstore_dist_server.h:275-322):
             # record the command; every worker picks it up on its next
@@ -375,6 +753,12 @@ class Scheduler:
                             next(iter(self._profile_posted)))
                 return {"seq": self._profile_seq}
         if cmd in DataPlane.CMDS:
+            if cmd == "allreduce":
+                # a named scheduler-crash site INSIDE the data-plane
+                # epoch: chaos `--plan scheduler_kill` kills here,
+                # mid-round (docs/ha.md failure catalog)
+                faults.crash_point("sched.allreduce",
+                                   host=msg.get("host"))
             return self._dp.dispatch(msg)
         if cmd == "register_server":
             with self._servers_lock:
@@ -393,34 +777,77 @@ class Scheduler:
                                        int(msg.get("seq", -1)))
         if cmd == "publish_snapshot":
             with self._snapshot_lock:
-                self._snapshot = msg["blob"]
+                blob = msg["blob"]
+                if self._journal is not None:
+                    # model-sized blobs do NOT ride the WAL: durably
+                    # sidecar the bytes first, journal the tiny marker,
+                    # then memo the resolved blob (same bytes the
+                    # sidecar holds — skips a full read-back)
+                    marker = journal.write_snapshot_sidecar(
+                        self.journal_path, blob)
+                    self._apply("snapshot", blob=marker)
+                    self._state.snapshot = blob  # dtlint: ignore[DT006]
+                else:
+                    self._apply("snapshot", blob=blob)
             return {}
         if cmd == "fetch_snapshot":
             with self._snapshot_lock:
-                return {"blob": self._snapshot}
+                # the snapshot blob is the ONE ControlState field read
+                # under _snapshot_lock, not _lock (see _apply docstring)
+                snap = self._state.snapshot  # dtlint: ignore[DT006]
+                if journal.snapshot_marker(snap) and self.journal_path:
+                    # replay left an unresolved marker (sidecar written
+                    # after this record was tailed): resolve on fetch,
+                    # degrade to "no snapshot" if the file is gone
+                    snap = journal.load_snapshot_sidecar(
+                        self.journal_path, snap[journal._SNAP_REF])
+                    if snap is not None:
+                        self._state.snapshot = snap  # dtlint: ignore[DT006]
+                return {"blob": snap}
         if cmd == "num_dead":
             return {"count": self._num_dead(float(msg.get("timeout_s", 60)))}
         if cmd == "membership":
             with self._lock:
-                return {"workers": list(self._workers)}
+                return {"workers": list(self._state.workers)}
         if cmd == "shutdown":
             self.close()
             return {}
         return {"error": f"unknown cmd {cmd!r}"}
+
+    def _ha_round(self, msg: dict) -> dict:
+        """Install a completed round replicated by the live primary.
+        Fenced: a replica stamped with an incarnation below ours comes
+        from a deposed leader and is refused (stale-incarnation write)."""
+        fence = int(msg.get("fence", 0))
+        if fence < self._incarnation:
+            return {"error": f"fenced: round replica carries stale "
+                             f"incarnation {fence} < {self._incarnation}"}
+        self._dp.install_round(msg["key"], int(msg["gen"]),
+                               dict(msg["seqs"]), msg["value"])
+        self._obs.counter("ha.rounds_replicated")
+        return {}
 
     # ------------------------------------------------------------------
     # registration / heartbeat
     # ------------------------------------------------------------------
 
     def _register(self, host: str, is_new: bool,
-                  is_recovery: bool = False) -> dict:
+                  is_recovery: bool = False,
+                  reattach: bool = False) -> dict:
+        """``reattach=True`` (client endpoint rotation, docs/ha.md) is an
+        identity/fence refresh from a LIVE process, not a restart: it
+        must not purge the host's retry-dedup state — a spurious
+        rotation back to a healthy leader would otherwise clear
+        ``_async_served``, letting an in-flight async_push retry whose
+        response was lost re-apply its gradient (double fold)."""
         faults.crash_point("sched.register", host=host)
         with self._cv:
-            if host in self._removed_hosts and not is_recovery:
+            st = self._state
+            if host in st.removed_hosts and not is_recovery:
                 # sender-validation drop of removed hosts
                 # (van.cc:571-574)
                 return {"error": "host was removed from the job"}
-            if is_recovery and host in self._workers:
+            if is_recovery and host in st.workers:
                 # QUICK restart: the old incarnation crashed but hasn't
                 # been evicted yet.  Its process is gone, so treat this
                 # exactly like an eviction (drop from the live set,
@@ -436,22 +863,16 @@ class Scheduler:
                 # diff — that would hand the restarted worker a normal
                 # rank with begin_epoch=0 (epoch desync) and, in elastic
                 # mode, spawn a duplicate process under its identity.
-                self._workers.remove(host)
-                self._registered.discard(host)
-                self._base.discard(host)
-                self._removed_hosts.add(host)
-                self._pending_recovery.add(host)
-                # the DEAD incarnation may have arrived at the parked
-                # barrier before crashing; its stale arrival must not
-                # count as the NEW incarnation's (re-admission requires
-                # the restarted worker to arrive itself, or survivors
-                # start the epoch expecting a still-bootstrapping host)
-                self._barrier_arrived.discard(host)
+                # (The stale arrival discard rides inside the journaled
+                # quick_evict op: the DEAD incarnation may have arrived
+                # at the parked barrier before crashing, and its arrival
+                # must not count as the NEW incarnation's.)
+                self._apply("quick_evict", host=host, seq=st.log_seq + 1)
+                self._audit_locked("REMOVED", host)
                 self._dp.hosts_removed({host})
-                self._append_log("REMOVED", host)
                 self._rewrite_host_file([host])
                 self._complete_pending_locked()
-            if host in self._removed_hosts:
+            if host in st.removed_hosts:
                 # identity reissue (van.cc:187-218 is_recovery=true): a
                 # crashed worker restarts under its OLD id.  Queue it for
                 # re-admission at the next membership barrier — NOT
@@ -459,8 +880,7 @@ class Scheduler:
                 # contributor set — and let it bootstrap from the
                 # snapshot meanwhile.  Its dedup caches are purged
                 # (fresh sequences after restart).
-                self._pending_recovery.add(host)
-                self._registered.add(host)
+                self._apply("recovery_pending", host=host)
                 self._heartbeats[host] = time.time()
                 self._dp.host_registered(host)
                 for key in [k for k in self._profile_posted
@@ -470,31 +890,33 @@ class Scheduler:
                 self._obs.event("recovery.registered", {"host": host})
                 logger.info("recovery registration from %s: pending "
                             "re-admission at the next barrier", host)
-                return {"rank": -1, "workers": list(self._workers),
+                return {"rank": -1, "workers": list(st.workers),
                         "recovery_pending": True,
-                        "resume_epoch": self._last_completed_epoch + 1,
+                        "resume_epoch": st.last_completed_epoch + 1,
                         "profile_seq": self._profile_seq,
+                        "fence": self._incarnation,
                         "servers": self._server_list()}
-            if host not in self._workers:
-                if not is_new:
-                    self._base.add(host)  # launch-time workers are base
-                self._workers.append(host)
-            self._registered.add(host)
+            self._apply("worker_add", host=host, base=not is_new)
             self._heartbeats[host] = time.time()
-            # a (re)registering worker starts a fresh profiler-post AND
-            # async-push sequence — purge its stale retry-dedup entries so
-            # its first request after a restart isn't swallowed by an old
-            # (host, seq) key (a swallowed async_push would silently drop
-            # a gradient and hand back pre-crash weights)
-            for key in [k for k in self._profile_posted if k[0] == host]:
-                del self._profile_posted[key]
-            self._dp.host_registered(host)
+            if not reattach:
+                # a (re)registering worker starts a fresh profiler-post
+                # AND async-push sequence — purge its stale retry-dedup
+                # entries so its first request after a restart isn't
+                # swallowed by an old (host, seq) key (a swallowed
+                # async_push would silently drop a gradient and hand
+                # back pre-crash weights).  A failover reattach is the
+                # SAME process continuing its sequences: no purge.
+                for key in [k for k in self._profile_posted
+                            if k[0] == host]:
+                    del self._profile_posted[key]
+                self._dp.host_registered(host)
             self._cv.notify_all()
             # profile_seq: joiners sync PAST the buffered command history
             # (don't replay a long-finished profiling session on new hosts)
-            return {"rank": self._workers.index(host),
-                    "workers": list(self._workers),
+            return {"rank": st.workers.index(host),
+                    "workers": list(st.workers),
                     "profile_seq": self._profile_seq,
+                    "fence": self._incarnation,
                     "servers": self._server_list()}
 
     def wait_for_workers(self, n: Optional[int] = None, timeout: float = 120):
@@ -503,17 +925,18 @@ class Scheduler:
         n = n if n is not None else self.expected_workers
         deadline = time.time() + timeout
         with self._cv:
-            while len(self._registered) < n:
+            while len(self._state.registered) < n:
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     raise TimeoutError(
-                        f"only {len(self._registered)}/{n} workers registered")
+                        f"only {len(self._state.registered)}/{n} workers "
+                        "registered")
                 self._cv.wait(remaining)
 
     def _num_dead(self, timeout_s: float) -> int:
         now = time.time()
         with self._lock:
-            return sum(1 for h in self._workers
+            return sum(1 for h in self._state.workers
                        if now - self._heartbeats.get(h, 0.0) > timeout_s)
 
     # ------------------------------------------------------------------
@@ -523,26 +946,37 @@ class Scheduler:
     def _evict_loop(self):
         period = max(self.auto_evict_dead_s / 4.0, 0.1)
         while not self._stop.wait(period):
+            if not self._active.is_set():
+                continue  # fenced ex-leader: membership is not ours
             now = time.time()
             with self._cv:
+                st = self._state
                 dead = [
-                    h for h in self._workers
+                    h for h in st.workers
                     if now - self._heartbeats.get(h, 0.0) >
-                    (self.auto_evict_dead_s if h in self._registered
+                    (self.auto_evict_dead_s if h in st.registered
                      else self.startup_grace_s)]
                 if not dead:
                     continue
-                for h in dead:
-                    logger.warning("evicting dead worker %s (silent %.1fs)",
-                                   h, now - self._heartbeats.get(h, 0.0))
-                    self._workers.remove(h)
-                    self._registered.discard(h)
-                    self._removed_hosts.add(h)
-                    self._base.discard(h)
-                    self._append_log("REMOVED", h)
-                self._dp.hosts_removed(set(dead))
-                self._rewrite_host_file(dead)
-                self._complete_pending_locked()
+                try:
+                    for h in dead:
+                        logger.warning(
+                            "evicting dead worker %s (silent %.1fs)",
+                            h, now - self._heartbeats.get(h, 0.0))
+                        self._apply("evict", host=h, seq=st.log_seq + 1)
+                        self._audit_locked("REMOVED", h)
+                    self._dp.hosts_removed(set(dead))
+                    self._rewrite_host_file(dead)
+                    # _complete_pending_locked journal-appends too
+                    # (barrier_complete / mc_* ops) — a Fenced escaping
+                    # from it used to kill this thread with _active
+                    # still set: a deposed ex-leader kept serving as
+                    # leader (split-brain window) with auto-eviction
+                    # silently dead
+                    self._complete_pending_locked()
+                except journal.Fenced:
+                    self._active.clear()
+                    continue
                 self._cv.notify_all()
 
     def _rewrite_host_file(self, evicted):
@@ -577,26 +1011,23 @@ class Scheduler:
     def _complete_pending_locked(self):
         """After membership shrank, finish any collective now satisfied by
         the survivors.  Caller holds the lock."""
-        live = set(self._workers)
+        st = self._state
+        live = set(st.workers)
         # pending mc_barrier
-        if self._barrier_epoch is not None and live and \
-                self._barrier_arrived >= live:
-            epoch = self._barrier_epoch
+        if st.barrier_epoch is not None and live and \
+                st.barrier_arrived >= live:
+            epoch = st.barrier_epoch
             result = self._apply_membership_change(epoch)
-            self._barrier_result[epoch] = result
-            self._last_completed_epoch = epoch
-            self._barrier_epoch = None
-            self._barrier_arrived = set()
+            self._apply("barrier_complete", epoch=epoch, result=result)
             self._obs.complete_span("mc_barrier.window", self._barrier_t0,
                                     {"epoch": epoch,
                                      "released_by": "survivors"})
             self._barrier_t0 = None
         # pending plain barrier
-        if self._plain_arrived and live and self._plain_arrived >= live:
-            self._plain_arrived = set()
-            self._plain_gen += 1
+        if st.plain_arrived and live and st.plain_arrived >= live:
+            self._apply("plain_release", gen=st.plain_gen + 1)
         # pending allreduce rounds finish with the survivors
-        self._dp.complete_with(live, ordered=self._workers)
+        self._dp.complete_with(live, ordered=st.workers)
 
     # ------------------------------------------------------------------
     # membership-change barrier (the heart — SURVEY.md §3.3)
@@ -604,48 +1035,45 @@ class Scheduler:
 
     def _mc_barrier(self, host: str, epoch: int, info: dict) -> dict:
         with self._cv:
-            if host in self._pending_recovery:
+            st = self._state
+            if host in st.pending_recovery:
                 # a recovering host parks at the NEXT barrier whatever
                 # epoch it thinks it resumes at (its resume_epoch goes
                 # stale while it bootstraps; van.cc:187-218 skips the
                 # init barriers the same way)
-                epoch = max(epoch, self._last_completed_epoch + 1)
-            admitted = self._recovered_at.get(host)
+                epoch = max(epoch, st.last_completed_epoch + 1)
+            admitted = st.recovered_at.get(host)
             if admitted is not None:
                 if epoch <= admitted:
                     # at-least-once retry of the admitting barrier (its
                     # response was lost): serve the SAME result
                     return self._result_for(host,
-                                            self._barrier_result[admitted])
+                                            st.barrier_result[admitted])
                 # the host moved past its re-admission normally
-                del self._recovered_at[host]
-            if epoch <= self._last_completed_epoch:
+                self._apply("recovered_clear", host=host)
+            if epoch <= st.last_completed_epoch:
                 # late arrival (a worker added during this epoch's barrier):
                 # the change was already applied — return the result
-                res = self._barrier_result.get(epoch)
+                res = st.barrier_result.get(epoch)
                 if res is None:
-                    res = {"workers": list(self._workers), "removed": [],
+                    res = {"workers": list(st.workers), "removed": [],
                            "added": [], "epoch": epoch}
                 return self._result_for(host, res)
 
-            if self._barrier_epoch is None:
-                self._barrier_epoch = epoch
+            if st.barrier_epoch is None:
                 # the barrier WINDOW span: first arrival -> release (the
                 # job-level "how long does a membership change stall
                 # training" number the reference never measured)
                 self._barrier_t0 = self._obs.now()
-            self._barrier_arrived.add(host)
+            self._apply("barrier_arrive", host=host, epoch=epoch)
             faults.crash_point("sched.barrier_arrived", host=host,
                                epoch=epoch)
 
-            if self._barrier_arrived >= set(self._workers):
+            if st.barrier_arrived >= set(st.workers):
                 # everyone is here: apply at most one membership change
-                arrived = len(self._barrier_arrived)
+                arrived = len(st.barrier_arrived)
                 result = self._apply_membership_change(epoch)
-                self._barrier_result[epoch] = result
-                self._last_completed_epoch = epoch
-                self._barrier_epoch = None
-                self._barrier_arrived = set()
+                self._apply("barrier_complete", epoch=epoch, result=result)
                 self._obs.complete_span("mc_barrier.window",
                                         self._barrier_t0,
                                         {"epoch": epoch,
@@ -654,10 +1082,12 @@ class Scheduler:
                 self._cv.notify_all()
                 return self._result_for(host, result)
 
-            while epoch > self._last_completed_epoch:
+            while epoch > st.last_completed_epoch:
+                if self._stop.is_set():
+                    raise RuntimeError("scheduler closed")
                 if not self._cv.wait(timeout=300):
                     raise TimeoutError(f"mc_barrier epoch {epoch} stuck")
-            return self._result_for(host, self._barrier_result[epoch])
+            return self._result_for(host, st.barrier_result[epoch])
 
     def _result_for(self, host: str, result: dict) -> dict:
         out = dict(result)
@@ -675,36 +1105,47 @@ class Scheduler:
         changes the worker count.  ``Module.fit``'s mesh-rebuild trigger
         (count comparison) and ``MeshManager.depart``'s collective
         matching both depend on this; if this ever applies mixed changes
-        in one barrier, fit must switch to comparing the member LIST."""
+        in one barrier, fit must switch to comparing the member LIST.
+
+        HA: ``mc_begin`` is journaled before the diff and every applied
+        remove/recover/add is its own journal record, so a leader killed
+        in here leaves a replayable prefix; the successor resumes the
+        SAME barrier in the SAME change direction (``mc_partial`` pins
+        removals even if the remaining removable set is empty)."""
         t0 = self._obs.now()
+        st = self._state
         if self._pre_change_hook is not None:
             try:
                 self._pre_change_hook(epoch)
             except Exception:
                 logger.exception("pre_change_hook failed")
-        desired = set(self._workers)
+        desired = set(st.workers)
         if self.host_worker_file and os.path.exists(self.host_worker_file):
             desired = set(_read_hosts(self.host_worker_file))
 
-        current = set(self._workers)
-        removable = (current - desired) - self._base  # base protected
-        blocked = (current - desired) & self._base
+        # the unqualified mid-change kill site (chaos scheduler_kill_mc):
+        # all arrivals are journaled, the completion is not — the
+        # successor must resume THIS barrier; the per-host calls below
+        # land between individual membership ops
+        faults.crash_point("sched.membership_change", epoch=epoch)
+        self._apply("mc_begin", epoch=epoch)
+        partial = st.mc_partial  # a predecessor's mid-change prefix
+        current = set(st.workers)
+        removable = (current - desired) - st.base  # base protected
+        blocked = (current - desired) & st.base
         if blocked:
             logger.warning("refusing to remove base workers %s "
                            "(README.md:54-61)", sorted(blocked))
-        removed: List[str] = []
-        added: List[str] = []
-        recovered: List[str] = []
-        if removable:
+        if removable or partial["removed"]:
             # removals win; a pending recovery stays queued for the next
-            # barrier (one change direction per barrier — the invariant)
-            removed = sorted(removable)
-            self._workers = [w for w in self._workers if w not in removable]
-            self._removed_hosts |= removable
-            self._registered -= removable
+            # barrier (one change direction per barrier — the invariant,
+            # which a crash-resumed removal barrier keeps too)
+            for h in sorted(removable):
+                faults.crash_point("sched.membership_change", host=h,
+                                   epoch=epoch)
+                self._apply("mc_remove", host=h, seq=st.log_seq + 1)
+                self._audit_locked("REMOVED", h)
             self._dp.hosts_removed(removable)
-            for h in removed:
-                self._append_log("REMOVED", h)
         else:
             # identity reissue first (van.cc:187-218): evicted-but-
             # restarted hosts come back AS THEMSELVES — base protection
@@ -713,35 +1154,33 @@ class Scheduler:
             # Only hosts that ARRIVED at this barrier re-enter: they then
             # start the epoch in lockstep with the survivors (exact
             # sync); a still-bootstrapping host stays pending.
-            for h in sorted(self._pending_recovery & self._barrier_arrived):
-                self._pending_recovery.discard(h)
-                self._removed_hosts.discard(h)
-                if h not in self._workers:
-                    self._workers.append(h)
-                if h in self._base0:
-                    self._base.add(h)
-                recovered.append(h)
-                self._recovered_at[h] = epoch
-                self._append_log("RECOVERED", h)
+            for h in sorted(st.pending_recovery & st.barrier_arrived):
+                faults.crash_point("sched.membership_change", host=h,
+                                   epoch=epoch)
+                self._apply("mc_recover", host=h, epoch=epoch,
+                            seq=st.log_seq + 1)
+                self._audit_locked("RECOVERED", h)
                 self._add_to_host_file(h)
             # a pending-recovery host must re-enter ONLY through the
             # recovery loop above (as itself, at a barrier it arrived
             # at) — never through the plain ADD diff, which would grant
             # it a fresh-worker rank mid-bootstrap (r5 advisor race)
-            to_add = sorted(desired - set(self._workers)
-                            - self._pending_recovery)
+            to_add = sorted(desired - set(st.workers)
+                            - st.pending_recovery)
             for h in to_add:
-                if h in self._removed_hosts:
-                    self._removed_hosts.discard(h)  # re-adding is allowed
-                self._workers.append(h)
+                faults.crash_point("sched.membership_change", host=h,
+                                   epoch=epoch)
+                self._apply("mc_add", host=h, seq=st.log_seq + 1)
                 self._heartbeats[h] = time.time()  # grace until it registers
-                added.append(h)
-                self._append_log("ADDED", h)
+                self._audit_locked("ADDED", h)
                 if self._launch_callback is not None:
                     # launch with EPOCH_BEGIN = this epoch (the barrier runs
                     # BEFORE epoch's batches; elastic_training.cc:26-62)
                     threading.Thread(target=self._launch_callback,
                                      args=(h, epoch), daemon=True).start()
+        removed = list(partial["removed"])
+        added = list(partial["added"])
+        recovered = list(partial["recovered"])
         if removed or added or recovered:
             self._obs.complete_span(
                 "membership_change", t0,
@@ -749,22 +1188,23 @@ class Scheduler:
                  "recovered": recovered})
             logger.info("Epoch[%d] membership change: removed=%s added=%s "
                         "recovered=%s -> %s", epoch, removed, added,
-                        recovered, self._workers)
-        return {"workers": list(self._workers), "removed": removed,
+                        recovered, st.workers)
+        return {"workers": list(st.workers), "removed": removed,
                 "added": added, "recovered": recovered, "epoch": epoch}
 
-    def _append_log(self, action: str, host: str):
+    def _audit_locked(self, action: str, host: str):
         """``SEQ ADDED|REMOVED IP TIME`` (``elastic_training.cc:108-126``).
-        Caller holds the lock (the seq must be unique and ordered)."""
-        self._log_seq += 1
+        Caller holds the lock; the seq was already advanced by the
+        journaled membership op (unique and ordered by construction)."""
+        seq = self._state.log_seq
         # every audit line is also a timeline event: ADDED / REMOVED /
         # RECOVERED (covers operator removals, auto-evictions, and the
         # quick-restart eviction, which all funnel through here)
         self._obs.event(f"membership.{action}",
-                        {"host": host, "seq": self._log_seq})
+                        {"host": host, "seq": seq})
         if self._log_path:
             with open(self._log_path, "a") as f:
-                f.write(f"{self._log_seq} {action} {host} "
+                f.write(f"{seq} {action} {host} "
                         f"{time.strftime('%Y-%m-%d_%H:%M:%S')}\n")
 
     # ------------------------------------------------------------------
@@ -776,17 +1216,25 @@ class Scheduler:
         request whose generation already released returns immediately
         instead of polluting the next generation)."""
         with self._cv:
-            if seq >= 0 and self._plain_served.get(host) == seq:
-                return {}  # retry of a released barrier
-            gen = self._plain_gen
-            self._plain_arrived.add(host)
-            self._plain_served[host] = seq
-            if self._plain_arrived >= set(self._workers):
-                self._plain_arrived = set()
-                self._plain_gen += 1
+            st = self._state
+            if seq >= 0 and host not in st.plain_arrived and \
+                    st.plain_served.get(host) == seq:
+                # retry of a RELEASED barrier (arrival was consumed by a
+                # plain_release).  The host-still-arrived case must fall
+                # through and park again: after a failover the successor
+                # replays the arrival from the journal, and answering the
+                # replay here would let this worker through a barrier the
+                # rest of the fleet has not reached (docs/ha.md)
+                return {}
+            gen = st.plain_gen
+            self._apply("plain_arrive", host=host, seq=seq)
+            if st.plain_arrived >= set(st.workers):
+                self._apply("plain_release", gen=gen + 1)
                 self._cv.notify_all()
                 return {}
-            while self._plain_gen == gen:
+            while st.plain_gen == gen:
+                if self._stop.is_set():
+                    raise RuntimeError("scheduler closed")
                 if not self._cv.wait(timeout=300):
                     raise TimeoutError("barrier stuck")
             return {}
@@ -811,7 +1259,6 @@ class Scheduler:
     def _async_store(self):
         """Embedded plane's dist_async master weights (test hook)."""
         return self._dp._async_store
-
 
 
 def _read_hosts(path: str) -> List[str]:

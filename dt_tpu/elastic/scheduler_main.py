@@ -1,0 +1,121 @@
+"""Standalone scheduler process — ``python -m dt_tpu.elastic.scheduler_main``.
+
+The reference ran its scheduler role as one process of the ps-lite job
+(``tools/launch.py`` forks it; role wiring
+``ps-lite/src/postoffice.cc:18-31``).  dt_tpu historically embedded the
+:class:`Scheduler` in the launcher/test process; this entrypoint runs it
+standalone so the
+control-plane HA pair can live in separate failure domains:
+
+- the **primary**: ``--journal J --lease L [--peer standby_host:port]`` —
+  journals every control transition and (with ``--peer``) replicates
+  completed allreduce rounds to the standby before answering.
+- the **warm standby**: ``--standby --journal J --lease L`` — tails the
+  journal, watches the lease, takes over with a bumped fencing
+  incarnation when the primary goes silent (docs/ha.md).
+
+``tools/chaos_run.py --plan scheduler_kill`` runs the primary through
+this entrypoint with a seeded ``DT_FAULT_PLAN`` crash rule
+(``sched.allreduce`` / ``sched.barrier_arrived`` /
+``sched.membership_change`` sites) so the kill is an ``os._exit(137)``
+of a real process, indistinguishable from SIGKILL; the launcher's
+``--standby`` flag runs the standby through it.
+
+jax-free on purpose (imports only the elastic/obs stack): the scheduler
+is a pure control service and must start in milliseconds, not after a
+jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import Optional, Tuple
+
+
+def _parse_addr(spec: str) -> Tuple[str, int]:
+    host, _, port = spec.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def _relay_obs(sched, peer: Tuple[str, int]) -> None:
+    """Crash-path flush hook: push this process's control-plane track to
+    the peer scheduler over ``obs_push`` so an injected ``os._exit``
+    (fault plan ``action="exit"``) still lands this incarnation's spans
+    and fault events on the merged job timeline — the same contract
+    WorkerClient's crash flush gives workers."""
+    from dt_tpu.elastic import protocol
+    own = sched._obs.snapshot()
+    from dt_tpu.obs import trace as obs_trace
+    proc = obs_trace.tracer().snapshot()
+    payload = {"inc": os.getpid(), "fseq": 1,
+               "records": own["records"] + proc["records"],
+               "counters": {**proc["counters"], **own["counters"]},
+               "dropped": own["dropped"] + proc["dropped"]}
+    try:
+        protocol.request(peer[0], peer[1],
+                         {"cmd": "obs_push", "host": "sched-primary",
+                          "obs": payload}, timeout=2.0)
+    except (OSError, RuntimeError):
+        pass  # observability is never fatal, least of all mid-crash
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dt_tpu elastic scheduler process (HA primary or "
+                    "warm standby)")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--port-file", default="",
+                    help="write the bound port here once listening "
+                         "(launcher/chaos rendezvous)")
+    ap.add_argument("--host-worker-file", default=None)
+    ap.add_argument("--journal", default=None,
+                    help="control-state WAL path (DT_CTRL_JOURNAL)")
+    ap.add_argument("--lease", default=None,
+                    help="leader lease file (default <journal>.lease)")
+    ap.add_argument("--lease-s", type=float, default=None)
+    ap.add_argument("--standby", action="store_true",
+                    help="run as the warm standby (journal tail + "
+                         "lease watch + takeover)")
+    ap.add_argument("--peer", default="",
+                    help="host:port of the standby to replicate "
+                         "completed rounds to (primary only)")
+    ap.add_argument("--expected-workers", type=int, default=None)
+    ap.add_argument("--auto-evict-dead-s", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s sched[%(process)d] %(levelname)s %(message)s")
+    from dt_tpu.elastic.scheduler import Scheduler
+    from dt_tpu.obs import trace as obs_trace
+
+    peer = _parse_addr(args.peer) if args.peer else None
+    sched = Scheduler(host_worker_file=args.host_worker_file,
+                      port=args.port,
+                      expected_workers=args.expected_workers,
+                      auto_evict_dead_s=args.auto_evict_dead_s,
+                      journal_path=args.journal,
+                      lease_path=args.lease,
+                      lease_s=args.lease_s,
+                      standby=args.standby,
+                      peer=peer)
+    if peer is not None:
+        obs_trace.register_flush(lambda: _relay_obs(sched, peer))
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(sched.port))
+        os.replace(tmp, args.port_file)
+    role = "standby" if args.standby else "primary"
+    logging.getLogger("dt_tpu.elastic").info(
+        "%s scheduler up on :%d (journal=%s)", role, sched.port,
+        args.journal)
+    sched.join()  # parks until a shutdown command / close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,6 +96,20 @@ def _await_servers(sched, n_servers: int, timeout: float = 60.0) -> None:
         time.sleep(0.1)
 
 
+def _await_port_file(path: str, timeout: float = 30.0) -> int:
+    """Wait for a scheduler_main child to write its bound port (the
+    standby binds port 0; the parent needs the real number to compose
+    ``DT_CTRL_ENDPOINTS`` before any worker starts)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with open(path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise RuntimeError(f"standby scheduler never wrote {path}")
+
+
 def _reap_all(procs: dict) -> dict:
     """Wait for every proc, re-snapshotting until stable: the scheduler's
     launch thread may still be inserting elastic joiners while base
@@ -111,11 +125,19 @@ def _reap_all(procs: dict) -> dict:
 
 def launch_local(num_workers: int, command: List[str],
                  hostfile: Optional[str] = None, elastic: bool = False,
-                 scheduler_port: int = 0, num_servers: int = 0):
+                 scheduler_port: int = 0, num_servers: int = 0,
+                 standby: bool = False, ha_dir: Optional[str] = None):
     """Fork scheduler + optional range-server fleet + N local workers;
     returns worker exit codes.  ``num_servers`` is the DMLC_NUM_SERVER
     analog: >0 starts that many ``RangeServer`` processes and the data
-    plane shards across them (``kvstore_dist.h:547-589``)."""
+    plane shards across them (``kvstore_dist.h:547-589``).
+
+    ``standby=True`` (r11 control-plane HA, docs/ha.md): the in-process
+    scheduler journals its control state and a warm-standby scheduler
+    process (``dt_tpu.elastic.scheduler_main --standby``) tails the
+    journal; workers get both endpoints via ``DT_CTRL_ENDPOINTS`` so a
+    primary death fails the job over instead of killing it.  ``ha_dir``
+    holds the journal/lease files (default: a fresh temp dir)."""
     from dt_tpu.elastic import Scheduler
     from dt_tpu.elastic import protocol
 
@@ -133,16 +155,49 @@ def launch_local(num_workers: int, command: List[str],
     server_procs = {}
     secret_env = {"DT_ELASTIC_SECRET": secret} if secret else {}
 
+    journal = lease = None
+    standby_proc = None
+    standby_port = None
+    if standby:
+        import tempfile
+        had = ha_dir or tempfile.mkdtemp(prefix="dt_ctrl_ha_")
+        os.makedirs(had, exist_ok=True)
+        journal = os.path.join(had, "ctrl.journal")
+        lease = os.path.join(had, "ctrl.lease")
+        port_file = os.path.join(had, "standby.port")
+        standby_proc = subprocess.Popen(
+            [sys.executable, "-m", "dt_tpu.elastic.scheduler_main",
+             "--standby", "--journal", journal, "--lease", lease,
+             "--port-file", port_file]
+            + (["--host-worker-file", hostfile] if hostfile else []),
+            env={**os.environ, **secret_env})
+        standby_port = _await_port_file(port_file)
+        logger.info("warm-standby scheduler on :%d (journal %s)",
+                    standby_port, journal)
+
+    # DT_CTRL_ENDPOINTS needs the primary's port, which is only known
+    # once the Scheduler binds — fill the dict in place after
+    # construction so launch_new (captured as the launch_callback,
+    # possibly fired during a journal-replayed membership change) never
+    # sees an unbound name
+    endpoints_env: dict = {}
+
     def launch_new(host: str, epoch: int):
         logger.info("launching elastic worker %s (EPOCH_BEGIN=%d)", host, epoch)
         procs[host] = subprocess.Popen(
             command, env=_worker_env(
                 os.environ, sched.port, host, hostfile, elastic,
                 {"NEW_WORKER": "1", "EPOCH_BEGIN": str(epoch),
-                 "TRAINING_CMD": " ".join(command), **secret_env}))
+                 "TRAINING_CMD": " ".join(command), **secret_env,
+                 **endpoints_env}))
 
     sched = Scheduler(host_worker_file=hostfile, initial_workers=hosts,
-                      launch_callback=launch_new if elastic else None)
+                      launch_callback=launch_new if elastic else None,
+                      journal_path=journal, lease_path=lease,
+                      peer=("127.0.0.1", standby_port) if standby else None)
+    if standby:
+        endpoints_env["DT_CTRL_ENDPOINTS"] = \
+            f"127.0.0.1:{sched.port},127.0.0.1:{standby_port}"
     logger.info("scheduler on :%d; starting %d servers + %d workers",
                 sched.port, num_servers, num_workers)
     try:
@@ -168,12 +223,13 @@ def launch_local(num_workers: int, command: List[str],
                 command, env=_worker_env(os.environ, sched.port, h, hostfile,
                                          elastic,
                                          {"TRAINING_CMD": " ".join(command),
-                                          **secret_env}))
+                                          **secret_env, **endpoints_env}))
         return _reap_all(procs)
     finally:
         sched.close()
         protocol.set_secret(None)
-        for p in list(procs.values()) + list(server_procs.values()):
+        extra = [standby_proc] if standby_proc is not None else []
+        for p in list(procs.values()) + list(server_procs.values()) + extra:
             if p.poll() is None:
                 p.terminate()
 
@@ -315,6 +371,14 @@ def main(argv=None) -> int:
     ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
     ap.add_argument("--elastic-training-enabled", default="False",
                     help="True enables the epoch-boundary membership protocol")
+    ap.add_argument("--standby", action="store_true",
+                    help="control-plane HA (local launcher): journal the "
+                         "scheduler state and run a warm-standby "
+                         "scheduler process; workers fail over via "
+                         "DT_CTRL_ENDPOINTS (docs/ha.md)")
+    ap.add_argument("--ha-dir", default=None,
+                    help="directory for the HA journal/lease files "
+                         "(default: fresh temp dir)")
     ap.add_argument("--scheduler-port", type=int, default=0)
     ap.add_argument("--ssh-cmd", default="ssh -o StrictHostKeyChecking=no",
                     help="ssh launcher: command prefix used to reach hosts")
@@ -332,13 +396,20 @@ def main(argv=None) -> int:
     if args.launcher == "ssh":
         if not args.hostfile:
             ap.error("ssh launcher requires -H hostfile")
+        if args.standby:
+            # the journal/lease live on a filesystem both schedulers
+            # see; the local launcher guarantees that, ssh does not —
+            # run the standby by hand on shared storage instead
+            ap.error("--standby is local-launcher only (the ssh "
+                     "launcher cannot assume a shared journal path)")
         rcs = launch_ssh(args.num_workers, args.command, args.hostfile,
                          elastic, args.scheduler_port, args.ssh_cmd,
                          args.root_uri, num_servers=args.num_servers)
     else:
         rcs = launch_local(args.num_workers, args.command, args.hostfile,
                            elastic, args.scheduler_port,
-                           num_servers=args.num_servers)
+                           num_servers=args.num_servers,
+                           standby=args.standby, ha_dir=args.ha_dir)
     bad = {h: rc for h, rc in rcs.items() if rc != 0}
     if bad:
         logger.error("workers failed: %s", bad)

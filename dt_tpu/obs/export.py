@@ -101,6 +101,8 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
 
     tracks: Dict[str, Any] = {}
     membership: List[dict] = []
+    failovers: List[dict] = []
+    leadership: List[dict] = []
     total_faults = 0
     for ev in chrome.get("traceEvents", ()):
         if ev.get("ph") == "M":
@@ -127,12 +129,27 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
                                    **{k: v for k, v in ev["args"].items()
                                       if k in ("epoch", "removed", "added",
                                                "recovered")}})
+            if name == "scheduler.failover":
+                # the control-plane HA takeover span (docs/ha.md): the
+                # chaos harness and dtop both report its count + duration
+                failovers.append({"track": track, "ts": ev.get("ts"),
+                                  "dur_ms": round(dur_ms, 3),
+                                  **{k: v for k, v in ev["args"].items()
+                                     if k in ("incarnation", "reason",
+                                              "workers")}})
         else:
             tr["events"] += 1
             if name.startswith("fault."):
                 kind = name[len("fault."):]
                 tr["faults"][kind] = tr["faults"].get(kind, 0) + 1
                 total_faults += 1
+            if name in ("leader.elected", "leader.fenced"):
+                # leader-incarnation timeline: elections (primary start +
+                # failover takeovers) and fencings, job-wide order
+                leadership.append({"track": track, "ts": ev.get("ts"),
+                                   "what": name.split(".", 1)[1],
+                                   **{k: v for k, v in ev["args"].items()
+                                      if k in ("incarnation", "reason")}})
 
     meta = (chrome.get("otherData") or {}).get("tracks") or {}
     out_tracks: Dict[str, Any] = {}
@@ -170,6 +187,8 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
     return {"tracks": out_tracks,
             "membership_changes": sorted(membership,
                                          key=lambda m: m.get("ts") or 0),
+            "failovers": sorted(failovers, key=lambda m: m.get("ts") or 0),
+            "leadership": sorted(leadership, key=lambda m: m.get("ts") or 0),
             "total_fault_events": total_faults}
 
 

@@ -130,28 +130,27 @@ def test_dt006_scheduler_copy_detects_unguarded_access(tmp_path):
         "\n".join(f.render() for f in clean)
 
     # move an access outside the lock: a new method reads the guarded
-    # live set with no 'with self._lock' — the quick-restart-race class
-    # of bug this rule exists to catch
+    # journaled control state with no 'with self._lock' — the
+    # quick-restart-race class of bug this rule exists to catch
     racy = src.replace(
-        "    def _append_log(self, action: str, host: str):",
+        "    def _audit_locked(self, action: str, host: str):",
         "    def _racy_membership(self):\n"
-        "        return list(self._workers)\n\n"
-        "    def _append_log(self, action: str, host: str):")
+        "        return list(self._state.workers)\n\n"
+        "    def _audit_locked(self, action: str, host: str):")
     assert "_racy_membership" in racy
     (pkg / "scheduler.py").write_text(racy)
     findings = run(str(fixture_root), paths=["dt_tpu"], select={"DT006"})
-    assert any("_workers" in f.message for f in findings), \
+    assert any("_state" in f.message for f in findings), \
         [f.render() for f in findings]
 
     # equivalently: deleting the guarded-by annotation must not crash and
     # silences the rule for that attribute (annotation IS the contract)
     unannotated = racy.replace(
-        "self._workers: List[str] = list(initial_workers or [])  "
-        "# guarded-by: _lock",
-        "self._workers: List[str] = list(initial_workers or [])")
+        "self._state = journal.ControlState()  # guarded-by: _lock",
+        "self._state = journal.ControlState()")
     (pkg / "scheduler.py").write_text(unannotated)
     findings = run(str(fixture_root), paths=["dt_tpu"], select={"DT006"})
-    assert not any("_workers" in f.message for f in findings)
+    assert not any("'_state'" in f.message for f in findings)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,589 @@
+"""Control-plane HA (r11): journal, lease fencing, replay, failover.
+
+The reference scheduler kept every piece of job state in one process's
+memory and died with it (``ps-lite/src/elastic_training.cc:1-158``).
+These tests pin the machinery that removes that single point of failure
+(``dt_tpu/elastic/journal.py``, the scheduler's journaled
+``ControlState``, the client's ordered-endpoint failover, docs/ha.md):
+
+- journal framing edges: incremental tail, torn final record (truncated
+  fsync), CRC corruption, replay idempotence (journal applied twice ==
+  once);
+- lease + fencing: a deposed leader's journal writes raise ``Fenced``;
+- structural replay: ``ControlState.rebuild(journal)`` equals the live
+  scheduler state — including after an injected crash *inside*
+  ``_apply_membership_change`` (the mid-change kill the successor must
+  resume);
+- satellites: ``TokenCache`` TTL + cap bounds, decorrelated-jitter
+  backoff spread, the ``close()`` vs ``_evict_loop`` shutdown race;
+- an in-process warm-standby failover: a worker parked at a barrier on
+  the dying primary stays parked on the successor until the whole fleet
+  arrives (barriers complete exactly once across the switch).
+
+Process-level failover under seeded kills lives in ``tools/chaos_run.py
+--plan scheduler_kill*`` (the primary really ``os._exit(137)``s there).
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from dt_tpu.elastic import Scheduler, WorkerClient, faults, journal, protocol
+from dt_tpu.elastic.faults import FaultPlan, FaultRule
+from dt_tpu.elastic.journal import ControlState, Fenced, JournalError
+from dt_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("DT_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("DT_CTRL_ENDPOINTS", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+    obs_trace.set_enabled(None)
+
+
+def _client(port, host, **kw):
+    return WorkerClient("127.0.0.1", port, host=host,
+                        heartbeat_interval_s=30.0, **kw)
+
+
+def _live_struct(sched):
+    with sched._lock:
+        return sched._state.struct()
+
+
+def _write_hosts(path, hosts):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(hosts) + "\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# journal framing
+# ---------------------------------------------------------------------------
+
+def test_journal_roundtrip_and_incremental_tail(tmp_path):
+    path = str(tmp_path / "j")
+    w = journal.JournalWriter(path, fence=3)
+    w.append("init", {"workers": ["a", "b"], "expected": 2})
+    w.append("worker_add", {"host": "a", "base": True})
+
+    r = journal.JournalReader(path)
+    first = r.read_new()
+    assert first == [(3, "init", {"workers": ["a", "b"], "expected": 2}),
+                     (3, "worker_add", {"host": "a", "base": True})]
+    assert r.read_new() == []  # nothing new
+
+    w.append("evict", {"host": "b", "seq": 1})
+    assert r.read_new() == [(3, "evict", {"host": "b", "seq": 1})]
+    w.close()
+
+    # one-shot replay sees everything
+    assert len(list(journal.replay(path))) == 3
+
+
+def test_torn_final_record_replay_stops_cleanly(tmp_path):
+    path = str(tmp_path / "j")
+    w = journal.JournalWriter(path)
+    w.append("init", {"workers": ["a"], "expected": 1})
+    w.append("worker_add", {"host": "a", "base": True})
+    w.close()
+    good = open(path, "rb").read()
+
+    # torn at every byte boundary of the FINAL record (crash mid-append /
+    # mid-fsync): replay must return exactly the first record, never raise
+    import struct as _s
+    ln, _crc = _s.Struct("<II").unpack(good[:8])
+    first_len = 8 + ln
+    for cut in range(first_len + 1, len(good)):
+        with open(path, "wb") as f:
+            f.write(good[:cut])
+        recs = journal.JournalReader(path).read_new()
+        assert len(recs) == 1, f"cut at {cut}: {recs}"
+        assert recs[0][1] == "init"
+
+    # CRC corruption of the tail is the same torn-tail case
+    bad = bytearray(good)
+    bad[-1] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(bad))
+    recs = journal.JournalReader(path).read_new()
+    assert [op for _f, op, _k in recs] == ["init"]
+
+    # a reader that saw the torn tail picks the record up once completed
+    with open(path, "wb") as f:
+        f.write(good[: first_len + 4])
+    r = journal.JournalReader(path)
+    assert [op for _f, op, _k in r.read_new()] == ["init"]
+    with open(path, "r+b") as f:
+        f.seek(0)
+        f.write(good)
+    assert [op for _f, op, _k in r.read_new()] == ["worker_add"]
+
+    # an absurd length header is corruption, not a torn tail
+    with open(path, "wb") as f:
+        f.write(_s.Struct("<II").pack(journal.MAX_RECORD + 1, 0))
+    with pytest.raises(JournalError):
+        journal.JournalReader(path).read_new()
+
+
+def test_mid_file_corruption_raises_not_truncates(tmp_path):
+    """A bad record with valid records AFTER it is true corruption, not
+    a torn tail: replay must raise, never silently rebuild a prefix
+    state (a standby taking over on one would be missing members)."""
+    path = str(tmp_path / "j")
+    w = journal.JournalWriter(path)
+    w.append("init", {"workers": ["a"], "expected": 1})
+    w.append("worker_add", {"host": "a", "base": True})
+    w.append("evict", {"host": "a", "seq": 1})
+    w.close()
+    good = open(path, "rb").read()
+
+    import struct as _s
+    ln, _crc = _s.Struct("<II").unpack(good[:8])
+    # flip one payload byte of the FIRST record (records follow it)
+    bad = bytearray(good)
+    bad[8] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(bad))
+    with pytest.raises(JournalError, match="mid-file corruption"):
+        journal.JournalReader(path).read_new()
+
+    # incremental reader: already-consumed good prefix, then the SECOND
+    # record corrupted with the third intact -> raise on the next read
+    with open(path, "wb") as f:
+        f.write(good)
+    r = journal.JournalReader(path)
+    assert len(r.read_new()) == 3
+    second_payload_at = 8 + ln + 8
+    bad = bytearray(good)
+    bad[second_payload_at] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(bad))
+    r2 = journal.JournalReader(path)
+    with pytest.raises(JournalError):
+        r2.read_new()
+
+
+def test_fenced_mid_append_withdraws_the_record(tmp_path):
+    """The check-then-act gap: a writer deposed BETWEEN its pre-append
+    lease check and its fsync must not leave the record in the journal
+    (the successor's takeover catch-up may already have run without
+    it).  The post-fsync re-verify truncates it back out."""
+    path = str(tmp_path / "j")
+    lease = journal.Lease(str(tmp_path / "lease"))
+    inc = lease.acquire("sched:A")
+
+    class _DeposedBetweenChecks:
+        """Lease view that answers the pre-check with our incarnation
+        and every later read with a successor's (the stall window)."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self._reads = 0
+
+        def incarnation(self):
+            self._reads += 1
+            return inc if self._reads == 1 else inc + 1
+
+    w = journal.JournalWriter(path, fence=inc, lease=lease)
+    w.append("init", {"workers": ["a"], "expected": 1})
+    w._lease = _DeposedBetweenChecks(lease)
+    with pytest.raises(Fenced, match="mid-append"):
+        w.append("evict", {"host": "a", "seq": 1})
+    w.close()
+    # the fenced record was withdrawn: replay sees ONLY the first op,
+    # and the file parses cleanly end-to-end (no torn garbage left)
+    assert [op for _f, op, _kw in journal.replay(path)] == ["init"]
+
+
+def test_journal_replay_idempotent_twice_equals_once(tmp_path):
+    """Applying the journal twice equals applying it once — the property
+    the standby's tail-then-takeover (and any replay retry) rests on."""
+    ops = [
+        ("init", {"workers": ["a", "b"], "expected": 2}),
+        ("worker_add", {"host": "a", "base": True}),
+        ("worker_add", {"host": "b", "base": True}),
+        ("plain_arrive", {"host": "a", "seq": 0}),
+        ("plain_arrive", {"host": "b", "seq": 0}),
+        ("plain_release", {"gen": 1}),
+        ("barrier_arrive", {"host": "a", "epoch": 1}),
+        ("barrier_arrive", {"host": "b", "epoch": 1}),
+        ("mc_begin", {"epoch": 1}),
+        ("mc_add", {"host": "c", "seq": 1}),
+        ("barrier_complete",
+         {"epoch": 1, "result": {"workers": ["a", "b", "c"],
+                                 "removed": [], "added": ["c"],
+                                 "recovered": [], "epoch": 1}}),
+        ("worker_add", {"host": "c", "base": False}),
+        ("quick_evict", {"host": "c", "seq": 2}),
+        ("recovery_pending", {"host": "c"}),
+        ("barrier_arrive", {"host": "a", "epoch": 2}),
+        ("barrier_arrive", {"host": "b", "epoch": 2}),
+        ("barrier_arrive", {"host": "c", "epoch": 2}),
+        ("mc_begin", {"epoch": 2}),
+        ("mc_recover", {"host": "c", "epoch": 2, "seq": 3}),
+        ("barrier_complete",
+         {"epoch": 2, "result": {"workers": ["a", "b", "c"],
+                                 "removed": [], "added": [],
+                                 "recovered": ["c"], "epoch": 2}}),
+        ("recovered_clear", {"host": "c"}),
+        ("evict", {"host": "b", "seq": 4}),
+        ("snapshot", {"blob": b"params-v2"}),
+    ]
+    once = ControlState()
+    for op, kw in ops:
+        once.apply(op, **kw)
+    twice = ControlState()
+    for _pass in range(2):
+        for op, kw in ops:
+            twice.apply(op, **kw)
+    assert once.struct() == twice.struct()
+
+    # and the same through the journal file itself
+    path = str(tmp_path / "j")
+    w = journal.JournalWriter(path)
+    for op, kw in ops:
+        w.append(op, kw)
+    w.close()
+    rebuilt = ControlState.rebuild(path)
+    assert rebuilt.struct() == once.struct()
+
+
+def test_snapshot_rides_sidecar_not_wal(tmp_path):
+    """Model-sized snapshot blobs must not inflate the journal: the WAL
+    carries a digest marker, the bytes live in a pruned sidecar, and
+    replay resolves the marker back to the blob."""
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0"])
+    jp = str(tmp_path / "ctrl.journal")
+    sched = Scheduler(host_worker_file=hw, journal_path=jp)
+    c = None
+    try:
+        c = _client(sched.port, "w0")
+        blob = {"params": list(range(50_000))}  # ~100 KB pickled
+        for i in range(3):  # supersede twice: sidecar GC keeps 2
+            c.publish_snapshot({**blob, "v": i})
+        assert c.fetch_snapshot() == {**blob, "v": 2}
+        # the journal holds markers, not blobs
+        assert os.path.getsize(jp) < 10_000
+        snaps = [n for n in os.listdir(str(tmp_path))
+                 if n.startswith("ctrl.journal.snap.")]
+        assert len(snaps) == 2  # newest two retained
+        # replay resolves the marker to the real blob
+        rebuilt = ControlState.rebuild(jp)
+        assert rebuilt.snapshot == {**blob, "v": 2}
+        assert rebuilt.struct() == _live_struct(sched)
+    finally:
+        if c is not None:
+            c.close()
+        sched.close()
+
+
+def test_lease_fencing_refuses_stale_leader(tmp_path):
+    path = str(tmp_path / "j")
+    lease = journal.Lease(str(tmp_path / "lease"))
+    inc_a = lease.acquire("sched:A")
+    assert inc_a == 1
+    wa = journal.JournalWriter(path, fence=inc_a, lease=lease)
+    wa.append("init", {"workers": ["a"], "expected": 1})
+    assert lease.renew(inc_a, "sched:A")
+
+    inc_b = lease.acquire("sched:B")  # the standby takes over
+    assert inc_b == 2
+    # the deposed leader cannot write another record, or renew
+    with pytest.raises(Fenced):
+        wa.append("evict", {"host": "a", "seq": 1})
+    assert not lease.renew(inc_a, "sched:A")
+    wa.close()
+
+    wb = journal.JournalWriter(path, fence=inc_b, lease=lease)
+    wb.append("evict", {"host": "a", "seq": 1})
+    wb.close()
+    fences = [f for f, _op, _kw in journal.replay(path)]
+    assert fences == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# structural replay equality against a live scheduler
+# ---------------------------------------------------------------------------
+
+def test_rebuild_from_journal_equals_live_state(tmp_path):
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1"])
+    jp = str(tmp_path / "ctrl.journal")
+    sched = Scheduler(host_worker_file=hw, journal_path=jp)
+    cs = []
+    try:
+        cs = [_client(sched.port, h) for h in ("w0", "w1")]
+        # a plain barrier, a snapshot, and one membership change (ADD)
+        t = threading.Thread(target=cs[0].barrier, daemon=True)
+        t.start()
+        cs[1].barrier()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        cs[0].publish_snapshot({"step": 7})
+
+        _write_hosts(hw, ["w0", "w1", "w2"])
+        errs = []
+
+        def arrive(c):
+            try:
+                c.membership_change_barrier({"EPOCH_BEGIN": 1})
+            except Exception as e:  # pragma: no cover - surfaced below
+                errs.append(e)
+
+        ths = [threading.Thread(target=arrive, args=(c,), daemon=True)
+               for c in cs]
+        [t.start() for t in ths]
+        [t.join(timeout=60) for t in ths]
+        assert not errs and not any(t.is_alive() for t in ths)
+        assert sorted(cs[0].workers) == ["w0", "w1", "w2"]
+
+        live = _live_struct(sched)
+        assert ControlState.rebuild(jp).struct() == live
+        assert live["has_snapshot"] and live["last_completed_epoch"] == 1
+    finally:
+        for c in cs:
+            c.close()
+        sched.close()
+
+
+def test_rebuild_equals_live_after_mid_membership_change_crash(tmp_path):
+    """A leader killed INSIDE ``_apply_membership_change`` leaves a
+    replayable prefix (``mc_begin`` journaled, the per-host op not): the
+    journal rebuild matches the live partial state, and a retry resumes
+    the SAME barrier to completion."""
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1"])
+    jp = str(tmp_path / "ctrl.journal")
+    sched = Scheduler(host_worker_file=hw, journal_path=jp)
+    cs = []
+    try:
+        cs = [_client(sched.port, h) for h in ("w0", "w1")]
+        _write_hosts(hw, ["w0", "w1", "w2"])
+        # crash exactly at the per-host site for the ADD of w2 — after
+        # mc_begin hit the journal, before the mc_add op does
+        faults.install(FaultPlan(
+            [FaultRule("crash", site="sched.membership_change",
+                       host="w2", action="raise")], seed=0))
+
+        parked = threading.Thread(
+            target=cs[0].membership_change_barrier,
+            args=({"EPOCH_BEGIN": 1},), daemon=True)
+        parked.start()
+        deadline = time.time() + 30
+        while "w0" not in sched._barrier_arrived:
+            assert time.time() < deadline
+            time.sleep(0.01)
+        # the LAST arrival applies the change and hits the crash site
+        with pytest.raises(RuntimeError, match="CrashInjected"):
+            cs[1].membership_change_barrier({"EPOCH_BEGIN": 1})
+
+        live = _live_struct(sched)
+        assert live["mc_partial"] == {"epoch": 1, "removed": [],
+                                      "recovered": [], "added": []}
+        assert ControlState.rebuild(jp).struct() == live
+
+        # clear the fault: the retried barrier resumes the same change
+        faults.clear()
+        cs[1].membership_change_barrier({"EPOCH_BEGIN": 1})
+        parked.join(timeout=30)
+        assert not parked.is_alive()
+        assert sorted(cs[1].workers) == ["w0", "w1", "w2"]
+        live = _live_struct(sched)
+        assert live["mc_partial"] is None
+        assert ControlState.rebuild(jp).struct() == live
+    finally:
+        for c in cs:
+            c.close()
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# satellites: TokenCache bounds, retry jitter, close/evict race
+# ---------------------------------------------------------------------------
+
+def test_token_cache_ttl_and_cap_bound_memory():
+    now = [0.0]
+    tc = protocol.TokenCache(cap=3, ttl_s=10.0, clock=lambda: now[0])
+    tc.put("a", {"v": 1})
+    # replay inside the window dedups to the SAME response
+    assert tc.get("a") == {"v": 1}
+    now[0] = 9.9
+    assert tc.get("a") == {"v": 1}
+    # past the TTL the entry is gone (a retry can no longer land there —
+    # its sender's backoff horizon is far shorter)
+    now[0] = 10.1
+    assert tc.get("a") is None
+    assert len(tc) == 0
+
+    # expired entries are swept by put() even when the cache is not full
+    now[0] = 0.0
+    tc.put("a", {"v": 1})
+    now[0] = 20.0
+    tc.put("b", {"v": 2})
+    assert len(tc) == 1  # "a" aged out on the sweep, not just on get
+
+    # LRU cap holds independent of TTL
+    now[0] = 21.0
+    tc.put("c", {"v": 3})
+    tc.put("d", {"v": 4})
+    tc.put("e", {"v": 5})
+    assert len(tc) == 3
+    assert tc.get("b") is None  # oldest evicted
+    assert tc.get("e") == {"v": 5}
+
+
+def test_backoff_jitter_is_spread_not_lockstep():
+    rng = random.Random(7)
+    base, cap = 0.1, 2.0
+    d, delays = base, []
+    for _ in range(300):
+        d = protocol.next_backoff(d, base, cap, rng=rng)
+        delays.append(d)
+    assert all(base <= x <= cap for x in delays)
+    # decorrelated: a wide spread of distinct values, NOT the exponential
+    # doubling ladder that synchronizes a failing-over fleet
+    assert len({round(x, 9) for x in delays}) > 250
+    ladder = {min(base * 2 ** k, cap) for k in range(1, 12)}
+    assert not {round(x, 9) for x in delays} <= ladder
+    # injectable rng => deterministic sequence (testability contract)
+    rng2 = random.Random(7)
+    d2, replay = base, []
+    for _ in range(300):
+        d2 = protocol.next_backoff(d2, base, cap, rng=rng2)
+        replay.append(d2)
+    assert replay == delays
+
+
+def test_close_joins_evictor_and_serve_threads(tmp_path):
+    """Regression: close() while the evictor holds the CV used to leave
+    live threads mutating a half-closed scheduler.  Now close() is
+    idempotent, wakes every loop, and joins them with a timeout."""
+    for i in range(3):
+        sched = Scheduler(initial_workers=["g0", "g1"],
+                          auto_evict_dead_s=0.2, startup_grace_s=0.0,
+                          host_worker_file=str(tmp_path / f"hosts{i}"))
+        # let the evictor run at least one eviction pass
+        deadline = time.time() + 10
+        while sched._workers and time.time() < deadline:
+            time.sleep(0.02)
+        t0 = time.time()
+        sched.close()
+        sched.close()  # idempotent
+        assert time.time() - t0 < 5.0
+        for th in (sched._evict_thread, sched._thread):
+            assert th is not None and not th.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# in-process warm-standby failover
+# ---------------------------------------------------------------------------
+
+def test_warm_standby_failover_preserves_state_and_barriers(tmp_path):
+    obs_trace.set_enabled(True)
+    jp = str(tmp_path / "ctrl.journal")
+    lp = str(tmp_path / "ctrl.lease")
+    # lease_s must leave the primary's renew thread (period lease_s/3)
+    # real slack on a loaded box: a too-tight lease here makes the
+    # standby legitimately depose a merely-starved primary BEFORE the
+    # kill — the protocol working as designed, but not this scenario
+    standby = Scheduler(standby=True, journal_path=jp, lease_path=lp,
+                        lease_s=2.0)
+    primary = Scheduler(initial_workers=["w0", "w1"], journal_path=jp,
+                        lease_path=lp, lease_s=2.0)
+    eps = [("127.0.0.1", primary.port), ("127.0.0.1", standby.port)]
+    cs = []
+    try:
+        assert primary.is_leader() and primary.incarnation == 1
+        assert not standby.is_leader()
+        cs = [_client(primary.port, h, endpoints=eps)
+              for h in ("w0", "w1")]
+        assert cs[0].fence == 1
+
+        # normal operation pre-failover: one barrier + a snapshot
+        t = threading.Thread(target=cs[0].barrier, daemon=True)
+        t.start()
+        cs[1].barrier()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        cs[0].publish_snapshot({"step": 3, "params": [1.0, 2.0]})
+
+        # park w0 at the NEXT barrier on the primary, then kill it
+        done0 = threading.Event()
+
+        def park():
+            cs[0].barrier()
+            done0.set()
+
+        parked = threading.Thread(target=park, daemon=True)
+        parked.start()
+        deadline = time.time() + 30
+        while True:
+            with primary._lock:
+                if "w0" in primary._state.plain_arrived:
+                    break
+            assert time.time() < deadline
+            time.sleep(0.01)
+        primary.close()  # severed connections == the process dying
+
+        # exactly-once across the switch: w0's replayed arrival parks on
+        # the successor — it must NOT clear the barrier before w1 arrives
+        time.sleep(3.0)  # > lease_s: the failover window has passed
+        assert not done0.is_set(), \
+            "parked worker cleared the barrier alone across the failover"
+
+        cs[1].barrier()  # fails over, completes the barrier fleet-wide
+        assert done0.wait(timeout=30)
+
+        assert standby.is_leader()
+        assert standby.incarnation == 2  # fencing epoch bumped
+        assert sorted(standby._workers) == ["w0", "w1"]
+        # journaled snapshot survived the leader
+        assert cs[1].fetch_snapshot() == {"step": 3, "params": [1.0, 2.0]}
+        # exactly one failover span on the successor's timeline
+        spans = [r for r in standby._obs.snapshot()["records"]
+                 if r[0] == "X" and r[2] == "scheduler.failover"]
+        assert len(spans) == 1
+        # the successor's live state is exactly the journal replay
+        assert ControlState.rebuild(jp).struct() == _live_struct(standby)
+    finally:
+        for c in cs:
+            c.close()
+        standby.close()
+        primary.close()
+
+
+def test_stale_incarnation_round_replica_refused(tmp_path):
+    """``ha_round`` fencing: a deposed primary's round replica (stale
+    incarnation) must be refused by the new leader."""
+    jp = str(tmp_path / "ctrl.journal")
+    lease = journal.Lease(str(tmp_path / "ctrl.lease"))
+    lease.acquire("sched:old")          # incarnation 1 (the dead primary)
+    standby = Scheduler(standby=True, journal_path=jp,
+                        lease_path=str(tmp_path / "ctrl.lease"),
+                        lease_s=0.2)
+    try:
+        deadline = time.time() + 30
+        while not standby.is_leader() and time.time() < deadline:
+            time.sleep(0.05)  # lease already stale -> takeover
+        assert standby.is_leader() and standby.incarnation == 2
+        stale = protocol.request(
+            "127.0.0.1", standby.port,
+            {"cmd": "ha_round", "fence": 1, "key": "g", "gen": 5,
+             "seqs": {"w0": 0}, "value": [1.0]}, timeout=10)
+        assert "fenced" in stale.get("error", "")
+        fresh = protocol.request(
+            "127.0.0.1", standby.port,
+            {"cmd": "ha_round", "fence": 2, "key": "g", "gen": 5,
+             "seqs": {"w0": 0}, "value": [1.0]}, timeout=10)
+        assert "error" not in fresh
+    finally:
+        standby.close()

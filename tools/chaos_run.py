@@ -23,10 +23,25 @@ barrier.  Success = every worker (including the restarted one) exits 0,
 final loss is finite, all workers hold bit-identical params, and
 membership converged back to the full host set.
 
+**Scheduler-kill plans (r11 control-plane HA, docs/ha.md):** the
+``scheduler_kill*`` plans run the PRIMARY scheduler as a real process
+(``dt_tpu.elastic.scheduler_main``) with a seeded crash rule that
+``os._exit(137)``s it mid-epoch (``sched.allreduce``), mid-barrier
+(``scheduler_kill_barrier`` → ``sched.barrier_arrived``), or during a
+membership-change application (``scheduler_kill_mc`` →
+``sched.membership_change``), while a warm-standby scheduler runs
+in-process tailing the journal.  Workers carry both endpoints in
+``DT_CTRL_ENDPOINTS`` and fail over transparently.  Success adds: the
+primary died 137, NO worker restarted, the standby leads under a bumped
+incarnation, the timeline shows exactly ONE ``scheduler.failover`` span
+under 10 s, and (via ``--expect-param-hash`` against a ``--plan none``
+run) final params are bit-identical to the kill-free baseline.
+
 Usage::
 
     python tools/chaos_run.py --seed 0 --plan default
     python tools/chaos_run.py --plan none          # fault-free baseline
+    python tools/chaos_run.py --plan scheduler_kill   # HA failover drill
 
 Prints one JSON summary line and exits non-zero on any failed check.
 """
@@ -48,6 +63,29 @@ WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
 HOSTS = ["w0", "w1", "w2"]
 CRASH_HOST = "w2"
 CRASH_EPOCH = 3
+
+#: scheduler-kill sites per HA plan (rule kwargs for the one crash rule
+#: the PRIMARY scheduler process loads via DT_FAULT_PLAN).  The `after`
+#: counts are per (rule, host) streams: w0's ~16 allreduce receipts per
+#: epoch put after=25 mid-epoch-2; w1's 3rd barrier arrival is epoch 2's
+#: barrier; the unqualified membership-change stream ticks once per
+#: applied barrier, so after=2 kills inside epoch 2's application.
+SCHED_KILL_SITES = {
+    "scheduler_kill": dict(site="sched.allreduce", host="w0", after=25),
+    "scheduler_kill_barrier": dict(site="sched.barrier_arrived",
+                                   host="w1", after=2),
+    "scheduler_kill_mc": dict(site="sched.membership_change", after=2),
+}
+
+
+def _await_port_file(path, timeout_s=30.0):
+    # the launcher owns the canonical port-file rendezvous (jax-free at
+    # module level); re-raise its timeout as the CLI's exit contract
+    from dt_tpu.launcher.launch import _await_port_file as _wait
+    try:
+        return _wait(path, timeout=timeout_s)
+    except RuntimeError as e:
+        raise SystemExit(str(e))
 
 
 def _plans(num_epoch):
@@ -78,10 +116,18 @@ def _plans(num_epoch):
         "default": (noise + crash, sched_noise),  # fuzz + crash + recovery
         "crash-only": (crash, []),
     }
+    # scheduler-kill plans: clean worker transport (the fault under test
+    # is the CONTROL PLANE dying, and bit-identity vs --plan none is an
+    # acceptance gate — worker noise would shrink membership and change
+    # the trajectory); the crash rule ships to the primary scheduler
+    # process, not to workers
+    for name in SCHED_KILL_SITES:
+        plans[name] = ([], [])
     return plans
 
 
-def _spawn(port, host, out, num_epoch, plan_json, recovery=False):
+def _spawn(port, host, out, num_epoch, plan_json, recovery=False,
+           extra_env=None):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["ELASTIC_TRAINING_ENABLED"] = "1"
@@ -91,6 +137,7 @@ def _spawn(port, host, out, num_epoch, plan_json, recovery=False):
         env.pop("DT_FAULT_PLAN", None)
     if recovery:
         env["DT_RECOVERY"] = "1"
+    env.update(extra_env or {})
     # log to a file, not a PIPE: nothing drains the pipe while workers
     # run, so a chatty worker would wedge on pipe backpressure — and the
     # full log (not a 2000-byte tail) survives for post-mortems
@@ -107,7 +154,8 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--plan", default="default",
-                    choices=["default", "noise", "crash-only", "none"])
+                    choices=["default", "noise", "crash-only", "none"]
+                    + sorted(SCHED_KILL_SITES))
     ap.add_argument("--num-epoch", type=int, default=8)
     ap.add_argument("--timeout-s", type=float, default=1200.0)
     ap.add_argument("--trace", default="",
@@ -127,13 +175,16 @@ def main():
                          "for some rounds, in both modes, by design)")
     args = ap.parse_args()
 
-    if args.trace:
+    ha_plan = args.plan in SCHED_KILL_SITES
+    if args.trace or ha_plan:
         # before any dt_tpu.obs use: the scheduler reads it in-process,
-        # workers inherit it through _spawn's env copy
+        # workers inherit it through _spawn's env copy.  The HA plans
+        # always trace: the scheduler.failover span IS an acceptance
+        # check, with or without --trace
         os.environ["DT_OBS"] = "1"
 
     from dt_tpu.elastic import Scheduler, faults
-    from dt_tpu.elastic.faults import FaultPlan
+    from dt_tpu.elastic.faults import FaultPlan, FaultRule
 
     worker_rules, sched_rules = _plans(args.num_epoch)[args.plan]
     worker_plan = FaultPlan(worker_rules, seed=args.seed)
@@ -150,9 +201,47 @@ def main():
     with open(hw, "w") as f:
         f.write("\n".join(HOSTS) + "\n")
     outs = {h: os.path.join(tmp, f"{h}.json") for h in HOSTS}
-    sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=30.0)
-    procs = {h: _spawn(sched.port, h, outs[h], args.num_epoch,
-                       worker_plan.to_json() if worker_rules else "")
+    primary_proc = None
+    worker_extra = {}
+    if ha_plan:
+        # HA topology: warm standby IN-PROCESS (it survives the kill and
+        # is what the final checks interrogate), primary as a REAL
+        # process carrying the seeded crash rule — its death is an
+        # os._exit(137), indistinguishable from SIGKILL
+        journal = os.path.join(tmp, "ctrl.journal")
+        lease = os.path.join(tmp, "ctrl.lease")
+        sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=30.0,
+                          standby=True, journal_path=journal,
+                          lease_path=lease)
+        kill_plan = FaultPlan(
+            [FaultRule("crash", action="exit",
+                       **SCHED_KILL_SITES[args.plan])], seed=args.seed)
+        sched_env = dict(os.environ)
+        sched_env.pop("XLA_FLAGS", None)
+        sched_env["DT_FAULT_PLAN"] = kill_plan.to_json()
+        port_file = os.path.join(tmp, "primary.port")
+        sched_log = open(os.path.join(tmp, "scheduler.log"), "w")
+        primary_proc = subprocess.Popen(
+            [sys.executable, "-m", "dt_tpu.elastic.scheduler_main",
+             "--host-worker-file", hw, "--journal", journal,
+             "--lease", lease, "--peer", f"127.0.0.1:{sched.port}",
+             "--port-file", port_file, "--auto-evict-dead-s", "30"],
+            env=sched_env, stdout=sched_log, stderr=subprocess.STDOUT)
+        spawn_port = _await_port_file(port_file)
+        worker_extra = {"DT_CTRL_ENDPOINTS":
+                        f"127.0.0.1:{spawn_port},127.0.0.1:{sched.port}"}
+    else:
+        # every plan journals the control state (r11): the final check
+        # asserts ControlState.rebuild(journal) == the live state, so
+        # deterministic replay is exercised under EVERY seeded fault
+        # plan, not just the scheduler-kill ones
+        journal = os.path.join(tmp, "ctrl.journal")
+        sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=30.0,
+                          journal_path=journal)
+        spawn_port = sched.port
+    procs = {h: _spawn(spawn_port, h, outs[h], args.num_epoch,
+                       worker_plan.to_json() if worker_rules else "",
+                       extra_env=worker_extra)
              for h in HOSTS}
     expect_crash = any(r.kind == "crash" for r in worker_rules)
     restarted = False
@@ -172,9 +261,9 @@ def main():
                     print(f"# {h} crashed (rc={rc}) as planned; quick "
                           "restart with DT_RECOVERY=1", file=sys.stderr)
                     procs[h] = _spawn(
-                        sched.port, h, outs[h], args.num_epoch,
+                        spawn_port, h, outs[h], args.num_epoch,
                         restart_plan.to_json() if restart_plan.rules
-                        else "", recovery=True)
+                        else "", recovery=True, extra_env=worker_extra)
                     pending[h] = procs[h]
                     restarted = True
                 elif rc != 0:
@@ -224,10 +313,40 @@ def main():
         # the r7 pooled transport: every worker multiplexes its requests
         # over a handful of persistent channels, so the scheduler serves
         # far more requests than it accepts connections (per-request
-        # connections would make these counts track 1:1)
+        # connections would make these counts track 1:1).  On the HA
+        # plans `sched` is the standby: only post-failover traffic, but
+        # several epochs of it — the ratio holds there too.
         tstats = sched.transport_stats()
         checks["pooled_connections"] = \
             tstats["requests"] > 2 * tstats["connections"]
+
+        # deterministic replay: a fresh ControlState rebuilt from the
+        # journal must equal the live scheduler state, whatever the
+        # fault plan did (the HA design's core contract, docs/ha.md)
+        from dt_tpu.elastic import journal as ctrl_journal
+        with sched._lock:
+            live_struct = sched._state.struct()
+            rebuilt = ctrl_journal.ControlState.rebuild(journal).struct()
+        checks["journal_replay_matches"] = rebuilt == live_struct
+
+        failover_ms = None
+        if ha_plan:
+            # the primary really died by the injected exit, nobody was
+            # restarted, and the standby leads under a bumped fencing
+            # incarnation
+            checks["scheduler_killed"] = primary_proc.poll() == 137
+            checks["no_worker_restarts"] = not restarted
+            checks["standby_took_over"] = \
+                sched.is_leader() and sched.incarnation >= 2
+            # exactly ONE scheduler.failover span, bounded under 10 s
+            # (dt_tpu/obs/trace.py record schema: dur_us at index 4)
+            spans = [r for r in sched._obs.snapshot()["records"]
+                     if r[0] == "X" and r[2] == "scheduler.failover"]
+            checks["failover_spans"] = len(spans) == 1
+            if spans:
+                failover_ms = spans[0][4] / 1000.0
+            checks["failover_under_10s"] = \
+                failover_ms is not None and failover_ms < 10_000.0
 
         summary = None
         pipeline_buckets = None
@@ -298,6 +417,8 @@ def main():
             "ok": ok, "plan": args.plan, "seed": args.seed,
             "num_epoch": args.num_epoch, "checks": checks,
             "param_hash": param_hash,
+            "failover_ms": failover_ms if ha_plan else None,
+            "leader_incarnation": sched.incarnation if ha_plan else None,
             "pipeline_buckets":
                 pipeline_buckets if summary else None,
             "transport": tstats,
@@ -318,7 +439,10 @@ def main():
     finally:
         sched.close()
         faults.clear()
-        for p in procs.values():
+        hangers = list(procs.values())
+        if primary_proc is not None:
+            hangers.append(primary_proc)
+        for p in hangers:
             if p.poll() is None:
                 p.kill()
 

@@ -117,6 +117,22 @@ def render(summary) -> str:
         lines.append(
             f"  epoch {m.get('epoch')}: removed={m.get('removed')} "
             f"added={m.get('added')} recovered={m.get('recovered')}")
+    # control-plane HA (docs/ha.md): leader-incarnation timeline and any
+    # scheduler.failover spans (standby takeover: duration = the stall
+    # bound the chaos harness gates at < 10 s)
+    lead = summary.get("leadership", [])
+    fo = summary.get("failovers", [])
+    if lead or fo:
+        lines.append("")
+        lines.append(f"leadership (incarnation timeline): "
+                     f"{len(fo)} failover(s)")
+        for e in lead:
+            lines.append(f"  inc {e.get('incarnation')}: {e.get('what')} "
+                         f"on {e.get('track')} ({e.get('reason', '-')})")
+        for f in fo:
+            lines.append(f"  failover -> inc {f.get('incarnation')}: "
+                         f"{f['dur_ms']:.1f} ms, {f.get('workers')} "
+                         f"worker(s) resumed ({f.get('reason', '-')})")
     return "\n".join(lines)
 
 
