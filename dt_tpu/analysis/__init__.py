@@ -11,6 +11,16 @@ are TPU-shaped, so they get a bespoke rule engine instead:
 - DT005 env-registry     — DT_*/JAX_* reads vs config.ENV_REGISTRY
 - DT006 lock-discipline  — ``# guarded-by:`` annotations in elastic/*
 - DT007 parity-citation  — module docstrings cite reference file:line
+- DT008 race-inference   — flow-sensitive lock-set race detection
+- DT009 lock-order       — acquisition-graph cycles, blocking under lock
+- DT010 journal-discipline — ControlState mutations ride the WAL path
+
+DT008-DT010 (``rules_flow`` over the ``flow`` substrate) are
+flow-sensitive: they track held-lock sets through ``with`` blocks and
+same-class call edges — the RacerD-style complement to DT006's
+syntactic annotation check (reference gap: the ``van.cc`` receiver
+thread / ``postoffice.h`` mutexes were guarded by ``make cpplint``
+alone, ``Makefile:140-160``).
 
 CLI: ``python tools/dtlint.py``; engine: :func:`dt_tpu.analysis.engine.run`;
 rule catalog with examples: ``docs/dtlint_rules.md``.  Stdlib-only — the
@@ -25,11 +35,12 @@ from dt_tpu.analysis.engine import (Baseline, FileContext, Finding,
 
 def all_rules() -> List[Rule]:
     """One fresh instance of every registered rule, id order."""
-    from dt_tpu.analysis import rules_project, rules_tpu
+    from dt_tpu.analysis import rules_flow, rules_project, rules_tpu
     rules = [rules_tpu.PallasTiling(), rules_tpu.Bf16Downcast(),
              rules_tpu.CpuDonate(), rules_tpu.PartialBlock(),
              rules_project.EnvRegistry(), rules_project.LockDiscipline(),
-             rules_project.ParityCitation()]
+             rules_project.ParityCitation(), rules_flow.RaceInference(),
+             rules_flow.LockOrder(), rules_flow.JournalDiscipline()]
     return sorted(rules, key=lambda r: r.id)
 
 

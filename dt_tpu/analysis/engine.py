@@ -251,11 +251,15 @@ class Baseline:
 
 def run(root: str, paths: Optional[Sequence[str]] = None,
         rules: Optional[Sequence[Rule]] = None,
-        select: Optional[Set[str]] = None) -> List[Finding]:
+        select: Optional[Set[str]] = None,
+        timings: Optional[Dict[str, float]] = None) -> List[Finding]:
     """Lint ``paths`` under ``root`` with ``rules``; returns ALL findings
     (pre-baseline), sorted (path, line, rule) — deterministic across
     runs.  Suppressed lines are dropped here; baseline filtering is the
-    caller's (so `--write-baseline` sees the full set)."""
+    caller's (so `--write-baseline` sees the full set).  ``timings``,
+    when given, is filled with cumulative per-rule wall milliseconds
+    (``check_file`` + ``finalize`` — the ``--json`` CLI reports it)."""
+    import time as _time
     from dt_tpu.analysis import all_rules
     paths = list(paths if paths is not None else DEFAULT_PATHS)
     active = [r for r in (rules if rules is not None else all_rules())
@@ -263,6 +267,16 @@ def run(root: str, paths: Optional[Sequence[str]] = None,
     project = ProjectContext(root, paths)
     findings: List[Finding] = []
     contexts: Dict[str, FileContext] = {}
+
+    def timed(rule: Rule, it: Iterable[Finding]) -> List[Finding]:
+        if timings is None:
+            return list(it)
+        t0 = _time.perf_counter()
+        out = list(it)
+        timings[rule.id] = timings.get(rule.id, 0.0) + \
+            (_time.perf_counter() - t0) * 1e3
+        return out
+
     for rel in iter_python_files(root, paths):
         try:
             with open(os.path.join(root, rel), encoding="utf-8") as f:
@@ -277,11 +291,11 @@ def run(root: str, paths: Optional[Sequence[str]] = None,
         for rule in active:
             if not rule.applies_to(ctx.path):
                 continue
-            for f in rule.check_file(ctx, project):
+            for f in timed(rule, rule.check_file(ctx, project)):
                 if not ctx.suppressed(f.line, f.rule):
                     findings.append(f)
     for rule in active:
-        for f in rule.finalize(project):
+        for f in timed(rule, rule.finalize(project)):
             # finalize findings honor suppressions too, when they anchor
             # to a file this run parsed (e.g. a registry line in
             # config.py); non-Python anchors like PARITY.md have no

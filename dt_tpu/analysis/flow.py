@@ -1,0 +1,506 @@
+"""dtflow — flow-sensitive concurrency models for DT008-DT010.
+
+The reference guarded its concurrency-heavy core — the ``van.cc``
+receiver thread, the ``postoffice.h`` barrier/heartbeat mutexes — with
+nothing stronger than ``make cpplint`` (reference ``Makefile:140-160``);
+dt_tpu's control plane grew ~25 locks across scheduler/client/dataplane/
+protocol/overlap and the syntactic DT006 rule can only check what a
+human remembered to annotate.  This module is the flow-sensitive
+substrate underneath :mod:`dt_tpu.analysis.rules_flow`, in the RacerD
+tradition of compositional lock-set analysis (Blackshear et al.,
+*RacerD: Compositional Static Race Detection*):
+
+- :class:`ClassModel`: per-class inventory — owned locks (with
+  ``Condition(self._lock)`` alias unification), shared attributes and
+  their ``__init__`` definition sites, existing ``# guarded-by:``
+  annotations, known-thread-safe attributes, and **thread roots**
+  (``threading.Thread(target=self._m)``, executor ``submit``/``map``,
+  and any method passed bare as a callback — ``serve_connection``
+  handlers, flush hooks, ``WeakMethod``) plus the implicit ``caller``
+  root covering the public API surface.
+- :func:`analyze_method`: one method body under an entry held-lock set —
+  tracks ``with self.<lock>:`` blocks (flow-sensitive, aliases
+  canonicalized), resets the held set inside nested ``def``/``lambda``
+  (a closure runs later, lock released), records every ``self.<attr>``
+  access as read / rebind-store / mutation, every same-class call edge
+  with the held set at the call site, every lock-acquisition edge (lock
+  B entered while A held — the DT009 graph), and blocking calls under a
+  held lock (``protocol.request``/``_req*``, unbounded ``join``/
+  ``wait`` — the PR 6 close-vs-evictor family).
+- :func:`collect_accesses` / :func:`collect_edges`: worklist propagation
+  over the same-class call graph, so ``*_locked`` / "Caller holds the
+  lock." helpers inherit the locks their real call sites hold instead
+  of being skipped the way the syntactic DT006 must.
+
+Pure stdlib ``ast`` — imports without jax, like the rest of the engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+#: lock-like constructors: entering ``with self.x`` where x was assigned
+#: one of these means x guards the block
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTOR = "Condition"
+
+#: constructors whose objects serialize internally — an attribute bound
+#: to one of these in __init__ and never rebound is thread-safe to share
+_SAFE_CTORS = {"Event", "Queue", "LifoQueue", "PriorityQueue",
+               "SimpleQueue", "Semaphore", "BoundedSemaphore", "Barrier",
+               "ThreadPoolExecutor", "ProcessPoolExecutor", "ContextVar",
+               "socket"}
+
+#: method names that mutate the receiver container in place — a call
+#: ``self.x.append(...)`` is a WRITE on x when x is container-typed;
+#: anything else (``self._tokens.put(...)``, ``self._journal.append``
+#: on a non-container object) only reads the binding
+_MUTATORS = {"append", "appendleft", "add", "pop", "popleft", "popitem",
+             "update", "remove", "discard", "extend", "extendleft",
+             "clear", "insert", "setdefault", "move_to_end", "sort",
+             "reverse"}
+
+#: constructors that build plain containers (mutator-method calls on
+#: attributes assigned one of these count as writes)
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "OrderedDict",
+                    "defaultdict", "Counter"}
+
+#: call names that block on the network / another thread — flagged by
+#: DT009 when made under a held lock
+_REQUEST_NAMES = {"request", "_req", "_req_addr", "_req_failover"}
+
+_GUARDED_RE = re.compile(
+    r"self\.(\w+)\b[^#]*#.*?guarded-by:\s*([\w,\s]+)")
+
+
+def _attr_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.<x>`` -> x (else None)."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _value_exprs(value: Optional[ast.AST]) -> List[ast.AST]:
+    """The possible runtime values of an assignment RHS, looking through
+    one conditional (``X(...) if cond else None`` assigns an X)."""
+    if value is None:
+        return []
+    if isinstance(value, ast.IfExp):
+        return [value.body, value.orelse]
+    return [value]
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    attr: str
+    kind: str            # "r" read | "ws" rebind store | "wm" mutation
+    line: int
+    held: FrozenSet[str]  # canonical lock names held at the site
+    root: str            # "caller" or "thread:<method>"
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind != "r"
+
+
+@dataclasses.dataclass(frozen=True)
+class Blocking:
+    desc: str
+    line: int
+    held: FrozenSet[str]
+
+
+class ClassModel:
+    """Concurrency-relevant inventory of one class definition."""
+
+    def __init__(self, cls: ast.ClassDef, lines: List[str]):
+        self.node = cls
+        self.name = cls.name
+        self.methods: Dict[str, ast.AST] = {
+            m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        #: property-like methods: a bare ``self.x`` READ of one of these
+        #: runs its body inline on the current thread — a call edge, not
+        #: a callback registration
+        self.properties: Set[str] = {
+            m.name for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and any(_attr_name(d) in ("property", "cached_property",
+                                      "setter", "getter", "deleter")
+                    for d in m.decorator_list)}
+        self.locks: Set[str] = set()
+        self._cond_of: Dict[str, Optional[str]] = {}  # cond attr -> arg
+        self.attrs: Dict[str, int] = {}      # attr -> first def line
+        self.init_line: Dict[str, int] = {}  # attr -> __init__ assign line
+        self.containers: Set[str] = set()    # attrs holding plain containers
+        self._safe_ctor: Set[str] = set()
+        self._rebound_later: Set[str] = set()
+        self.guarded: Set[str] = self._annotations(cls, lines)
+        #: memo for :func:`analyze_method` — one (method, entry-held)
+        #: context is re-reached from several roots and again by the
+        #: DT009 all-methods pass; the analysis is a pure function of
+        #: the pair, so recomputing it only re-walks the same AST
+        self._method_memo: Dict[Tuple[str, FrozenSet[str]], tuple] = {}
+        self._scan(cls)
+        self.canon: Dict[str, str] = self._canonicalize()
+        self.bg_roots: Dict[str, str] = self._find_bg_roots(cls)
+        self.caller_entries: List[str] = sorted(
+            m for m in self.methods
+            if (not m.startswith("_")) or
+            (m.startswith("__") and m.endswith("__") and m != "__init__"))
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def _annotations(cls: ast.ClassDef, lines: List[str]) -> Set[str]:
+        out: Set[str] = set()
+        end = cls.end_lineno or cls.lineno
+        for lineno in range(cls.lineno, min(end, len(lines)) + 1):
+            m = _GUARDED_RE.search(lines[lineno - 1])
+            if m:
+                out.add(m.group(1))
+        return out
+
+    def _scan(self, cls: ast.ClassDef) -> None:
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            in_init = meth.name == "__init__"
+            for node in ast.walk(meth):
+                targets: List[ast.AST] = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], None
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    self.attrs.setdefault(attr, t.lineno)
+                    if in_init:
+                        self.init_line.setdefault(attr, t.lineno)
+                    else:
+                        self._rebound_later.add(attr)
+                    for v in _value_exprs(value):
+                        if isinstance(v, (ast.Dict, ast.List, ast.Set,
+                                          ast.ListComp, ast.SetComp,
+                                          ast.DictComp)):
+                            self.containers.add(attr)
+                        if not isinstance(v, ast.Call):
+                            continue
+                        ctor = _attr_name(v.func)
+                        if ctor in _LOCK_CTORS:
+                            self.locks.add(attr)
+                        elif ctor == _COND_CTOR:
+                            self.locks.add(attr)
+                            arg = v.args[0] if v.args else None
+                            self._cond_of[attr] = _self_attr(arg) \
+                                if arg is not None else None
+                        elif ctor in _CONTAINER_CTORS:
+                            self.containers.add(attr)
+                        elif ctor in _SAFE_CTORS and in_init:
+                            self._safe_ctor.add(attr)
+        # a Condition's underlying lock is a lock even if its own ctor
+        # wasn't seen (constructed elsewhere / passed in)
+        for arg in self._cond_of.values():
+            if arg:
+                self.locks.add(arg)
+
+    def _canonicalize(self) -> Dict[str, str]:
+        """Alias map: every lock name -> one representative, preferring
+        the Condition's UNDERLYING lock (``Condition(self._lock)`` makes
+        ``_cv`` and ``_lock`` the same guard, reported as ``_lock``)."""
+        parent = {l: l for l in self.locks}
+
+        def find(x: str) -> str:
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        for cond, arg in self._cond_of.items():
+            if arg and arg in parent and cond in parent:
+                parent[find(cond)] = find(arg)
+        # prefer a non-Condition representative inside each group
+        groups: Dict[str, List[str]] = {}
+        for l in self.locks:
+            groups.setdefault(find(l), []).append(l)
+        canon: Dict[str, str] = {}
+        for members in groups.values():
+            plain = sorted(m for m in members if m not in self._cond_of)
+            rep = plain[0] if plain else sorted(members)[0]
+            for m in members:
+                canon[m] = rep
+        return canon
+
+    def _find_bg_roots(self, cls: ast.ClassDef) -> Dict[str, str]:
+        """Methods that run on another thread: ``Thread(target=self.m)``,
+        ``pool.submit(self.m)``/``map``, or ``self.m`` passed bare to any
+        call (callback registration — ``serve_connection``, flush hooks,
+        ``WeakMethod``)."""
+        roots: Dict[str, str] = {}
+        parents = {c: p for p in ast.walk(cls)
+                   for c in ast.iter_child_nodes(p)}
+        for node in ast.walk(cls):
+            attr = _self_attr(node)
+            if attr is None or attr not in self.methods or \
+                    attr in self.properties or \
+                    not isinstance(node.ctx, ast.Load):
+                continue
+            p = parents.get(node)
+            if isinstance(p, ast.Call) and p.func is node:
+                continue  # invocation, not a reference
+            roots.setdefault(attr, "callback")
+        return roots
+
+    # -- queries -----------------------------------------------------------
+
+    def canon_set(self, names: Iterable[str]) -> FrozenSet[str]:
+        return frozenset(self.canon.get(n, n) for n in names)
+
+    def safe_attr(self, attr: str) -> bool:
+        return attr in self._safe_ctor and attr not in self._rebound_later
+
+    def is_threaded(self) -> bool:
+        """≥ 1 background root plus at least one more root (another
+        background root, or a public API surface for the caller)."""
+        if not self.bg_roots:
+            return False
+        return len(self.bg_roots) + (1 if self.caller_entries else 0) >= 2
+
+
+def _parent_map(meth: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {c: p for p in ast.walk(meth) for c in ast.iter_child_nodes(p)}
+
+
+def _access_kind(node: ast.Attribute,
+                 parents: Dict[ast.AST, ast.AST],
+                 mutator_calls: bool = True) -> str:
+    """Classify one ``self.x`` occurrence: plain rebind ("ws"),
+    in-place mutation ("wm": subscript/attr store, mutator call, del,
+    augassign), or read ("r").  ``mutator_calls=False`` treats
+    ``.append()``-style calls as reads (the receiver is not
+    container-typed — e.g. ``JournalWriter.append``)."""
+    p = parents.get(node)
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        if isinstance(node.ctx, ast.Store) and isinstance(p, ast.Assign):
+            return "ws"
+        if isinstance(node.ctx, ast.Store) and \
+                isinstance(p, ast.AnnAssign):
+            return "ws"
+        return "wm"  # del self.x / augassign / tuple-unpack target
+    # walk up a subscript/attribute chain: self.x[a][b] = v stores on
+    # the OUTERMOST subscript; the inner nodes are Loads
+    cur: ast.AST = node
+    while True:
+        p = parents.get(cur)
+        if isinstance(p, ast.Subscript) and p.value is cur:
+            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                return "wm"
+            cur = p
+            continue
+        break
+    p = parents.get(node)
+    if isinstance(p, ast.Attribute) and p.value is node:
+        if isinstance(p.ctx, (ast.Store, ast.Del)):
+            return "wm"
+        gp = parents.get(p)
+        if mutator_calls and isinstance(gp, ast.Call) and \
+                gp.func is p and p.attr in _MUTATORS:
+            return "wm"
+    return "r"
+
+
+def _call_timeout_bounded(call: ast.Call) -> bool:
+    """True when the call carries a non-None timeout (positional arg or
+    ``timeout=`` kwarg) — a bounded block is not a deadlock hazard.
+    ``wait(None)`` / ``join(None)`` are the unbounded park spelled
+    positionally."""
+    if call.args:
+        a = call.args[0]
+        return not (isinstance(a, ast.Constant) and a.value is None)
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
+
+
+def analyze_method(model: ClassModel, meth: ast.AST,
+                   entry_held: FrozenSet[str]):
+    """-> (accesses, calls, edges, blocking) for one method body entered
+    with ``entry_held`` (canonical names).  ``accesses`` are
+    ``(attr, kind, line, held)`` tuples (root attached by the caller);
+    ``calls`` are ``(method_name, held, line)`` same-class call edges;
+    ``edges`` are ``(held_lock, acquired_lock, line)`` acquisition
+    pairs; ``blocking`` are :class:`Blocking` sites.  Memoized per
+    (method, entry-held) on the model — callers must not mutate the
+    returned lists."""
+    memo_key = (getattr(meth, "name", ""), entry_held)
+    cached = model._method_memo.get(memo_key)
+    if cached is not None:
+        return cached
+    parents = _parent_map(meth)
+    accesses: List[Tuple[str, str, int, FrozenSet[str]]] = []
+    calls: List[Tuple[str, FrozenSet[str], int]] = []
+    edges: List[Tuple[str, str, int]] = []
+    blocking: List[Blocking] = []
+
+    def check_blocking(node: ast.Call, held: FrozenSet[str]) -> None:
+        if not held:
+            return
+        fn = _attr_name(node.func)
+        target = node.func.value \
+            if isinstance(node.func, ast.Attribute) else None
+        if fn in _REQUEST_NAMES:
+            # a wire request under a held lock: every other thread
+            # needing the lock now waits on the network
+            blocking.append(Blocking(
+                f"network request '{fn}(...)'", node.lineno, held))
+            return
+        if fn == "join":
+            # zero args or a positional None — a thread join, never the
+            # one-positional-iterable str.join
+            joinish = not node.args or (
+                len(node.args) == 1 and
+                isinstance(node.args[0], ast.Constant) and
+                node.args[0].value is None)
+            if joinish and not _call_timeout_bounded(node):
+                blocking.append(Blocking(
+                    "unbounded 'join()'", node.lineno, held))
+            return
+        if fn == "wait" and not _call_timeout_bounded(node):
+            # Condition.wait releases ITS OWN lock while parked; any
+            # OTHER held lock stays blocked for the full unbounded wait
+            waited = _self_attr(target) if target is not None else None
+            eff = held - ({model.canon.get(waited, waited)}
+                          if waited else set())
+            if eff:
+                blocking.append(Blocking(
+                    "unbounded 'wait()' while holding "
+                    + "/".join(sorted(eff)), node.lineno, eff))
+
+    def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, ast.With):
+            cur = held
+            for item in node.items:
+                visit(item.context_expr, cur)
+                attr = _self_attr(item.context_expr)
+                if attr in model.locks:
+                    lock = model.canon.get(attr, attr)
+                    for h in sorted(cur):
+                        if h != lock:
+                            edges.append((h, lock, item.context_expr
+                                          .lineno))
+                    cur = cur | {lock}
+            for child in node.body:
+                visit(child, cur)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure runs LATER: definition-time locks are not held
+            for child in ast.iter_child_nodes(node):
+                visit(child, frozenset())
+            return
+        if isinstance(node, ast.Call):
+            check_blocking(node, held)
+            callee = _self_attr(node.func)
+            if callee in model.methods:
+                calls.append((callee, held, node.lineno))
+        attr = _self_attr(node)
+        if attr is not None:
+            if attr in model.properties and \
+                    isinstance(node.ctx, ast.Load):
+                # a property read runs its body inline, here, with the
+                # current held set — a call edge on this thread
+                calls.append((attr, held, node.lineno))
+            if attr in model.attrs:
+                accesses.append((attr,
+                                 _access_kind(node, parents,
+                                              attr in model.containers),
+                                 node.lineno, held))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for child in ast.iter_child_nodes(meth):
+        visit(child, entry_held)
+    out = (accesses, calls, edges, blocking)
+    model._method_memo[memo_key] = out
+    return out
+
+
+def _propagate(model: ClassModel, entries: List[str], root: str,
+               accesses_out: List[Access],
+               edges_out: List[Tuple[str, str, int]],
+               blocking_out: List[Blocking]) -> None:
+    """Worklist over (method, held) contexts reachable from ``entries``,
+    following same-class call edges so caller-locked helpers inherit
+    their call sites' locks."""
+    seen: Set[Tuple[str, FrozenSet[str]]] = set()
+    work: List[Tuple[str, FrozenSet[str]]] = [
+        (m, frozenset()) for m in entries if m in model.methods]
+    while work:
+        name, held = work.pop()
+        if (name, held) in seen or name == "__init__":
+            continue
+        seen.add((name, held))
+        acc, calls, edges, blocking = analyze_method(
+            model, model.methods[name], held)
+        for attr, kind, line, h in acc:
+            accesses_out.append(Access(attr, kind, line, h, root))
+        edges_out.extend(edges)
+        blocking_out.extend(blocking)
+        for callee, h, _line in calls:
+            work.append((callee, h))
+
+
+def collect_accesses(model: ClassModel
+                     ) -> Tuple[List[Access],
+                                List[Tuple[str, str, int]],
+                                List[Blocking]]:
+    """All attribute accesses reachable from the class's thread roots
+    (plus the caller root over the public API), each tagged with its
+    root and held-lock set.  ``__init__`` is construction — excluded."""
+    accesses: List[Access] = []
+    edges: List[Tuple[str, str, int]] = []
+    blocking: List[Blocking] = []
+    for m in sorted(model.bg_roots):
+        _propagate(model, [m], f"thread:{m}", accesses, edges, blocking)
+    if model.caller_entries:
+        _propagate(model, model.caller_entries, "caller",
+                   accesses, edges, blocking)
+    return accesses, edges, blocking
+
+
+def collect_edges(model: ClassModel
+                  ) -> Tuple[List[Tuple[str, str, int]], List[Blocking]]:
+    """Acquisition edges + blocking sites from EVERY method as an entry
+    (reachability from a thread root is irrelevant for lock ordering —
+    any caller creates the order)."""
+    edges: List[Tuple[str, str, int]] = []
+    blocking: List[Blocking] = []
+    acc: List[Access] = []
+    _propagate(model, [m for m in model.methods if m != "__init__"],
+               "any", acc, edges, blocking)
+    return edges, blocking
+
+
+def build_class_models(tree: ast.AST, lines: List[str]) -> List[ClassModel]:
+    return [ClassModel(node, lines) for node in ast.walk(tree)
+            if isinstance(node, ast.ClassDef)]

@@ -1,0 +1,402 @@
+"""Flow-sensitive concurrency rules (DT008-DT010): lock-set race
+inference, lock-order / blocking-while-locked analysis, and the
+ControlState journal discipline.
+
+The reference left its threaded core unchecked — the ``van.cc:256-315``
+receiver thread and the ``postoffice.h`` barrier mutexes were guarded by
+``make cpplint`` (``Makefile:140-160``) and code review only.  These
+rules machine-check the two bug families that dominated PR 6's review
+hardening (the evict-loop Fenced death, the close-vs-evictor block):
+
+- **DT008** infers races RacerD-style (lock-set analysis per thread
+  root) and emits the ``# guarded-by:`` annotation DT006 then pins;
+- **DT009** builds the lock acquisition graph and flags order cycles
+  plus blocking calls under a held lock;
+- **DT010** pins the WAL discipline of ``docs/ha.md``: every
+  ``ControlState`` mutation flows through the journaled apply path.
+
+Flow machinery lives in :mod:`dt_tpu.analysis.flow`.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from dt_tpu.analysis import flow
+from dt_tpu.analysis.engine import (FileContext, Finding, ProjectContext,
+                                    Rule)
+
+
+def _models_for(ctx: FileContext,
+                project: ProjectContext) -> List[flow.ClassModel]:
+    """Per-file :class:`flow.ClassModel` list, built once and shared by
+    DT008/DT009/DT010 (the model scan dominates the flow rules' cost)."""
+    cache = project.data.setdefault("flow_models", {})
+    if ctx.path not in cache:
+        cache[ctx.path] = flow.build_class_models(ctx.tree, ctx.lines) \
+            if "class " in ctx.source else []
+    return cache[ctx.path]
+
+
+# ---------------------------------------------------------------------------
+# DT008 — lock-set race inference
+# ---------------------------------------------------------------------------
+
+
+def _race_for_attr(model: flow.ClassModel, attr: str,
+                   accs: List[flow.Access]) -> Optional[dict]:
+    """The DT008 decision for one shared attribute; None when safe.
+
+    Reported only when ALL hold: some write outside ``__init__``;
+    accesses from ≥ 2 distinct roots; no lock common to every access;
+    and either the attr is locked *somewhere* (inconsistent locking) or
+    a write happens on a background root.  Exemption: the locked-rebind
+    publication idiom — every write is a plain rebind under one common
+    lock and only reads are bare (reference assignment is atomic in
+    CPython; flagged again the moment any write site drops the lock)."""
+    writes = [a for a in accs if a.is_write]
+    if not writes:
+        return None
+    roots = {a.root for a in accs}
+    if len(roots) < 2:
+        return None
+    common = frozenset.intersection(*[a.held for a in accs])
+    if common:
+        return None
+    wcommon = frozenset.intersection(*[w.held for w in writes])
+    if wcommon and all(w.kind == "ws" for w in writes):
+        return None  # locked-rebind publication
+    ever_locked = any(a.held for a in accs)
+    bg_write = any(w.root != "caller" for w in writes)
+    if not (ever_locked or bg_write):
+        return None
+    counts = collections.Counter(
+        l for a in accs for l in a.held)
+    if counts:
+        top = max(counts.values())
+        lock = sorted(k for k, v in counts.items() if v == top)[0]
+    elif model.locks:
+        lock = sorted({model.canon.get(l, l) for l in model.locks})[0]
+    else:
+        lock = None  # the class owns no lock to suggest
+    bare = [a for a in accs if lock not in a.held]
+    site = min([a for a in bare if a.is_write] or bare or accs,
+               key=lambda a: (a.line, a.kind))
+    return {"attr": attr, "lock": lock, "line": site.line,
+            "roots": sorted(roots),
+            "init_line": model.init_line.get(
+                attr, model.attrs.get(attr, site.line))}
+
+
+def class_races(model: flow.ClassModel) -> List[dict]:
+    """All DT008 race reports for one class (shared by the rule and the
+    ``--fix-annotations`` suggestion collector)."""
+    if not model.is_threaded():
+        return []
+    accesses, _edges, _blocking = flow.collect_accesses(model)
+    by_attr: Dict[str, List[flow.Access]] = {}
+    for a in accesses:
+        if a.attr in model.guarded or a.attr in model.locks or \
+                model.safe_attr(a.attr):
+            continue
+        by_attr.setdefault(a.attr, []).append(a)
+    out = []
+    for attr in sorted(by_attr):
+        r = _race_for_attr(model, attr, by_attr[attr])
+        if r is not None:
+            r["cls"] = model.name
+            out.append(r)
+    return out
+
+
+class RaceInference(Rule):
+    """DT008: a shared attribute written after ``__init__`` and reached
+    from ≥ 2 thread roots with no common lock is a data race; the
+    finding names the lock to annotate so DT006 pins it from then on."""
+
+    id = "DT008"
+    name = "race-inference"
+    hint = ("annotate the attribute's __init__ assignment with "
+            "'# guarded-by: <lock>' and take that lock at the flagged "
+            "site (or confine the attribute to one thread)")
+
+    def check_file(self, ctx: FileContext,
+                   project: ProjectContext) -> Iterable[Finding]:
+        for model in _models_for(ctx, project):
+            for r in class_races(model):
+                fix = (f"suggest '# guarded-by: {r['lock']}'"
+                       if r["lock"] is not None else
+                       "the class owns no lock — add one and annotate")
+                yield ctx.finding(
+                    self, r["line"],
+                    f"possible data race: '{r['cls']}.{r['attr']}' is "
+                    f"reached from {', '.join(r['roots'])} with no "
+                    f"common lock; {fix}")
+
+
+def collect_suggestions(root: str, paths: Optional[Sequence[str]] = None,
+                        baseline_keys=None) -> List[dict]:
+    """(path, init_line, attr, lock) annotation suggestions for
+    ``tools/dtlint.py --fix-annotations`` — the same analysis DT008
+    reports, anchored at each attribute's ``__init__`` assignment.
+    Races the user already silenced — a ``# dtlint: ignore[DT008]`` on
+    the reported line, or a baseline grandfather (``baseline_keys``:
+    the loaded baseline's (rule, path, snippet) keys) — yield no
+    suggestion: the fixer must never edit source against an explicit
+    suppression decision."""
+    import os
+    from dt_tpu.analysis.engine import (DEFAULT_PATHS, FileContext,
+                                        iter_python_files)
+    baseline_keys = baseline_keys or frozenset()
+    out: List[dict] = []
+    for rel in iter_python_files(
+            root, list(paths if paths is not None else DEFAULT_PATHS)):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                source = f.read()
+            ctx = FileContext(root, rel, source)
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+        for model in flow.build_class_models(ctx.tree, ctx.lines):
+            for r in class_races(model):
+                if r["lock"] is None:
+                    continue  # no lock exists to name in an annotation
+                if ctx.suppressed(r["line"], "DT008"):
+                    continue
+                key = ("DT008", ctx.path, ctx.line_text(r["line"]))
+                if key in baseline_keys:
+                    continue
+                out.append({"path": ctx.path,
+                            "line": r["init_line"], "attr": r["attr"],
+                            "lock": r["lock"], "cls": r["cls"]})
+    return sorted(out, key=lambda s: (s["path"], s["line"], s["attr"]))
+
+
+# ---------------------------------------------------------------------------
+# DT009 — lock-order cycles + blocking while locked
+# ---------------------------------------------------------------------------
+
+
+class LockOrder(Rule):
+    """DT009: build the lock acquisition graph (lock B taken while A
+    held, same-class call edges followed) and flag order cycles —
+    potential deadlocks — plus blocking calls made under a held lock
+    (wire requests, unbounded ``join``/``wait``), the PR 6
+    close-vs-evictor family."""
+
+    id = "DT009"
+    name = "lock-order"
+    hint = ("acquire locks in one global order everywhere; move "
+            "blocking calls (requests, joins, unbounded waits) outside "
+            "the lock or bound them with a timeout")
+
+    def check_file(self, ctx: FileContext,
+                   project: ProjectContext) -> Iterable[Finding]:
+        graph: Dict[Tuple[str, str], Tuple[str, int]] = \
+            project.data.setdefault("dt009_edges", {})  # type: ignore
+        for model in _models_for(ctx, project):
+            if len(model.locks) == 0:
+                continue
+            edges, blocking = flow.collect_edges(model)
+            qual = f"{ctx.path}::{model.name}"
+            for a, b, line in edges:
+                key = (f"{qual}.{a}", f"{qual}.{b}")
+                if key not in graph:
+                    graph[key] = (ctx.path, line)
+            seen: Set[Tuple[int, str]] = set()
+            for b in sorted(blocking, key=lambda x: (x.line, x.desc)):
+                if (b.line, b.desc) in seen:
+                    continue
+                seen.add((b.line, b.desc))
+                held = "/".join(sorted(b.held))
+                yield ctx.finding(
+                    self, b.line,
+                    f"blocking while locked: {b.desc} under held lock "
+                    f"'{held}' ({model.name})")
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        graph = project.data.get("dt009_edges", {})
+        succ: Dict[str, Set[str]] = {}
+        for (a, b) in graph:
+            succ.setdefault(a, set()).add(b)
+            succ.setdefault(b, set())
+        for comp in _sccs(succ):
+            if len(comp) < 2:
+                continue
+            comp = sorted(comp)
+            # anchor at the lexically first edge inside the cycle
+            edges_in = sorted((a, b) for (a, b) in graph
+                              if a in comp and b in comp)
+            path, line = graph[edges_in[0]]
+            names = " -> ".join(c.split("::", 1)[-1] for c in comp)
+            yield Finding(
+                rule=self.id, path=path, line=line,
+                message=f"lock-order cycle (potential deadlock): "
+                        f"{names} form an acquisition cycle",
+                hint=self.hint,
+                snippet=names)
+
+
+def _sccs(succ: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan strongly-connected components, iterative, deterministic."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for start in sorted(succ):
+        if start in index:
+            continue
+        work: List[Tuple[str, Optional[iter]]] = [(start, None)]
+        while work:
+            node, it = work.pop()
+            if it is None:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+                it = iter(sorted(succ.get(node, ())))
+            advanced = False
+            for child in it:
+                if child not in index:
+                    work.append((node, it))
+                    work.append((child, None))
+                    advanced = True
+                    break
+                if child in on_stack:
+                    low[node] = min(low[node], index[child])
+            if advanced:
+                continue
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DT010 — ControlState journal discipline
+# ---------------------------------------------------------------------------
+
+
+class JournalDiscipline(Rule):
+    """DT010: in a class holding a ``ControlState`` (the scheduler),
+    every mutation of the state — field writes, container mutations,
+    ``apply()`` transitions — must happen inside the WAL path: a method
+    that journals first (calls ``<JournalWriter attr>.append``) or a
+    replay method (iterates ``<JournalReader attr>.read_new()``), per
+    the append-then-mutate discipline of ``docs/ha.md``."""
+
+    id = "DT010"
+    name = "journal-discipline"
+    hint = ("route the mutation through the journaled apply path as a "
+            "named op (WAL append before mutate, docs/ha.md)")
+
+    def check_file(self, ctx: FileContext,
+                   project: ProjectContext) -> Iterable[Finding]:
+        if "ControlState" not in ctx.source:
+            return
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls)
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        state_attrs: Set[str] = set()
+        writer_attrs: Set[str] = set()
+        reader_attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for v in flow._value_exprs(value):
+                if not isinstance(v, ast.Call):
+                    continue
+                ctor = flow._attr_name(v.func)
+                for t in targets:
+                    attr = flow._self_attr(t)
+                    if attr is None:
+                        continue
+                    if ctor == "ControlState":
+                        state_attrs.add(attr)
+                    elif ctor == "JournalWriter":
+                        writer_attrs.add(attr)
+                    elif ctor == "JournalReader":
+                        reader_attrs.add(attr)
+        if not state_attrs:
+            return
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name == "__init__" or \
+                    self._is_wal_method(meth, writer_attrs, reader_attrs):
+                continue
+            yield from self._check_method(ctx, meth, state_attrs)
+
+    @staticmethod
+    def _is_wal_method(meth: ast.AST, writers: Set[str],
+                       readers: Set[str]) -> bool:
+        """True for the journal-gated mutators: the method appends to
+        the WAL before applying, or replays committed records."""
+        for node in ast.walk(meth):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            owner = flow._self_attr(node.func.value)
+            if node.func.attr == "append" and owner in writers:
+                return True
+            if node.func.attr == "read_new" and owner in readers:
+                return True
+        return False
+
+    def _check_method(self, ctx: FileContext, meth: ast.AST,
+                      state_attrs: Set[str]) -> Iterable[Finding]:
+        parents = flow._parent_map(meth)
+        # local aliases: st = self._state
+        aliases: Set[str] = set()
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Assign) and \
+                    flow._self_attr(node.value) in state_attrs:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+        seen: Set[Tuple[int, str]] = set()
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.Attribute):
+                continue
+            base = node.value
+            is_state = flow._self_attr(base) in state_attrs or \
+                (isinstance(base, ast.Name) and base.id in aliases)
+            if not is_state:
+                continue
+            field = node.attr
+            p = parents.get(node)
+            if field == "apply" and isinstance(p, ast.Call) and \
+                    p.func is node:
+                msg = ("ControlState.apply() called outside the WAL "
+                       "path (state transition bypasses the journal)")
+            elif flow._access_kind(node, parents) != "r":
+                msg = (f"ControlState field '{field}' mutated outside "
+                       f"the journaled apply path")
+            else:
+                continue
+            if (node.lineno, msg) in seen:
+                continue
+            seen.add((node.lineno, msg))
+            yield ctx.finding(self, node.lineno, msg)

@@ -90,7 +90,10 @@ class WorkerClient:
             else [(scheduler_host, scheduler_port)]
         self._leader = 0  # index into addrs; guarded-by: _addr_lock
         self._addr_lock = threading.Lock()  # heartbeat vs caller thread
-        self.fence = 0  # leader incarnation we registered under
+        # leader incarnation we registered under; rewritten by a
+        # failover reattach on WHICHEVER thread noticed the rotation
+        # (dtflow DT008 r12)
+        self.fence = 0  # guarded-by: _addr_lock
         self.host = host or f"{socket.gethostname()}:{os.getpid()}"
         if is_new is None:
             is_new = os.environ.get("NEW_WORKER", "") in ("1", "true")
@@ -102,7 +105,10 @@ class WorkerClient:
         resp = self._req({"cmd": "register", "host": self.host,
                           "is_new": is_new, "is_recovery": is_recovery})
         self.fence = int(resp.get("fence", 0))
-        self.rank: int = resp["rank"]
+        # rank/workers are rewritten at membership barriers (caller
+        # thread) while the heartbeat thread reads rank for profiler
+        # commands — both ride _prof_lock (dtflow DT008 r12)
+        self.rank: int = resp["rank"]  # guarded-by: _prof_lock
         self.workers: List[str] = resp["workers"]
         # recovery re-entry: rank -1 until the next membership barrier
         # re-admits this host; resume_epoch is where to rejoin
@@ -223,7 +229,8 @@ class WorkerClient:
         over does not arrive at the standby in lockstep waves."""
         msg = dict(msg)
         msg.setdefault("token", uuid.uuid4().hex)
-        msg.setdefault("fence", self.fence)
+        with self._addr_lock:
+            msg.setdefault("fence", self.fence)
         # DT_CTRL_FAILOVER_S bounds the ROTATION budget, not one
         # attempt: each attempt runs with the caller's full request
         # timeout (barriers legitimately park minutes on a healthy
@@ -302,11 +309,12 @@ class WorkerClient:
         if "error" in resp:
             return
         fence = int(resp.get("fence", 0))
-        if fence != self.fence:
+        with self._addr_lock:
+            changed = fence != self.fence
             self.fence = fence
-            if obs_trace.enabled():
-                obs_trace.tracer().event("client.reattached",
-                                         {"fence": fence})
+        if changed and obs_trace.enabled():
+            obs_trace.tracer().event("client.reattached",
+                                     {"fence": fence})
 
     # -- sharded-plane routing (kvstore_dist.h:547-589) --------------------
 
@@ -510,10 +518,11 @@ class WorkerClient:
             {"epoch": epoch, "removed": bool(resp.get("you_are_removed"))})
         if resp.get("you_are_removed"):
             raise WorkerRemoved(self.host)
-        self.workers = resp["workers"]
-        self.rank = resp["rank"]
-        if self.recovery_pending and self.rank >= 0:
-            self.recovery_pending = False  # re-admitted as ourselves
+        with self._prof_lock:
+            self.workers = resp["workers"]
+            self.rank = resp["rank"]
+            if self.recovery_pending and self.rank >= 0:
+                self.recovery_pending = False  # re-admitted as ourselves
 
     def wait_rejoin(self, timeout_s: float = 600.0) -> int:
         """Recovery re-entry (``van.cc:187-218``): park at the next
@@ -539,12 +548,14 @@ class WorkerClient:
             if resp.get("you_are_removed"):
                 raise WorkerRemoved(self.host)
             if resp.get("rank", -1) >= 0:
-                self.workers = resp["workers"]
-                self.rank = resp["rank"]
-                self.recovery_pending = False
+                with self._prof_lock:
+                    self.workers = resp["workers"]
+                    self.rank = resp["rank"]
+                    self.recovery_pending = False
                 obs_trace.tracer().complete_span(
                     "recovery.rejoin", t0,
-                    {"epoch": int(resp["epoch"]), "rank": self.rank})
+                    {"epoch": int(resp["epoch"]),
+                     "rank": int(resp["rank"])})
                 return int(resp["epoch"])
             # a removal won this barrier; recovery stays queued
         return self.resume_epoch

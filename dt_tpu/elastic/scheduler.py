@@ -241,7 +241,11 @@ class Scheduler:
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
-        self._closed = False
+        # close() runs on the caller AND on handler threads (the
+        # "shutdown" command): the idempotence check is a test-and-set
+        # under its own lock, not a bare flag (dtflow DT008 r12)
+        self._close_lock = threading.Lock()
+        self._closed = False  # guarded-by: _close_lock
         # accepted connections, severed on close() so clients parked on
         # a dying scheduler see a reset (and fail over) instead of
         # hanging until their own timeout — an in-process close behaves
@@ -644,9 +648,10 @@ class Scheduler:
         the close-vs-evictor race where an evict pass holding ``_cv``
         could leave ``close()`` returning with live threads still
         mutating a half-closed scheduler."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop.set()
         with self._cv:
             self._cv.notify_all()
@@ -786,7 +791,9 @@ class Scheduler:
                     marker = journal.write_snapshot_sidecar(
                         self.journal_path, blob)
                     self._apply("snapshot", blob=marker)
-                    self._state.snapshot = blob  # dtlint: ignore[DT006]
+                    # memo, not a state transition: the journal carries
+                    # the marker; these are the very bytes it references
+                    self._state.snapshot = blob  # dtlint: ignore[DT006,DT010]
                 else:
                     self._apply("snapshot", blob=blob)
             return {}
@@ -802,7 +809,8 @@ class Scheduler:
                     snap = journal.load_snapshot_sidecar(
                         self.journal_path, snap[journal._SNAP_REF])
                     if snap is not None:
-                        self._state.snapshot = snap  # dtlint: ignore[DT006]
+                        # marker-resolution memo (see publish_snapshot)
+                        self._state.snapshot = snap  # dtlint: ignore[DT006,DT010]
                 return {"blob": snap}
         if cmd == "num_dead":
             return {"count": self._num_dead(float(msg.get("timeout_s", 60)))}

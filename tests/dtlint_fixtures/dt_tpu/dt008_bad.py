@@ -1,0 +1,37 @@
+"""DT008 fixture (bad): shared state reached from a worker thread and
+the caller with no common lock — the lock-set analysis must infer the
+race WITHOUT any guarded-by annotation present."""
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                if self._pending:
+                    self._pending.pop()
+
+    def enqueue(self, item):
+        # caller thread, no lock: races _drain's locked pop
+        self._pending.append(item)
+
+
+class Relay:
+    def __init__(self):
+        self._errors = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        # background WRITE of a never-locked container: racy even
+        # though no lock exists to suggest
+        self._errors.append("tick")
+
+    def errors(self):
+        return list(self._errors)

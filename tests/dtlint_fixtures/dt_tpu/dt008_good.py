@@ -1,0 +1,39 @@
+"""DT008 fixture (good): consistent locking, thread-safe carriers, the
+locked-rebind publication idiom, and thread-confined state — all
+silent."""
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []          # every access below holds _lock
+        self._out = queue.Queue()   # internally synchronized carrier
+        self._epoch = 0             # locked-rebind publication
+        self._caller_only = []      # never touched off the caller thread
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                if self._pending:
+                    self._out.put(self._pending.pop())
+            # locked rebind, bare reads elsewhere: reference assignment
+            # is atomic; flagged only if a write site drops the lock
+            with self._lock:
+                self._epoch = self._epoch + 1
+
+    def enqueue(self, item):
+        with self._lock:
+            self._pending.append(item)
+
+    def epoch(self):
+        return self._epoch
+
+    def note(self, item):
+        self._caller_only.append(item)
+
+    def notes(self):
+        return list(self._caller_only)

@@ -1,0 +1,44 @@
+"""DT009 fixture (bad): a two-lock order cycle, a wire request under a
+held lock, an unbounded join under a lock, and an unbounded Condition
+wait that still holds ANOTHER lock while parked."""
+import threading
+
+from dt_tpu.elastic import protocol
+
+
+class Tangled:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cv = threading.Condition(self._b)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._a:
+            with self._b:          # order: a -> b
+                pass
+
+    def backwards(self):
+        with self._b:
+            with self._a:          # order: b -> a  -> cycle
+                pass
+
+    def call_out(self, host, port):
+        with self._a:
+            # the network under a held lock: every thread needing _a
+            # now waits on the peer (the close-vs-evictor family)
+            return protocol.request(host, port, {"cmd": "ping"})
+
+    def reap(self):
+        with self._a:
+            self._thread.join()    # unbounded join under _a
+
+    def park(self):
+        with self._a:
+            with self._cv:
+                # wait() releases _cv/_b but _a stays held, unbounded
+                self._cv.wait()
+
+    def reap_positional(self):
+        with self._b:
+            self._thread.join(None)  # positional None: still unbounded

@@ -1,0 +1,42 @@
+"""DT009 fixture (good): one global acquisition order, requests made
+outside locks, bounded joins, and waits that release every held lock."""
+import threading
+
+from dt_tpu.elastic import protocol
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cv = threading.Condition(self._b)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._a:
+            with self._b:          # a -> b everywhere
+                pass
+
+    def same_order(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def call_out(self, host, port):
+        with self._a:
+            msg = {"cmd": "ping"}
+        return protocol.request(host, port, msg)
+
+    def reap(self):
+        with self._a:
+            self._thread.join(timeout=5.0)   # bounded
+
+    def park(self):
+        with self._cv:
+            # wait() releases the cv's own lock; nothing else is held
+            self._cv.wait()
+
+    def park_bounded(self):
+        with self._a:
+            with self._cv:
+                self._cv.wait(timeout=1.0)   # bounded while holding _a

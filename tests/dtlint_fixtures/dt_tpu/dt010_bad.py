@@ -1,0 +1,48 @@
+"""DT010 fixture (bad): ControlState touched outside the WAL path —
+a direct field mutation, a container mutation through an alias, and an
+apply() transition that never journaled."""
+
+
+class ControlState:
+    def __init__(self):
+        self.workers = []
+        self.epoch = -1
+
+    def apply(self, op, **kw):
+        if op == "add":
+            self.workers.append(kw["host"])
+
+
+class JournalWriter:
+    def __init__(self, path):
+        self.path = path
+
+    def append(self, op, kw):
+        pass
+
+
+class Sched:
+    def __init__(self):
+        # annotated assignment on purpose: discovery must see through it
+        self._state: ControlState = ControlState()
+        self._journal = JournalWriter("wal")
+
+    def _apply(self, op, **kw):
+        self._journal.append(op, kw)   # WAL append, THEN mutate
+        self._state.apply(op, **kw)
+
+    def force_add(self, host):
+        # container mutation bypassing the journal
+        self._state.workers.append(host)
+
+    def stamp(self, epoch):
+        # field write bypassing the journal
+        self._state.epoch = epoch
+
+    def sneaky(self, host):
+        st = self._state
+        st.workers.remove(host)        # alias mutation
+
+    def unjournaled_transition(self, host):
+        # the op runs but was never made durable first
+        self._state.apply("add", host=host)

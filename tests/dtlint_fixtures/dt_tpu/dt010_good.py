@@ -1,0 +1,51 @@
+"""DT010 fixture (good): every ControlState mutation rides the WAL
+path (append-then-apply) or the replay reader; bare reads are free."""
+
+
+class ControlState:
+    def __init__(self):
+        self.workers = []
+        self.epoch = -1
+
+    def apply(self, op, **kw):
+        if op == "add":
+            self.workers.append(kw["host"])
+
+
+class JournalWriter:
+    def __init__(self, path):
+        self.path = path
+
+    def append(self, op, kw):
+        pass
+
+
+class JournalReader:
+    def __init__(self, path):
+        self.path = path
+
+    def read_new(self):
+        return []
+
+
+class Sched:
+    def __init__(self):
+        self._state = ControlState()
+        self._journal = JournalWriter("wal") if True else None
+        self._reader = JournalReader("wal")
+        self._state.epoch = 0          # __init__ wiring is construction
+
+    def _apply(self, op, **kw):
+        self._journal.append(op, kw)   # WAL append, THEN mutate
+        self._state.apply(op, **kw)
+
+    def _replay(self):
+        for op, kw in self._reader.read_new():
+            self._state.apply(op, **kw)
+
+    def add(self, host):
+        self._apply("add", host=host)
+
+    def members(self):
+        st = self._state
+        return list(st.workers), st.epoch

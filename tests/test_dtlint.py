@@ -66,6 +66,9 @@ _PAIRS = [
     ("DT005", "dt_tpu/dt005_bad.py", "dt_tpu/dt005_good.py"),
     ("DT006", "dt_tpu/dt006_bad.py", "dt_tpu/dt006_good.py"),
     ("DT007", "dt_tpu/dt007_bad.py", "dt_tpu/dt007_good.py"),
+    ("DT008", "dt_tpu/dt008_bad.py", "dt_tpu/dt008_good.py"),
+    ("DT009", "dt_tpu/dt009_bad.py", "dt_tpu/dt009_good.py"),
+    ("DT010", "dt_tpu/dt010_bad.py", "dt_tpu/dt010_good.py"),
 ]
 
 
@@ -154,6 +157,408 @@ def test_dt006_scheduler_copy_detects_unguarded_access(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DT008-DT010 acceptance: break fixture copies of the REAL scheduler/client
+# (detection power: the pristine copies are clean; one deleted guard, one
+# reversed acquisition, one WAL bypass each yield the expected finding)
+# ---------------------------------------------------------------------------
+
+
+def _copy_into(tmp_path, relsrc, content=None):
+    src = content if content is not None else \
+        open(os.path.join(ROOT, *relsrc.split("/"))).read()
+    fixture_root = tmp_path / "fr"
+    dst = fixture_root / relsrc
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(src)
+    return fixture_root, src
+
+
+def test_dt008_scheduler_copy_detects_deleted_guard(tmp_path):
+    rel = "dt_tpu/elastic/scheduler.py"
+    root, src = _copy_into(tmp_path, rel)
+    clean = run(str(root), paths=["dt_tpu"],
+                select={"DT008", "DT009", "DT010"})
+    assert not clean, "\n".join(f.render() for f in clean)
+
+    # delete one guard: un-annotate _heartbeats AND add an unlocked
+    # public write — the quick-restart-race bug shape DT008 infers
+    # WITHOUT any annotation left to check syntactically
+    racy = src.replace(
+        "self._heartbeats = {h: now for h in self._state.workers}"
+        "  # guarded-by: _lock",
+        "self._heartbeats = {h: now for h in self._state.workers}")
+    assert racy != src
+    racy = racy.replace(
+        "    def _audit_locked(self, action: str, host: str):",
+        "    def poke_heartbeat(self, host):\n"
+        "        self._heartbeats[host] = 0.0\n\n"
+        "    def _audit_locked(self, action: str, host: str):")
+    assert "poke_heartbeat" in racy
+    root, _ = _copy_into(tmp_path, rel, racy)
+    findings = run(str(root), paths=["dt_tpu"], select={"DT008"})
+    hits = [f for f in findings if "_heartbeats" in f.message]
+    assert hits, [f.render() for f in findings]
+    assert "guarded-by: _lock" in hits[0].message
+
+
+def test_dt008_client_copy_detects_unlocked_fence(tmp_path):
+    rel = "dt_tpu/elastic/client.py"
+    root, src = _copy_into(tmp_path, rel)
+    clean = run(str(root), paths=["dt_tpu"],
+                select={"DT008", "DT009", "DT010"})
+    assert not clean, "\n".join(f.render() for f in clean)
+
+    # un-lock the failover fence refresh (and drop the annotation so
+    # the syntactic DT006 cannot see it either) — DT008 must re-infer
+    # the heartbeat-vs-caller race from the lock sets alone
+    racy = src.replace("self.fence = 0  # guarded-by: _addr_lock",
+                       "self.fence = 0")
+    racy = racy.replace(
+        "        with self._addr_lock:\n"
+        "            changed = fence != self.fence\n"
+        "            self.fence = fence",
+        "        changed = fence != self.fence\n"
+        "        self.fence = fence")
+    assert racy != src
+    root, _ = _copy_into(tmp_path, rel, racy)
+    findings = run(str(root), paths=["dt_tpu"], select={"DT008"})
+    hits = [f for f in findings if "fence" in f.message]
+    assert hits, [f.render() for f in findings]
+
+
+def test_dt009_scheduler_copy_detects_reversed_locks(tmp_path):
+    rel = "dt_tpu/elastic/scheduler.py"
+    root, src = _copy_into(tmp_path, rel)
+    # _register -> _server_list already orders _lock -> _servers_lock;
+    # inject the reverse acquisition
+    racy = src.replace(
+        "    def _audit_locked(self, action: str, host: str):",
+        "    def backwards_probe(self):\n"
+        "        with self._servers_lock:\n"
+        "            with self._lock:\n"
+        "                return len(self._state.workers)\n\n"
+        "    def _audit_locked(self, action: str, host: str):")
+    assert racy != src
+    root, _ = _copy_into(tmp_path, rel, racy)
+    findings = run(str(root), paths=["dt_tpu"], select={"DT009"})
+    cycles = [f for f in findings if "cycle" in f.message]
+    assert cycles, [f.render() for f in findings]
+    assert any("_servers_lock" in f.message for f in cycles)
+
+
+def test_dt009_blocking_under_lock_on_scheduler_copy(tmp_path):
+    rel = "dt_tpu/elastic/scheduler.py"
+    root, src = _copy_into(tmp_path, rel)
+    racy = src.replace(
+        "    def _audit_locked(self, action: str, host: str):",
+        "    def relay_blocking(self, host, port):\n"
+        "        with self._lock:\n"
+        "            return protocol.request(host, port,\n"
+        "                                    {\"cmd\": \"status\"})\n\n"
+        "    def _audit_locked(self, action: str, host: str):")
+    assert racy != src
+    root, _ = _copy_into(tmp_path, rel, racy)
+    findings = run(str(root), paths=["dt_tpu"], select={"DT009"})
+    assert any("blocking while locked" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_dt010_scheduler_copy_detects_wal_bypass(tmp_path):
+    rel = "dt_tpu/elastic/scheduler.py"
+    root, src = _copy_into(tmp_path, rel)
+    racy = src.replace(
+        "    def _audit_locked(self, action: str, host: str):",
+        "    def force_membership(self, host):\n"
+        "        with self._cv:\n"
+        "            self._state.workers.append(host)\n\n"
+        "    def _audit_locked(self, action: str, host: str):")
+    assert racy != src
+    root, _ = _copy_into(tmp_path, rel, racy)
+    findings = run(str(root), paths=["dt_tpu"], select={"DT010"})
+    assert any("workers" in f.message for f in findings), \
+        [f.render() for f in findings]
+    # the journaled path stays silent: _apply / replay are the WAL gate
+    assert not any(f.line <= 310 for f in findings), \
+        [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# r12 CLI satellites: cache digest, --fix-annotations, --changed, timings
+# ---------------------------------------------------------------------------
+
+
+def _load_cli():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_dtlint_cli", os.path.join(ROOT, "tools", "dtlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cache_misses_on_rule_edit_with_preserved_stat(tmp_path):
+    """Editing an analysis source must invalidate the whole-tree cache
+    even when the file's (size, mtime) are byte-identical — the r12
+    content-digest key (the old stat-only key served stale verdicts)."""
+    cli = _load_cli()
+    analysis = cli._import_analysis()
+    root = tmp_path / "r"
+    (root / "dt_tpu" / "analysis").mkdir(parents=True)
+    rule_src = root / "dt_tpu" / "analysis" / "rules_x.py"
+    rule_src.write_text("X = 1  # a rule constant\n")
+    (root / "dt_tpu" / "mod.py").write_text("import os\n")
+    # the digest covers the EXECUTING engine's sources (module _ROOT);
+    # point this CLI instance's _ROOT at the scratch tree so the test
+    # can edit a "rule" without touching the real checkout
+    cli._ROOT = str(root)
+
+    missed, sig, _ = cli._cached_findings(analysis, str(root),
+                                          ["dt_tpu"], None)
+    assert missed is None
+    cli._store_cache(str(root), sig, [], {"DT008": 1.0})
+    hit, _, timings = cli._cached_findings(analysis, str(root),
+                                           ["dt_tpu"], None)
+    assert hit == [] and timings == {"DT008": 1.0}
+
+    st = rule_src.stat()
+    rule_src.write_text("X = 2  # a rule constant\n")  # same size
+    os.utime(rule_src, (st.st_atime, st.st_mtime))     # same mtime
+    assert rule_src.stat().st_size == st.st_size
+    stale, _, _ = cli._cached_findings(analysis, str(root),
+                                       ["dt_tpu"], None)
+    assert stale is None, "stat-identical rule edit served a stale cache"
+
+
+def test_fix_annotations_inserts_and_is_idempotent(tmp_path):
+    root = tmp_path / "fa"
+    (root / "dt_tpu").mkdir(parents=True)
+    bad = open(os.path.join(FIXTURES, "dt_tpu", "dt008_bad.py")).read()
+    bad = bad.replace("self._pending = []",
+                      "self._pending = []  # staged items")
+    (root / "dt_tpu" / "mod.py").write_text(bad)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+           "--root", str(root), "--fix-annotations", "dt_tpu"]
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                         timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    text = (root / "dt_tpu" / "mod.py").read_text()
+    # inserted at the __init__ assignment, after the existing comment
+    assert "self._pending = []  # staged items  # guarded-by: _lock" \
+        in text
+    # idempotent: a second run changes nothing
+    again = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=120)
+    assert again.returncode == 0
+    assert (root / "dt_tpu" / "mod.py").read_text() == text
+    # the annotation silences DT008 for the annotatable attr and hands
+    # the contract to DT006, which now pins the unlocked caller-side
+    # write; Relay (no lock in the class) is NOT auto-annotated — the
+    # fixer must never fabricate a lock name — so its finding persists
+    left = run(str(root), paths=["dt_tpu"], select={"DT008"})
+    assert not any("_pending" in f.message for f in left), \
+        [f.render() for f in left]
+    assert any("_errors" in f.message and "owns no lock" in f.message
+               for f in left), [f.render() for f in left]
+    dt006 = run(str(root), paths=["dt_tpu"], select={"DT006"})
+    assert any("_pending" in f.message for f in dt006), \
+        [f.render() for f in dt006]
+
+
+def test_changed_scope_lints_only_git_diff(tmp_path):
+    import shutil
+    if shutil.which("git") is None:
+        pytest.skip("git unavailable")
+    root = tmp_path / "cg"
+    (root / "dt_tpu").mkdir(parents=True)
+    (root / "dt_tpu" / "clean.py").write_text("import os\n")
+    bad = open(os.path.join(FIXTURES, "dt_tpu", "dt003_bad.py")).read()
+    (root / "dt_tpu" / "was_there.py").write_text(bad)
+
+    def git(*args):
+        proc = subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             *args], cwd=root, capture_output=True, text=True,
+            timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        return proc
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # a NEW bad file is in scope; the committed bad file is not, and a
+    # changed file under tests/ (fixtures violate rules on purpose)
+    # stays excluded exactly as in a full run
+    (root / "dt_tpu" / "fresh.py").write_text(bad)
+    (root / "tests").mkdir()
+    (root / "tests" / "fixture_bad.py").write_text(bad)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--root", str(root), "--changed", "--no-cache",
+         "--no-baseline", "--select", "DT003"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "fresh.py" in out.stdout
+    assert "was_there.py" not in out.stdout
+    assert "fixture_bad.py" not in out.stdout
+
+
+def test_changed_scope_with_root_below_git_toplevel(tmp_path):
+    """--root pointing at a SUBDIRECTORY of the checkout: `git diff`
+    paths carry the toplevel prefix, `git ls-files --others` paths do
+    not — both a tracked edit and a new untracked file must be linted."""
+    import shutil
+    if shutil.which("git") is None:
+        pytest.skip("git unavailable")
+    top = tmp_path / "mono"
+    sub = top / "proj"
+    (sub / "dt_tpu").mkdir(parents=True)
+    (sub / "dt_tpu" / "tracked.py").write_text("import os\n")
+
+    def git(*args):
+        proc = subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             *args], cwd=top, capture_output=True, text=True,
+            timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        return proc
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    bad = open(os.path.join(FIXTURES, "dt_tpu", "dt003_bad.py")).read()
+    (sub / "dt_tpu" / "tracked.py").write_text(bad)      # modified
+    (sub / "dt_tpu" / "untracked.py").write_text(bad)    # brand new
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--root", str(sub), "--changed", "--no-cache",
+         "--no-baseline", "--select", "DT003"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "tracked.py" in out.stdout
+    assert "untracked.py" in out.stdout
+
+
+def test_fix_annotations_respects_suppressions(tmp_path):
+    """A race the user silenced with '# dtlint: ignore[DT008]' must not
+    be annotated — the fixer would otherwise activate DT006 at the very
+    site the user suppressed and flip a passing gate to exit 1."""
+    root = tmp_path / "fs"
+    (root / "dt_tpu").mkdir(parents=True)
+    bad = open(os.path.join(FIXTURES, "dt_tpu", "dt008_bad.py")).read()
+    bad = bad.replace("self._pending.append(item)",
+                      "self._pending.append(item)"
+                      "  # dtlint: ignore[DT008]")
+    (root / "dt_tpu" / "mod.py").write_text(bad)
+    assert not any("_pending" in f.message for f in
+                   run(str(root), paths=["dt_tpu"], select={"DT008"}))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--root", str(root), "--fix-annotations", "dt_tpu"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    text = (root / "dt_tpu" / "mod.py").read_text()
+    assert "self._pending = []  # guarded-by" not in text
+
+
+def test_scoped_run_skips_out_of_scope_stale_check(tmp_path):
+    """A path-scoped run (--changed / explicit paths) never produces
+    the findings that keep out-of-scope grandfathers alive — it must
+    not flag them stale (and exit 1) for that reason alone; the full
+    default-scope run still does."""
+    root = tmp_path / "sc"
+    (root / "dt_tpu").mkdir(parents=True)
+    bad = open(os.path.join(FIXTURES, "dt_tpu", "dt003_bad.py")).read()
+    (root / "dt_tpu" / "a.py").write_text(bad)
+    (root / "dt_tpu" / "b.py").write_text(bad)
+    grand = run(str(root), paths=["dt_tpu/a.py"], select={"DT003"})
+    assert grand
+    bl = str(root / "baseline.txt")
+    Baseline().save(bl, grand, reasons={f.key: "test grandfather"
+                                        for f in grand})
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    base_cmd = [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+                "--root", str(root), "--baseline", bl, "--no-cache",
+                "--select", "DT003"]
+    scoped = subprocess.run(base_cmd + ["dt_tpu/b.py"],
+                            capture_output=True, text=True, env=env,
+                            timeout=120)
+    assert scoped.returncode == 1, scoped.stdout + scoped.stderr
+    assert "b.py" in scoped.stdout
+    assert "stale baseline" not in scoped.stdout, scoped.stdout
+    # rule-scoped over the full paths: --select of a DIFFERENT rule
+    # never produces the grandfathered findings either — no stale, rc 0
+    selected = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--root", str(root), "--baseline", bl, "--no-cache",
+         "--select", "DT006"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert selected.returncode == 0, selected.stdout + selected.stderr
+    assert "stale baseline" not in selected.stdout
+    # fix the grandfathered file: the FULL run (all paths, all rules —
+    # --select also counts as scoped now) reports the entry stale
+    (root / "dt_tpu" / "a.py").write_text("import os\n")
+    (root / "dt_tpu" / "b.py").write_text("import os\n")
+    full = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--root", str(root), "--baseline", bl, "--no-cache"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert full.returncode == 1, full.stdout + full.stderr
+    assert "stale baseline" in full.stdout
+
+
+def test_scoped_flags_refuse_unsound_combinations(tmp_path):
+    """--write-baseline on any scoped run would silently drop every
+    out-of-scope grandfather; --changed plus explicit paths is two
+    contradictory scopes — both are usage errors (rc 2)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    cli = os.path.join(ROOT, "tools", "dtlint.py")
+    for extra in (["--select", "DT003", "--write-baseline",
+                   "--baseline", str(tmp_path / "bl.txt")],
+                  ["dt_tpu", "--changed"]):
+        out = subprocess.run([sys.executable, cli, "--no-cache"] + extra,
+                             capture_output=True, text=True, env=env,
+                             timeout=120)
+        assert out.returncode == 2, (extra, out.stdout, out.stderr)
+
+
+def test_json_reports_per_rule_timings():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--json", "--no-cache", "--select", "DT008", "--select",
+         "DT010", os.path.join("dt_tpu", "elastic")],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    import json as _json
+    summary = _json.loads(out.stdout.strip().splitlines()[-1])
+    timings = summary["rule_timings_ms"]
+    assert set(timings) == {"DT008", "DT010"}
+    assert all(v >= 0 for v in timings.values())
+
+
+def test_repo_baseline_entries_are_reasoned_and_known():
+    """Every grandfather must carry a real reason and cite a live rule
+    id — Baseline.load already hard-fails on a missing '# reason:'."""
+    baseline = Baseline.load(os.path.join(ROOT, "dtlint_baseline.txt"))
+    ids = {r.id for r in all_rules()}
+    for (rule, path, _snippet), reason in baseline.entries.items():
+        assert rule in ids, f"baseline cites unknown rule {rule}"
+        assert reason.strip() and "TODO" not in reason, \
+            f"undocumented baseline entry for {rule} in {path}"
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline round-trip
 # ---------------------------------------------------------------------------
 
@@ -201,7 +606,7 @@ def test_baseline_requires_reason(tmp_path):
 def test_rule_ids_unique_and_documented():
     rules = all_rules()
     ids = [r.id for r in rules]
-    assert len(set(ids)) == len(ids) == 7
+    assert len(set(ids)) == len(ids) == 10
     catalog = open(os.path.join(ROOT, "docs", "dtlint_rules.md")).read()
     for r in rules:
         assert r.id in catalog, f"{r.id} missing from docs/dtlint_rules.md"
