@@ -248,7 +248,7 @@ def main():
         head = next((completed[n] for n in priority if n in completed),
                     result)
         others = {n: {k: r[k] for k in ("metric", "value", "unit", "mfu",
-                                        "step_ms")
+                                        "step_ms", "sync_agreement")
                       if k in r}
                   for n, r in completed.items()
                   if r is not head}
@@ -410,6 +410,14 @@ def measure_tier(net, batch, size):
 
     imgs_per_sec = batch / dt_step
     step_ms = dt_step * 1e3
+    # per-chip honesty (ROADMAP item 5): this step is a single-device
+    # jit, so value IS the per-chip number; num_chips documents the
+    # divisor and sync_agreement is the queued-drain vs per-step-sync
+    # ratio the first real TPU number is gated on (within 10% = the two
+    # completed-work timings agree; a big gap means queued programs were
+    # still executing at the scalar block — the 22x-AlexNet failure)
+    num_chips = 1
+    sync_agreement = round(min(queued, synced) / max(queued, synced), 3)
     fwd_flops, baseline, calib_size, calib_batch = _TIER_INFO.get(
         net, (0.0, None, None, None))
     if calib_size is not None and size != calib_size:
@@ -439,6 +447,9 @@ def measure_tier(net, batch, size):
         "step_ms": round(step_ms, 2),
         "step_ms_queued": round(queued * 1e3, 2),
         "step_ms_synced": round(synced * 1e3, 2),
+        "sync_agreement": sync_agreement,
+        "num_chips": num_chips,
+        "value_per_chip": round(imgs_per_sec / num_chips, 2),
         "compile_s": round(t_compile, 1),
         "model_tflops_per_sec": round(model_tflops, 2) if flops_per_img
         else None,
@@ -543,6 +554,7 @@ def measure_tier_lm():
                     if step_flops else None)
     kind = jax.devices()[0].device_kind
     peak = _peak_tflops(kind)
+    num_chips = 1  # single-device jit (see measure_tier's note)
     return {
         "metric": "transformer_lm_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -552,6 +564,10 @@ def measure_tier_lm():
         "step_ms": round(dt_step * 1e3, 2),
         "step_ms_queued": round(queued * 1e3, 2),
         "step_ms_synced": round(synced * 1e3, 2),
+        "sync_agreement": round(min(queued, synced)
+                                / max(queued, synced), 3),
+        "num_chips": num_chips,
+        "tokens_per_sec_per_chip": round(tokens_per_sec / num_chips, 1),
         "compile_s": round(t_compile, 1),
         "model_tflops_per_sec": round(model_tflops, 2)
         if model_tflops else None,

@@ -14,6 +14,7 @@ are TPU-shaped, so they get a bespoke rule engine instead:
 - DT008 race-inference   — flow-sensitive lock-set race detection
 - DT009 lock-order       — acquisition-graph cycles, blocking under lock
 - DT010 journal-discipline — ControlState mutations ride the WAL path
+- DT011 obs-name-registry — span/event/counter names vs obs.names catalog
 
 DT008-DT010 (``rules_flow`` over the ``flow`` substrate) are
 flow-sensitive: they track held-lock sets through ``with`` blocks and
@@ -39,7 +40,8 @@ def all_rules() -> List[Rule]:
     rules = [rules_tpu.PallasTiling(), rules_tpu.Bf16Downcast(),
              rules_tpu.CpuDonate(), rules_tpu.PartialBlock(),
              rules_project.EnvRegistry(), rules_project.LockDiscipline(),
-             rules_project.ParityCitation(), rules_flow.RaceInference(),
+             rules_project.ParityCitation(),
+             rules_project.ObsNameRegistry(), rules_flow.RaceInference(),
              rules_flow.LockOrder(), rules_flow.JournalDiscipline()]
     return sorted(rules, key=lambda r: r.id)
 

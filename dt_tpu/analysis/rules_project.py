@@ -1,12 +1,17 @@
-"""Project-invariant rules (DT005-DT007): env-var registry, elastic lock
-discipline, and the SURVEY-§2 parity-citation convention.
+"""Project-invariant rules (DT005-DT007, DT011): env-var registry,
+elastic lock discipline, the SURVEY-§2 parity-citation convention, and
+the obs span/counter/event name registry.
 
 The reference centralized its env contract in ``ps-lite/src/postoffice.cc:
 18-31`` (one GetEnv block) and gated style with ``make cpplint``
 (``Makefile:140-160``); these rules impose the same centralization on
 dt_tpu's ``DT_*``/``JAX_*`` knobs (:data:`dt_tpu.config.ENV_REGISTRY`),
 machine-check the ``# guarded-by:`` lock annotations PR 1/2's concurrent
-control plane grew, and keep module docstrings honest against PARITY.md.
+control plane grew, keep module docstrings honest against PARITY.md, and
+(DT011, r13) hold every ``dt_tpu.obs`` instrumentation name to the
+catalog in :data:`dt_tpu.obs.names.NAME_REGISTRY` — the reference's
+profiler scopes were free-form strings nothing audited
+(``src/profiler/profiler.h:256``).
 """
 
 from __future__ import annotations
@@ -276,6 +281,141 @@ class LockDiscipline(Rule):
             if (f.line, f.message) not in seen:
                 seen.add((f.line, f.message))
                 yield f
+
+
+_OBS_NAMES_RELPATH = "dt_tpu/obs/names.py"
+#: tracer emission methods whose first literal argument is an obs name.
+#: Read-side accessors (get_counter, counters) are not emission and may
+#: query any name.
+_OBS_EMITTERS = frozenset({"span", "complete_span", "event", "counter"})
+_OBS_KIND_OF = {"span": "span", "complete_span": "span",
+                "event": "event", "counter": "counter"}
+
+
+def _load_obs_registry(project: ProjectContext) -> Dict[str, Tuple[str,
+                                                                   int]]:
+    """{name: (kind, names.py line)} parsed from the NAME_REGISTRY dict
+    literal — by AST, never by import (the linter must not need jax)."""
+    if "obs_registry" in project.data:
+        return project.data["obs_registry"]  # type: ignore[return-value]
+    reg: Dict[str, Tuple[str, int]] = {}
+    path = os.path.join(project.root, _OBS_NAMES_RELPATH)
+    if os.path.exists(path):
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "NAME_REGISTRY"
+                       for t in targets):
+                continue
+            if isinstance(value, ast.Dict):
+                for k, v in zip(value.keys, value.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        kind = ""
+                        if isinstance(v, ast.Tuple) and v.elts and \
+                                isinstance(v.elts[0], ast.Constant):
+                            kind = str(v.elts[0].value)
+                        reg[k.value] = (kind, k.lineno)
+    project.data["obs_registry"] = reg
+    return reg
+
+
+class ObsNameRegistry(Rule):
+    """DT011: every ``span``/``complete_span``/``event``/``counter``
+    emission with a literal name must be declared in
+    ``dt_tpu.obs.names.NAME_REGISTRY`` (with a kind that matches the
+    call), and every registry entry must still have an emitter — the
+    export's stall/pipeline classification and dtop's sections key on
+    these names, so a renamed span must fail the lint instead of
+    silently vanishing from the dashboards.  F-string names match by
+    their literal prefix against the ``*`` prefix entries
+    (``fault.*``/``membership.*``/``rpc.*``); fully dynamic names are
+    out of scope."""
+
+    id = "DT011"
+    name = "obs-name-registry"
+    hint = ("declare the name in dt_tpu.obs.names.NAME_REGISTRY "
+            "(kind + doc), or delete the dead registry entry")
+
+    @staticmethod
+    def _literal_name(arg: ast.AST) -> Tuple[Optional[str], bool]:
+        """(name-or-prefix, is_prefix) of a call's first argument;
+        (None, False) when the name is fully dynamic."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value, False
+        if isinstance(arg, ast.JoinedStr) and arg.values and \
+                isinstance(arg.values[0], ast.Constant) and \
+                isinstance(arg.values[0].value, str):
+            return arg.values[0].value, True
+        return None, False
+
+    @staticmethod
+    def _resolve(registry: Dict[str, Tuple[str, int]], name: str,
+                 is_prefix: bool) -> Optional[str]:
+        """The registry key covering ``name``, or None."""
+        if not is_prefix and name in registry:
+            return name
+        for key in registry:
+            if key.endswith("*") and name.startswith(key[:-1]):
+                return key
+        return None
+
+    def check_file(self, ctx: FileContext,
+                   project: ProjectContext) -> Iterable[Finding]:
+        registry = _load_obs_registry(project)
+        if not registry:
+            return  # no catalog in this tree (fixture roots)
+        used: Set[str] = project.data.setdefault(
+            "obs_names_used", set())  # type: ignore[assignment]
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in _OBS_EMITTERS and node.args):
+                continue
+            name, is_prefix = self._literal_name(node.args[0])
+            if name is None:
+                continue
+            key = self._resolve(registry, name, is_prefix)
+            if key is None:
+                shown = f"{name}..." if is_prefix else name
+                yield ctx.finding(
+                    self, node.lineno,
+                    f"unregistered obs name: {shown!r} is not in "
+                    f"dt_tpu.obs.names.NAME_REGISTRY")
+                continue
+            used.add(key)
+            kind, _ = registry[key]
+            want = _OBS_KIND_OF[node.func.attr]
+            if kind and want not in kind.split("|"):
+                yield ctx.finding(
+                    self, node.lineno,
+                    f"obs name {name!r} is registered as {kind!r} but "
+                    f"emitted via .{node.func.attr}() (kind {want!r})")
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        # dead-entry arm only on a full-default-scope run (same gating
+        # as DT005: a path subset would flag every name whose emitters
+        # are outside it)
+        linted = {p.rstrip("/") for p in project.paths}
+        if not set(DEFAULT_PATHS) <= linted:
+            return
+        registry = _load_obs_registry(project)
+        used = project.data.get("obs_names_used", set())
+        for name, (kind, line) in sorted(registry.items()):
+            if name not in used:
+                yield Finding(
+                    rule=self.id, path=_OBS_NAMES_RELPATH, line=line,
+                    message=f"dead registry entry: obs name {name!r} is "
+                            f"declared but never emitted in the linted "
+                            f"tree",
+                    hint=self.hint, snippet=name)
 
 
 _CITATION_RE = re.compile(

@@ -80,6 +80,7 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     # observability (dt_tpu/obs)
     "DT_OBS": ("", "1 = enable dt_tpu.obs tracing (span/event ring buffer + heartbeat export)"),
     "DT_OBS_RING": (str(4096), "obs ring-buffer capacity (records per tracer; overflow drops oldest)"),
+    "DT_STRAGGLER_MS": ("500", "round-contribution-lag EWMA threshold (ms) that fires the worker.straggler event"),
     # fault injection / chaos
     "DT_FAULT_PLAN": ("", "fault-plan JSON (or @/path) for subprocess workers (elastic/faults.py)"),
     "DT_DROP_MSG": ("", "percent of received control messages to drop (ps-lite PS_DROP_MSG fuzz)"),

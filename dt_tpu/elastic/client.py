@@ -101,6 +101,20 @@ class WorkerClient:
             # a restarted worker re-entering under its old identity
             # (van.cc:187-218 is_recovery); set by the restart wrapper
             is_recovery = config.env("DT_RECOVERY") in ("1", "true")
+        # obs export eligibility + track identity BEFORE the first wire
+        # request: the register request already carries trace context,
+        # and its span must link to THIS worker's track, not the
+        # process default (docs/observability.md track model)
+        self._obs_inc = os.getpid()
+        self._obs_export = obs_trace.enabled()
+        if self._obs_export:
+            # name this process's trace track for cross-process context:
+            # every wire.request this process issues carries
+            # (host#incarnation, span_id), so server-side handler spans
+            # link back to OUR track in the merged timeline.  Same
+            # one-exporting-worker-per-process model as the export
+            # eligibility gate (docs/observability.md).
+            obs_trace.set_origin(f"{self.host}#{self._obs_inc}")
         faults.crash_point("client.register", host=self.host)
         resp = self._req({"cmd": "register", "host": self.host,
                           "is_new": is_new, "is_recovery": is_recovery})
@@ -134,18 +148,18 @@ class WorkerClient:
         # dropped heartbeat loses nothing.  The incarnation id (pid)
         # names this process's track; a quick-restarted worker gets a
         # fresh track instead of splicing into its dead predecessor's.
-        self._obs_inc = os.getpid()
+        # (_obs_inc itself was set before the register request above.)
         self._obs_lock = threading.Lock()
         self._obs_pending: list = []  # guarded-by: _obs_lock
         self._obs_shed = 0  # pending-overflow drops; guarded-by: _obs_lock
         self._obs_fseq = 0  # flush-payload seq (counter ordering); guarded-by: _obs_lock
-        # Export eligibility is captured at CONSTRUCTION (the launcher
-        # model: DT_OBS is set before workers start).  The process tracer
-        # is shared, so a client built while tracing was off must never
-        # become an exporter later — its heartbeat would drain records
-        # that belong to the one client constructed as the process's
-        # worker (in-process test fleets leave heartbeat threads running).
-        self._obs_export = obs_trace.enabled()
+        # Export eligibility was captured at CONSTRUCTION, before the
+        # register request (the launcher model: DT_OBS is set before
+        # workers start).  The process tracer is shared, so a client
+        # built while tracing was off must never become an exporter
+        # later — its heartbeat would drain records that belong to the
+        # one client constructed as the process's worker (in-process
+        # test fleets leave heartbeat threads running).
         self._obs_hook = None
         if self._obs_export:
             # an injected crash (os._exit) flushes through this hook so

@@ -21,6 +21,14 @@ from typing import Callable, Dict, Optional, Set
 
 import numpy as np
 
+from dt_tpu import config
+
+#: EWMA smoothing for the per-worker straggler score (round-contribution
+#: lag, ms).  ~0.3 weights the last ~5 rounds — fast enough to catch a
+#: worker going slow mid-epoch, smooth enough that one noisy round does
+#: not fire the threshold event
+_STRAGGLER_ALPHA = 0.3
+
 
 class DataPlane:
     """Allreduce + dist_async handlers, factored from the round-3 scheduler.
@@ -55,8 +63,18 @@ class DataPlane:
         # reads its live registry either way.
         self.confirm_fn = confirm_fn or expected_fn
         self._cv = threading.Condition()
-        # key -> {vals: {host: (seq, arr)}, gen, result, served: {host: (seq, result)}}
+        # key -> {vals: {host: (seq, arr)}, gen, result, served: {host:
+        # (seq, result)}, t0: begin token, arrive: {host: mono_ns},
+        # meta: (gen, last_host, wait_ms) of the last completed round}
         self._reduce: Dict[str, dict] = {}  # guarded-by: _cv
+        # per-worker round-contribution-lag EWMA (ms): how late each
+        # host's contributions run relative to the round's FIRST arrival
+        # — the scheduler-side straggler score (r13).  Edge-triggered
+        # worker.straggler events fire when a score crosses
+        # DT_STRAGGLER_MS; _straggler_over remembers who is above so one
+        # slow worker emits one event per excursion, not one per round.
+        self._straggler: Dict[str, float] = {}  # guarded-by: _cv
+        self._straggler_over: Set[str] = set()  # guarded-by: _cv
         self._async_lock = threading.Lock()
         self._async_live: Set[str] = set()  # guarded-by: _async_lock
         self._async_store: Dict[str, np.ndarray] = {}  # guarded-by: _async_lock
@@ -128,6 +146,17 @@ class DataPlane:
             for key in [k for k in self._async_last_seen
                         if k[0] in hosts]:
                 del self._async_last_seen[key]
+        with self._cv:
+            # departed hosts leave the straggler board too: a dead
+            # worker's frozen score would otherwise shadow live lag
+            for h in hosts:
+                self._straggler.pop(h, None)
+                self._straggler_over.discard(h)
+
+    @staticmethod
+    def _new_slot() -> dict:
+        return {"vals": {}, "gen": 0, "result": None, "served": {},
+                "t0": None, "arrive": {}, "meta": None}
 
     def install_round(self, key: str, gen: int, seqs: Dict[str, int],
                       result) -> None:
@@ -139,8 +168,7 @@ class DataPlane:
         pending contribution at-or-below a served seq is dropped (it
         belongs to the replicated round, not a fresh one)."""
         with self._cv:
-            slot = self._reduce.setdefault(
-                key, {"vals": {}, "gen": 0, "result": None, "served": {}})
+            slot = self._reduce.setdefault(key, self._new_slot())
             if int(gen) <= slot["gen"]:
                 return
             slot["gen"] = int(gen)
@@ -195,13 +223,27 @@ class DataPlane:
                    np.asarray(value["vals"]), int(value["num_rows"]))
         else:
             arr = np.asarray(value)
+        tnow = self._obs.now()  # None when tracing is off (zero cost)
         with self._cv:
-            slot = self._reduce.setdefault(
-                key, {"vals": {}, "gen": 0, "result": None, "served": {}})
+            slot = self._reduce.setdefault(key, self._new_slot())
             served = slot["served"].get(host)
             if seq >= 0 and served is not None and served[0] == seq:
                 return {"value": served[1]}  # retry of a completed round
             gen = slot["gen"]
+            if tnow is not None:
+                # round span bookkeeping: the FIRST contribution opens
+                # the round's window; every host's FIRST arrival is
+                # stamped so the finish can name the last (straggling)
+                # contributor and score per-worker lag (straggler EWMA,
+                # r13).  setdefault, not assignment: an at-least-once
+                # RETRY of an in-flight contribution (lost response,
+                # recv-drop fault) must not re-stamp the host later and
+                # steal the straggler blame from the genuinely slow
+                # contributor everyone is actually waiting on
+                if not slot["vals"]:
+                    slot["t0"] = tnow
+                    slot["arrive"] = {}
+                slot["arrive"].setdefault(host, tnow[1])
             slot["vals"][host] = (seq, arr)
             expected = self.expected_fn()
             if expected and set(slot["vals"]) >= set(expected):
@@ -211,11 +253,34 @@ class DataPlane:
                 contributors = [h for h in expected if h in slot["vals"]]
                 self._finish_round_locked(slot, contributors, key)
                 self._cv.notify_all()
-                return {"value": slot["result"]}
+                return self._round_resp_locked(slot, gen, tnow)
             while slot["gen"] == gen:
                 if not self._cv.wait(timeout=300):
                     raise TimeoutError(f"allreduce {key} stuck")
-            return {"value": slot["result"]}
+            return self._round_resp_locked(slot, gen, tnow)
+
+    def _round_resp_locked(self, slot: dict, gen: int,
+                           tnow) -> dict:
+        """One completed round's response.  Caller holds the lock.  When
+        tracing, a transient ``_srv`` key carries this handler's server-
+        side timing up to the rpc wrapper (which folds it into the
+        handler span and strips it from the wire): ``wait_ms`` — how
+        long THIS contribution waited for the round to complete — and
+        ``last`` — the round's last-arriving contributor, i.e. who the
+        wait is attributable to.  The export's critical-path
+        decomposition splits server time into queue vs straggler-wait
+        from exactly these two numbers."""
+        resp = {"value": slot["result"]}
+        if tnow is not None:
+            t1 = self._obs.now()
+            srv = {"wait_ms": round(max(t1[1] - tnow[1], 0) / 1e6, 3)
+                   if t1 is not None else 0.0}
+            meta = slot.get("meta")
+            if meta is not None and meta[0] == gen + 1:
+                srv["last"] = meta[1]
+                srv["round_wait_ms"] = meta[2]
+            resp["_srv"] = srv
+        return resp
 
     def _finish_round_locked(self, slot: dict, contributors,
                              key: str = "") -> None:
@@ -262,6 +327,28 @@ class DataPlane:
                     logging.getLogger("dt_tpu.elastic").warning(
                         "HA round replication to standby failed (%s); "
                         "continuing unreplicated", e)
+        t0 = slot.get("t0")
+        if t0 is not None:
+            # the round's server-side span: first contribution →
+            # completion, naming the last (straggling) contributor and
+            # the wait-for-last window; per-worker lags feed the
+            # straggler EWMA (scheduler status / obs_dump / dtop board)
+            arrive = slot.get("arrive") or {}
+            first = t0[1]
+            last_host, last_t = None, first
+            for h, t in arrive.items():
+                if t >= last_t:
+                    last_host, last_t = h, t
+            wait_ms = round(max(last_t - first, 0) / 1e6, 3)
+            slot["meta"] = (slot["gen"] + 1, last_host, wait_ms)
+            self._update_straggler_locked(arrive, first)
+            self._obs.complete_span(
+                "dataplane.round", t0,
+                {"key": key, "gen": slot["gen"] + 1,
+                 "contributors": len(contributors),
+                 "last": last_host, "wait_ms": wait_ms})
+            slot["t0"] = None
+            slot["arrive"] = {}
         slot["vals"] = {}
         slot["gen"] += 1
         self._obs.counter("dataplane.rounds")
@@ -270,6 +357,37 @@ class DataPlane:
             # with chunk suffixes): per-bucket accounting for the step
             # pipeline (chaos --trace asserts the overlapped path ran)
             self._obs.counter("dataplane.bucket_rounds")
+
+    def _update_straggler_locked(self, arrive: Dict[str, int],
+                                 first: int) -> None:
+        """Fold one round's per-host arrival lags into the straggler
+        EWMA; edge-triggered ``worker.straggler`` event on threshold
+        crossing (``DT_STRAGGLER_MS``).  Caller holds the lock."""
+        threshold = float(config.env("DT_STRAGGLER_MS"))
+        for h, t in arrive.items():
+            lag = max(t - first, 0) / 1e6
+            prev = self._straggler.get(h)
+            score = lag if prev is None else \
+                (1.0 - _STRAGGLER_ALPHA) * prev + _STRAGGLER_ALPHA * lag
+            self._straggler[h] = score
+            if score >= threshold:
+                if h not in self._straggler_over:
+                    self._straggler_over.add(h)
+                    self._obs.event("worker.straggler",
+                                    {"host": h,
+                                     "score_ms": round(score, 3)})
+            else:
+                self._straggler_over.discard(h)
+
+    def straggler_scores(self) -> Dict[str, float]:
+        """Per-worker round-contribution-lag EWMA (ms) — the straggler
+        board surfaced by the scheduler's ``status``/``obs_dump`` and
+        the range server's ``stats``.  Empty until tracing (``DT_OBS``)
+        is on: arrival stamping rides the obs gate so the disabled fast
+        path stays zero-cost."""
+        with self._cv:
+            return {h: round(v, 3)
+                    for h, v in sorted(self._straggler.items())}
 
     @staticmethod
     def _merge_sparse(stacked) -> dict:

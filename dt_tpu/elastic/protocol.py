@@ -49,8 +49,12 @@ interface is likewise configurable (``DT_ELASTIC_BIND``, default
 ``0.0.0.0``) so operators can pin the control plane to a private
 interface.
 
-Message is a dict with at least ``{"cmd": str}``.  Commands mirror the
-fork's ``Control::Command`` additions (``message.h:123``):
+Message is a dict with at least ``{"cmd": str}``.  When tracing is on
+(``DT_OBS=1``) each request attempt additionally carries ``"_tc":
+(origin_track, span_id)`` — the r13 causal trace context the server's
+handler span links back to (``docs/observability.md``); the disabled
+path attaches nothing and ships byte-compatible frames.  Commands
+mirror the fork's ``Control::Command`` additions (``message.h:123``):
 
 - ``register``       (worker -> sched): {host, is_new} -> {rank, workers}
 - ``heartbeat``      (worker -> sched): {host} -> {}
@@ -472,8 +476,17 @@ def _request_once(host: str, port: int, msg: Dict[str, Any],
     # The obs export channel itself is exempt: an obs_push's own span
     # would re-fill the very ring the flush is draining (the flush loop
     # would never see an empty payload and always run to its bound).
-    t0 = obs_trace.tracer().now() \
+    # When tracing is on the attempt also CARRIES its trace context —
+    # "_tc": (origin track, this attempt's pre-allocated span id) — so
+    # the server opens a handler span linked to this exact wire.request
+    # record (the export joins the two with chrome flow events).  The
+    # disabled path builds neither: begin() returns None without
+    # allocating, and the message ships byte-identical to r9.
+    t0 = obs_trace.tracer().begin() \
         if msg.get("cmd") != "obs_push" else None
+    if t0 is not None:
+        msg = dict(msg)
+        msg["_tc"] = (obs_trace.origin(), t0[2])
     addr = (host, port)
     sock, reused = _POOL.acquire(addr, timeout)
     try:
@@ -513,6 +526,33 @@ def _request_once(host: str, port: int, msg: Dict[str, Any],
     _POOL.release(addr, sock)
     obs_trace.tracer().complete_span(
         "wire.request", t0, {"cmd": msg.get("cmd"), "reused": reused})
+    return resp
+
+
+def traced_handle(tracer, msg: Dict[str, Any], inner):
+    """Serve one request through ``inner(msg)`` with the r13 causal-
+    tracing wrapper shared by the scheduler and the range server: a
+    request carrying trace context (``"_tc"``, attached by
+    :func:`_request_once` when the CLIENT traces) gets a server-side
+    handler span ``rpc.<cmd>`` on ``tracer`` whose ``link`` attr names
+    the exact client track+span it serves — recorded only when a
+    response is actually returned, so fault-injected drops stay
+    symmetric (the client records no wire.request span for a failed
+    attempt either) and the chaos causal-integrity check can count on
+    the 1:1 pairing.  Data-plane server timing shipped up via the
+    response's transient ``_srv`` key (round wait + last contributor,
+    ``dataplane.allreduce``) folds into the span's attrs and is
+    stripped from the wire response."""
+    tc = msg.get("_tc") if tracer.on() else None
+    t0 = tracer.begin() if tc is not None else None
+    resp = inner(msg)
+    srv = resp.pop("_srv", None) if isinstance(resp, dict) else None
+    if resp is None or t0 is None:
+        return resp
+    attrs = {"cmd": msg.get("cmd"), "link": list(tc)}
+    if isinstance(srv, dict):
+        attrs.update(srv)
+    tracer.complete_span(f"rpc.{msg.get('cmd')}", t0, attrs)
     return resp
 
 

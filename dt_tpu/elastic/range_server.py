@@ -157,6 +157,16 @@ class RangeServer:
         protocol.serve_connection(conn, self._handle_one)
 
     def _handle_one(self, msg: dict) -> Optional[dict]:
+        """The r13 causal-tracing wrapper (shared with the scheduler —
+        :func:`protocol.traced_handle`): a request carrying trace
+        context gets an ``rpc.<cmd>`` handler span on THIS shard's
+        tracer, linked to the client's wire.request span.  Range-server
+        tracers are per-instance and not merged into the scheduler's
+        job dump (separate processes) — the spans serve the ``stats``
+        introspection path and in-process tests."""
+        return protocol.traced_handle(self._obs, msg, self._handle_inner)
+
+    def _handle_inner(self, msg: dict) -> Optional[dict]:
         """One request on a persistent connection (``None`` = drop)."""
         # the same DT_DROP_MSG transport fuzz as the scheduler —
         # the sharded plane must survive at-least-once retries too
@@ -231,7 +241,11 @@ class RangeServer:
                     # overlap-pipeline rounds served by THIS shard (the
                     # per-bucket accounting of the r10 streaming step)
                     "bucket_rounds": self._obs.get_counter(
-                        "dataplane.bucket_rounds")}
+                        "dataplane.bucket_rounds"),
+                    # this shard's round-lag EWMA view (r13): each shard
+                    # sees the same workers, so per-shard scores agree
+                    # up to per-round noise
+                    "straggler": self._dp.straggler_scores()}
         if cmd == "shutdown":
             self.close()
             return {}

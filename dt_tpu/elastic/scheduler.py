@@ -498,6 +498,13 @@ class Scheduler:
                 self._conns.discard(conn)
 
     def _handle_one(self, msg: dict) -> Optional[dict]:
+        """One request on a persistent connection: the r13 causal-
+        tracing wrapper (``rpc.<cmd>`` handler span linked to the
+        client's wire.request span; shared with the range server —
+        :func:`protocol.traced_handle`) over :meth:`_handle_inner`."""
+        return protocol.traced_handle(self._obs, msg, self._handle_inner)
+
+    def _handle_inner(self, msg: dict) -> Optional[dict]:
         """One request on a persistent connection; ``None`` closes the
         channel without answering (receive-side drop injection — the
         pooled client sees EOF and retries on a fresh channel)."""
@@ -638,7 +645,11 @@ class Scheduler:
                 "counters": {**proc["counters"], **own["counters"]},
                 "dropped": own["dropped"] + proc["dropped"]}
         tracks["control-plane"] = ctrl
-        return {"tracks": tracks}
+        # per-worker straggler scores (round-contribution-lag EWMA, ms)
+        # ride the dump so dtop's live straggler board needs no second
+        # command; the export threads them through otherData
+        return {"tracks": tracks,
+                "straggler": self._dp.straggler_scores()}
 
     def close(self):
         """Shut the service down.  Idempotent, and bounded even when a
@@ -729,11 +740,13 @@ class Scheduler:
             return self._ha_round(msg)
         if cmd == "status":
             with self._lock:
-                return {"active": self._active.is_set(),
-                        "incarnation": self._incarnation,
-                        "workers": list(self._state.workers),
-                        "last_completed_epoch":
-                            self._state.last_completed_epoch}
+                out = {"active": self._active.is_set(),
+                       "incarnation": self._incarnation,
+                       "workers": list(self._state.workers),
+                       "last_completed_epoch":
+                           self._state.last_completed_epoch}
+            out["straggler"] = self._dp.straggler_scores()
+            return out
         if cmd == "profile":
             # rank-0-drives-all profiling (kvstore_dist_server.h:275-322):
             # record the command; every worker picks it up on its next

@@ -2,8 +2,11 @@
 plane (see ``dt_tpu/obs/trace.py`` for the core API and
 ``dt_tpu/obs/export.py`` for the merged chrome://tracing export)."""
 
-from dt_tpu.obs.trace import (Tracer, enabled, flush, register_flush,
-                              set_enabled, tracer, unregister_flush)
+from dt_tpu.obs.names import NAME_REGISTRY
+from dt_tpu.obs.trace import (Tracer, enabled, flush, origin,
+                              register_flush, set_enabled, set_origin,
+                              tracer, unregister_flush)
 
-__all__ = ["Tracer", "enabled", "flush", "register_flush", "set_enabled",
-           "tracer", "unregister_flush"]
+__all__ = ["NAME_REGISTRY", "Tracer", "enabled", "flush", "origin",
+           "register_flush", "set_enabled", "set_origin", "tracer",
+           "unregister_flush"]

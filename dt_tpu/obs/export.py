@@ -14,7 +14,13 @@ and this module renders ONE timeline:
 - :func:`summarize_chrome` — step-time percentiles, stall attribution
   (time under barrier / allreduce / wire spans), per-track retry/fault
   counts, and the membership-change timeline; consumed by
-  ``tools/dtop.py`` and the chaos harness's ``--trace`` checks.
+  ``tools/dtop.py`` and the chaos harness's ``--trace`` checks.  r13
+  adds the causal sections: ``causal`` (client↔server span pairing
+  integrity), ``critical_path`` (per-step decomposition: compute / d2h
+  / send / server queue / straggler-wait attributed to the lagging
+  worker / reply / h2d), and ``straggler`` (the scheduler's per-worker
+  round-lag EWMA board) — the cross-process join ps-lite never had
+  (``PS_VERBOSE`` per-node logging was its ceiling).
 - :func:`write` — chrome trace to ``PATH`` and the metrics/summary
   snapshot to ``PATH`` with a ``.metrics.json`` suffix.
 
@@ -54,17 +60,37 @@ PIPELINE_PREFIX = "pipeline."
 
 
 def chrome_trace(job: Dict[str, Any]) -> Dict[str, Any]:
-    """Render a job dump into one chrome://tracing JSON object."""
+    """Render a job dump into one chrome://tracing JSON object.
+
+    r13 causal join: spans carry ids (``span_id`` slot of the record
+    schema), and server-side handler spans name the client span they
+    serve in an ``attrs["link"] = [origin_track, span_id]`` pair — for
+    every such pair whose source span is present, a chrome flow
+    (``ph: "s"`` on the client span → ``ph: "f"`` on the handler span,
+    id ``"<origin>:<sid>"``) is emitted, so Perfetto draws the arrow
+    from each ``wire.request`` to the server work it caused."""
     events: List[dict] = []
     other: Dict[str, Any] = {"tracks": {}}
-    for pid, (track, data) in enumerate(sorted(
-            (job.get("tracks") or {}).items()), start=1):
+    if "straggler" in job:
+        other["straggler"] = dict(job["straggler"] or {})
+    # pass 1: index every id-carrying span by (track, sid) so pass 2 can
+    # bind flow starts to the exact client slice
+    span_at: Dict[tuple, dict] = {}
+    ordered = sorted((job.get("tracks") or {}).items())
+    for pid, (track, data) in enumerate(ordered, start=1):
+        for rec in data.get("records", ()):
+            if rec[0] == "X" and rec[6] is not None:
+                span_at[(track, rec[6])] = {"pid": pid, "tid": rec[5],
+                                            "ts": rec[3], "dur": rec[4]}
+    for pid, (track, data) in enumerate(ordered, start=1):
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0, "args": {"name": track}})
         for rec in data.get("records", ()):
             ph, rseq, name, ts_us, dur_us, tid, sid, parent, attrs = rec
             args = dict(attrs or {})
             args["seq"] = rseq
+            if sid is not None:
+                args["sid"] = sid
             if parent is not None:
                 args["parent"] = parent
             ev = {"ph": "X" if ph == "X" else "i", "name": name,
@@ -75,11 +101,168 @@ def chrome_trace(job: Dict[str, Any]) -> Dict[str, Any]:
             else:
                 ev["s"] = "t"
             events.append(ev)
+            link = (attrs or {}).get("link")
+            if ph == "X" and isinstance(link, (list, tuple)) \
+                    and len(link) == 2:
+                src = span_at.get((link[0], link[1]))
+                if src is not None:
+                    fid = f"{link[0]}:{link[1]}"
+                    events.append({"ph": "s", "id": fid, "cat": "rpc",
+                                   "name": "rpc", "pid": src["pid"],
+                                   "tid": src["tid"], "ts": src["ts"]})
+                    events.append({"ph": "f", "bp": "e", "id": fid,
+                                   "cat": "rpc", "name": "rpc",
+                                   "pid": pid, "tid": tid, "ts": ts_us})
         other["tracks"][track] = {
             "counters": dict(data.get("counters") or {}),
             "dropped": int(data.get("dropped") or 0)}
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": other}
+
+
+#: per-track per-step entries kept in the critical-path section; later
+#: steps are aggregated into the totals but not listed (bounds the
+#: .metrics.json size on long runs — the truncation is flagged)
+_MAX_PER_STEP = 512
+
+
+def _causal_and_critical(chrome: Dict[str, Any],
+                         track_of_pid: Dict[int, str]) -> Dict[str, Any]:
+    """Causal-integrity counts + the per-step critical-path
+    decomposition (r13).
+
+    Causal: every client ``wire.request`` span carries its ``sid``;
+    every server handler span (``rpc.<cmd>``) carries
+    ``link=[origin_track, sid]``.  A client span is *matched* when
+    exactly one handler span links to it; *orphans* (answered requests
+    whose handler span is missing) are bounded by the server-side ring
+    ``dropped`` counters, and *server_unmatched* handler spans arise
+    when the client's span was lost (its ring/pending shed) or the
+    client never got the reply (reset-fault replay windows).
+
+    Critical path: for each worker track's ``step`` span, the step's
+    wall-clock is decomposed into compute (step minus blocking sync
+    spans) + the sync pipeline's stages: ``d2h`` / ``h2d`` (staging
+    spans), and — per linked allreduce ``wire.request`` — client→server
+    ``send``, server-side ``straggler_wait`` (the round's
+    wait-for-last-contributor window this request sat through,
+    attributed to the round's ``last`` contributor), the remaining
+    server ``queue`` time, and ``reply``.  Stage spans run concurrently
+    across buckets, so the stage sums can exceed the step wall-clock
+    exactly when the overlap pipeline is working — same convention as
+    the ``pipeline_ms`` split."""
+    client: Dict[tuple, dict] = {}    # (track, sid) -> wire.request span
+    handlers: Dict[tuple, list] = {}  # link key -> [handler spans]
+    per_track: Dict[str, dict] = {}
+    for ev in chrome.get("traceEvents", ()):
+        if ev.get("ph") != "X":
+            continue
+        track = track_of_pid.get(ev.get("pid"), f"pid{ev.get('pid')}")
+        name = ev.get("name", "")
+        args = ev.get("args") or {}
+        tr = per_track.setdefault(track, {"steps": [], "d2h": [],
+                                          "h2d": [], "stall": [],
+                                          "wire": []})
+        if name == "step":
+            tr["steps"].append(ev)
+        elif name == "pipeline.d2h":
+            tr["d2h"].append(ev)
+        elif name == "pipeline.h2d":
+            tr["h2d"].append(ev)
+        elif name in STALL_SPANS:
+            tr["stall"].append(ev)
+        elif name == "wire.request":
+            tr["wire"].append(ev)
+            if args.get("sid") is not None:
+                client[(track, args["sid"])] = ev
+        elif name.startswith("rpc."):
+            link = args.get("link")
+            if isinstance(link, (list, tuple)) and len(link) == 2:
+                handlers.setdefault((link[0], link[1]), []).append(ev)
+
+    matched = sum(1 for k in client if len(handlers.get(k, ())) == 1)
+    multi = sum(1 for k in client if len(handlers.get(k, ())) > 1)
+    server_unmatched = sum(len(v) for k, v in handlers.items()
+                           if k not in client)
+    causal = {"client_spans": len(client), "matched": matched,
+              "orphans": len(client) - matched - multi,
+              "multi_linked": multi,
+              "server_spans": sum(len(v) for v in handlers.values()),
+              "server_unmatched": server_unmatched}
+
+    def in_window(ev, t0, t1):
+        return t0 <= ev.get("ts", 0) < t1
+
+    critical: Dict[str, Any] = {}
+    for track, tr in sorted(per_track.items()):
+        if not tr["steps"]:
+            continue
+        totals = {"compute_ms": 0.0, "d2h_ms": 0.0, "send_ms": 0.0,
+                  "server_queue_ms": 0.0, "straggler_wait_ms": 0.0,
+                  "reply_ms": 0.0, "h2d_ms": 0.0}
+        by_worker: Dict[str, float] = {}
+        per_step: List[dict] = []
+        for st in sorted(tr["steps"], key=lambda e: e.get("ts", 0)):
+            t0, dur = st.get("ts", 0), st.get("dur", 0)
+            t1 = t0 + dur
+            row = {"ts": t0, "step_ms": round(dur / 1000.0, 3),
+                   "compute_ms": 0.0, "d2h_ms": 0.0, "send_ms": 0.0,
+                   "server_queue_ms": 0.0, "straggler_wait_ms": 0.0,
+                   "reply_ms": 0.0, "h2d_ms": 0.0}
+            stall_us = sum(e.get("dur", 0) for e in tr["stall"]
+                           if in_window(e, t0, t1))
+            row["compute_ms"] = round(max(dur - stall_us, 0) / 1000.0, 3)
+            row["d2h_ms"] = round(sum(
+                e.get("dur", 0) for e in tr["d2h"]
+                if in_window(e, t0, t1)) / 1000.0, 3)
+            row["h2d_ms"] = round(sum(
+                e.get("dur", 0) for e in tr["h2d"]
+                if in_window(e, t0, t1)) / 1000.0, 3)
+            for r in tr["wire"]:
+                args = r.get("args") or {}
+                if args.get("cmd") != "allreduce" or \
+                        not in_window(r, t0, t1):
+                    continue
+                hs = handlers.get((track, args.get("sid")))
+                if not hs or len(hs) != 1:
+                    continue
+                h = hs[0]
+                hargs = h.get("args") or {}
+                wait = float(hargs.get("wait_ms") or 0.0)
+                hdur = h.get("dur", 0) / 1000.0
+                row["send_ms"] += max(h.get("ts", 0) - r.get("ts", 0),
+                                      0) / 1000.0
+                row["reply_ms"] += max(
+                    (r.get("ts", 0) + r.get("dur", 0))
+                    - (h.get("ts", 0) + h.get("dur", 0)), 0) / 1000.0
+                row["straggler_wait_ms"] += wait
+                row["server_queue_ms"] += max(hdur - wait, 0.0)
+                last = hargs.get("last")
+                if last and wait > 0:
+                    by_worker[last] = by_worker.get(last, 0.0) + wait
+            for k in totals:
+                v = round(row[k], 3)
+                row[k] = v
+                totals[k] += v
+            if len(per_step) < _MAX_PER_STEP:
+                per_step.append(row)
+        critical[track] = {
+            "steps": len(tr["steps"]),
+            "totals": {k: round(v, 3) for k, v in sorted(totals.items())},
+            "straggler_wait_by_worker": {
+                k: round(v, 3) for k, v in sorted(by_worker.items())},
+            "per_step": per_step,
+            "per_step_truncated": len(tr["steps"]) > _MAX_PER_STEP}
+    # job-wide blame fold (the one consumers rank on — dtop's
+    # attribution line and the chaos straggler check read this instead
+    # of re-aggregating the per-track maps)
+    blame: Dict[str, float] = {}
+    for cp in critical.values():
+        for h, v in cp["straggler_wait_by_worker"].items():
+            blame[h] = blame.get(h, 0.0) + v
+    return {"causal": causal, "critical_path": critical,
+            "straggler_blame": {k: round(v, 3)
+                                for k, v in sorted(blame.items())}}
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -105,8 +288,8 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
     leadership: List[dict] = []
     total_faults = 0
     for ev in chrome.get("traceEvents", ()):
-        if ev.get("ph") == "M":
-            continue
+        if ev.get("ph") in ("M", "s", "f", "t"):
+            continue  # metadata + the r13 causal flow arrows
         track = track_of_pid.get(ev.get("pid"), f"pid{ev.get('pid')}")
         tr = tracks.setdefault(track, {"steps_ms": [], "stall_ms": {},
                                        "pipeline_ms": {}, "faults": {},
@@ -184,12 +367,17 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
                 "retries": (m.get("counters") or {}).get("wire.retries", 0),
                 "counters": dict(m.get("counters") or {}),
                 "dropped": m.get("dropped", 0), "spans": 0, "events": 0}
-    return {"tracks": out_tracks,
-            "membership_changes": sorted(membership,
-                                         key=lambda m: m.get("ts") or 0),
-            "failovers": sorted(failovers, key=lambda m: m.get("ts") or 0),
-            "leadership": sorted(leadership, key=lambda m: m.get("ts") or 0),
-            "total_fault_events": total_faults}
+    out = {"tracks": out_tracks,
+           "membership_changes": sorted(membership,
+                                        key=lambda m: m.get("ts") or 0),
+           "failovers": sorted(failovers, key=lambda m: m.get("ts") or 0),
+           "leadership": sorted(leadership,
+                                key=lambda m: m.get("ts") or 0),
+           "total_fault_events": total_faults,
+           "straggler": dict((chrome.get("otherData") or {})
+                             .get("straggler") or {})}
+    out.update(_causal_and_critical(chrome, track_of_pid))
+    return out
 
 
 def metrics_path(trace_path: str) -> str:
@@ -199,11 +387,14 @@ def metrics_path(trace_path: str) -> str:
 
 def write(trace_path: str, job: Dict[str, Any]) -> Dict[str, Any]:
     """Write the merged chrome trace to ``trace_path`` and the metrics/
-    summary snapshot next to it; returns the summary."""
+    summary snapshot next to it; returns the summary.  Byte-
+    deterministic: two writes of the same dump produce identical files
+    (``sort_keys`` + the summarizer's own sorted sections) — diffs of
+    committed metrics files mean the DATA changed."""
     chrome = chrome_trace(job)
     with open(trace_path, "w") as f:
-        json.dump(chrome, f)
+        json.dump(chrome, f, sort_keys=True)
     summary = summarize_chrome(chrome)
     with open(metrics_path(trace_path), "w") as f:
-        json.dump(summary, f, indent=2)
+        json.dump(summary, f, indent=2, sort_keys=True)
     return summary

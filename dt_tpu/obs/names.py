@@ -1,0 +1,114 @@
+"""Span / event / counter name catalog — the single declaration point for
+every ``dt_tpu.obs`` instrumentation name, mirroring
+``dt_tpu.config.ENV_REGISTRY`` (the role ps-lite's one GetEnv block
+played for env vars, ``ps-lite/src/postoffice.cc:18-31``; the reference
+had no name discipline at all — profiler scopes were free-form strings,
+``src/profiler/profiler.h:256``).
+
+dtlint rule DT011 enforces it: a ``span``/``complete_span``/``event``/
+``counter`` call anywhere in the linted tree with a literal name must
+have a row here, and every row must still have an emitter (dead names
+rot into cargo-cult dashboards).  Names ending in ``*`` are prefix
+entries for the few dynamically-suffixed families (``fault.<kind>``,
+``membership.<ACTION>``, ``rpc.<cmd>``); an f-string call site matches
+by its literal prefix.
+
+Values are ``(kind, doc)`` where kind is ``span`` / ``event`` /
+``counter`` (a ``|``-separated union when one name is legitimately both,
+e.g. ``client.failover``).  Tools consume this table too: the export's
+stall/pipeline classification and dtop's sections are built from names
+declared here, so a renamed span fails the lint instead of silently
+vanishing from the dashboards.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Tuple
+
+NAME_REGISTRY: Mapping[str, Tuple[str, str]] = {
+    # -- training plane (training/module.py, trainer.py) -------------------
+    "step": ("span", "one training step (fwd+bwd+sync+update), worker track"),
+    "epoch": ("span", "one training epoch (Module.fit)"),
+    "eval": ("span", "one evaluation pass (Module.score)"),
+    "trainer.step": ("span", "one Trainer.step (low-level training loop)"),
+    # -- worker client (elastic/client.py) ---------------------------------
+    "mc_barrier": ("span", "client side of the membership-change barrier"),
+    "allreduce": ("span", "one top-level exact-average round (serial or "
+                          "pipelined wall-clock)"),
+    "allreduce_sparse": ("span", "one row-sparse exact-average round"),
+    "recovery.rejoin": ("span", "crash-recovery re-admission wait"),
+    "allreduce.chunked": ("event", "a round split into chunk sub-rounds"),
+    "client.failover": ("event|counter", "scheduler endpoint rotation"),
+    "client.reattached": ("event", "re-registered under a new leader fence"),
+    "heartbeat.sent": ("counter", "heartbeats issued by this worker"),
+    "allreduce.rounds": ("counter", "top-level allreduce rounds"),
+    "profiler.posts": ("counter", "remote profiler commands posted"),
+    # -- wire (elastic/protocol.py) ----------------------------------------
+    "wire.request": ("span", "one request/response attempt on a pooled "
+                             "channel; carries the propagated span id"),
+    "wire.retry": ("event", "an at-least-once retry (with backoff)"),
+    "wire.retries": ("counter", "total transport retries"),
+    "wire.bytes_sent": ("counter", "frame bytes written (all frames)"),
+    "wire.bytes_recv": ("counter", "frame bytes received (all frames)"),
+    # -- scheduler control plane (elastic/scheduler.py) --------------------
+    "rpc.*": ("span", "server-side handler span, one per served request "
+                      "that carried trace context (rpc.<cmd>)"),
+    "mc_barrier.window": ("span", "barrier window: first arrival → release"),
+    "membership_change": ("span", "one applied membership change"),
+    "scheduler.failover": ("span", "warm-standby takeover (docs/ha.md)"),
+    "membership.*": ("event", "audit-line events (membership.ADDED / "
+                              "REMOVED / RECOVERED)"),
+    "recovery.registered": ("event", "a crashed worker re-registered"),
+    "leader.elected": ("event", "leadership assumed (start or takeover)"),
+    "leader.fenced": ("event", "this leader was deposed by a newer fence"),
+    "transport.connections": ("counter", "accepted control connections"),
+    "transport.requests": ("counter", "control requests served"),
+    "tokens.dedup_hits": ("counter", "idempotency-token replays served "
+                                     "from cache"),
+    "ha.rounds_replicated": ("counter", "completed rounds installed from "
+                                        "the live primary"),
+    # -- data plane (elastic/dataplane.py, range_server.py) ----------------
+    "dataplane.round": ("span", "one allreduce round: first contribution "
+                                "→ completion; attrs carry the last "
+                                "(straggling) contributor + wait_ms"),
+    "dataplane.survivor_complete": ("event", "round finished by survivors "
+                                             "after membership shrank"),
+    "worker.straggler": ("event", "a worker's round-lag EWMA crossed "
+                                  "DT_STRAGGLER_MS"),
+    "dataplane.rounds": ("counter", "completed allreduce rounds"),
+    "dataplane.bucket_rounds": ("counter", "overlap-pipeline bucket rounds "
+                                           "(key#b<i>)"),
+    "data.bytes_in": ("counter", "range-server data-plane bytes received"),
+    "data.requests": ("counter", "range-server data-plane requests"),
+    # -- overlap pipeline (training/overlap.py, client AllreducePipeline) --
+    "pipeline.d2h": ("span", "one bucket's device→host staging"),
+    "pipeline.wire": ("span", "one bucket's wire round (comm thread)"),
+    "pipeline.h2d": ("span", "one bucket's host→device dispatch"),
+    "pipeline.buckets": ("counter", "bucket rounds pushed through the "
+                                    "overlap pipeline"),
+    "pipeline.aux_rounds": ("counter", "aux rounds ridden on the pipeline "
+                                       "window (e.g. stats)"),
+    # -- fault injection (elastic/faults.py) -------------------------------
+    "fault.*": ("event", "every APPLIED fault (fault.<kind>); the chaos "
+                         "harness cross-checks these against "
+                         "applied_summary()"),
+}
+
+
+def lookup(name: str) -> Tuple[str, str, str]:
+    """Resolve ``name`` against the registry: exact row first, then the
+    longest matching prefix row.  Returns ``(matched_key, kind, doc)``;
+    raises ``KeyError`` for unregistered names (the runtime counterpart
+    of dtlint DT011)."""
+    row = NAME_REGISTRY.get(name)
+    if row is not None:
+        return (name, row[0], row[1])
+    best = None
+    for key, (kind, doc) in NAME_REGISTRY.items():
+        if key.endswith("*") and name.startswith(key[:-1]):
+            if best is None or len(key) > len(best[0]):
+                best = (key, kind, doc)
+    if best is None:
+        raise KeyError(f"{name!r} is not declared in "
+                       f"dt_tpu.obs.names.NAME_REGISTRY (dtlint DT011)")
+    return best

@@ -81,6 +81,31 @@ def set_enabled(on: Optional[bool]) -> None:
 
 
 # ---------------------------------------------------------------------------
+# trace origin (r13 causal tracing): the track name this process's records
+# will appear under in the merged job dump.  WorkerClient sets it to its
+# "host#incarnation" track key at construction; everything else (the
+# in-process scheduler, tools) defaults to the control-plane track —
+# matching how Scheduler.obs_dump merges the process tracer.  The origin
+# rides the wire as half of the trace context (protocol.request "_tc"),
+# so a server-side handler span can name the exact client track+span it
+# serves and the export can join the two with chrome flow events.
+# ---------------------------------------------------------------------------
+
+_ORIGIN: Optional[str] = None
+
+
+def set_origin(origin: Optional[str]) -> None:
+    """Name this process's trace track (``None`` = back to the default)."""
+    global _ORIGIN
+    _ORIGIN = origin or None
+
+
+def origin() -> str:
+    """This process's track name for cross-process trace context."""
+    return _ORIGIN or "control-plane"
+
+
+# ---------------------------------------------------------------------------
 # flush hooks (crash-path export: a worker about to os._exit pushes its
 # buffered records to the scheduler so injected crashes still appear on
 # the job timeline — registered by WorkerClient)
@@ -230,15 +255,31 @@ class Tracer:
             return None
         return (self._wall(), self._mono())
 
-    def complete_span(self, name: str, t0: Optional[Tuple[int, int]],
+    def begin(self) -> Optional[Tuple[int, int, int]]:
+        """Like :meth:`now`, but also pre-allocates the span's id —
+        ``(wall_ns, mono_ns, span_id)`` — so the id can be propagated
+        (e.g. over the wire as trace context) BEFORE the span completes.
+        ``None`` when tracing is off: the disabled path allocates
+        nothing, exactly like :meth:`now`."""
+        if not self.on():
+            return None
+        return (self._wall(), self._mono(), self._next_seq())
+
+    def complete_span(self, name: str,
+                      t0: Optional[Tuple[int, ...]],
                       attrs: Optional[dict] = None) -> None:
-        """Record a span begun at ``t0`` (= :meth:`now`); no-op on
-        ``None`` (tracing was off when the span would have started)."""
+        """Record a span begun at ``t0`` (= :meth:`now` or
+        :meth:`begin`); no-op on ``None`` (tracing was off when the span
+        would have started).  A :meth:`begin` token's pre-allocated id
+        becomes the record's ``span_id`` — the export's cross-process
+        flow-join key."""
         if t0 is None or not self.on():
             return
         dur_us = max(self._mono() - t0[1], 0) // 1000
         self._push(("X", None, name, t0[0] // 1000, dur_us,
-                    threading.get_ident(), None, self._ctx.get(), attrs))
+                    threading.get_ident(),
+                    t0[2] if len(t0) > 2 else None,
+                    self._ctx.get(), attrs))
 
     def event(self, name: str, attrs: Optional[dict] = None) -> None:
         """Instant ("i") event, attached to the enclosing span if any."""

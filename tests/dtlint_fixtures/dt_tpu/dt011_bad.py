@@ -1,0 +1,11 @@
+"""DT011 fixture (bad): unregistered obs names and a kind mismatch."""
+from dt_tpu.obs import trace as obs_trace
+
+
+def emit(kind):
+    tr = obs_trace.tracer()
+    tr.counter("not.registered")              # no registry row
+    with tr.span("unknown.span"):             # no registry row
+        pass
+    tr.event(f"mystery.{kind}")               # prefix matches nothing
+    tr.complete_span("good.count", tr.now())  # registered as a counter

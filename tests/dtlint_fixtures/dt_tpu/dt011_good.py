@@ -1,0 +1,13 @@
+"""DT011 fixture (good): registered names, a prefix-family f-string,
+and a fully dynamic name (out of scope by design)."""
+from dt_tpu.obs import trace as obs_trace
+
+
+def emit(kind, dynamic_name):
+    tr = obs_trace.tracer()
+    tr.counter("good.count")
+    with tr.span("good.span"):
+        pass
+    tr.event(f"fault.{kind}")      # matches the fault.* prefix row
+    tr.event(dynamic_name)         # dynamic: out of DT011's scope
+    tr.get_counter("anything")     # read-side accessor: not an emission

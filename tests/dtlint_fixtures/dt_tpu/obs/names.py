@@ -1,0 +1,10 @@
+"""DT011 fixture catalog (stands in for dt_tpu/obs/names.py when the
+fixture tree is linted as its own root; reference analog: the free-form
+profiler scope strings of ``src/profiler/profiler.h:256`` that nothing
+audited)."""
+
+NAME_REGISTRY = {
+    "good.span": ("span", "a declared span the good fixture emits"),
+    "good.count": ("counter", "a declared counter"),
+    "fault.*": ("event", "a declared prefix family"),
+}

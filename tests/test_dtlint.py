@@ -69,6 +69,7 @@ _PAIRS = [
     ("DT008", "dt_tpu/dt008_bad.py", "dt_tpu/dt008_good.py"),
     ("DT009", "dt_tpu/dt009_bad.py", "dt_tpu/dt009_good.py"),
     ("DT010", "dt_tpu/dt010_bad.py", "dt_tpu/dt010_good.py"),
+    ("DT011", "dt_tpu/dt011_bad.py", "dt_tpu/dt011_good.py"),
 ]
 
 
@@ -606,7 +607,7 @@ def test_baseline_requires_reason(tmp_path):
 def test_rule_ids_unique_and_documented():
     rules = all_rules()
     ids = [r.id for r in rules]
-    assert len(set(ids)) == len(ids) == 10
+    assert len(set(ids)) == len(ids) == 11
     catalog = open(os.path.join(ROOT, "docs", "dtlint_rules.md")).read()
     for r in rules:
         assert r.id in catalog, f"{r.id} missing from docs/dtlint_rules.md"

@@ -63,6 +63,12 @@ WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
 HOSTS = ["w0", "w1", "w2"]
 CRASH_HOST = "w2"
 CRASH_EPOCH = 3
+#: the worker the seeded per-host data-plane delay targets (r13): its
+#: allreduce contributions run late, so the critical-path metrics must
+#: attribute the fleet's straggler-wait to THIS host's track — the
+#: causal-attribution acceptance check of the cross-process tracing
+STRAGGLE_HOST = "w1"
+STRAGGLE_DELAY_S = 0.15
 
 #: scheduler-kill sites per HA plan (rule kwargs for the one crash rule
 #: the PRIMARY scheduler process loads via DT_FAULT_PLAN).  The `after`
@@ -102,6 +108,12 @@ def _plans(num_epoch):
         FaultRule("dup", op="send", cmd="mc_barrier", prob=0.5),
         FaultRule("delay", op="send", cmd="mc_barrier", prob=0.3,
                   delay_s=0.1),
+        # the r13 straggler probe: one specific worker's data-plane
+        # sends run late, so --trace can assert the critical-path
+        # metrics attribute the fleet's straggler-wait to THAT track
+        FaultRule("delay", op="send", cmd="allreduce",
+                  host=STRAGGLE_HOST, prob=0.5,
+                  delay_s=STRAGGLE_DELAY_S),
     ]
     crash = [FaultRule("crash", site="module.epoch_begin", host=CRASH_HOST,
                        epoch=CRASH_EPOCH, action="exit")]
@@ -412,6 +424,48 @@ def main():
                 pipeline_buckets == 0 if serial_requested
                 else pipeline_buckets > 0)
 
+            # r13 causal integrity: every client wire.request span that
+            # got a reply must resolve to exactly ONE server-side
+            # handler span; orphans are legitimate only when a span ring
+            # shed records (the control-plane dropped counter bounds the
+            # handler spans that can be missing).  On the HA plans the
+            # pre-kill handler spans died with the primary process, so
+            # the pairing is asserted on the post-failover traffic only.
+            causal = summary.get("causal", {})
+            ctrl_dropped = tracks.get("control-plane", {}).get(
+                "dropped", 0)
+            worker_dropped = sum(tracks[t].get("dropped", 0)
+                                 for t in worker_tracks)
+            if ha_plan:
+                checks["trace_causal"] = causal.get("matched", 0) > 0
+            else:
+                checks["trace_causal"] = (
+                    causal.get("client_spans", 0) > 0
+                    and causal.get("multi_linked", 0) == 0
+                    and (causal.get("orphans", 0) == 0
+                         or (ctrl_dropped > 0
+                             and causal.get("orphans", 0)
+                             <= ctrl_dropped + worker_dropped)))
+
+            # r13 straggler attribution: the seeded per-host delay on
+            # STRAGGLE_HOST's allreduce sends must surface as
+            # straggler-wait attributed to THAT worker — both on the
+            # scheduler's EWMA board and in the critical-path
+            # decomposition's blame column (when any linked rounds
+            # survived the rings)
+            has_probe = any(r.kind == "delay" and r.cmd
+                            and "allreduce" in r.cmd and r.host
+                            for r in worker_rules)
+            if has_probe:
+                board = summary.get("straggler", {})
+                board_top = max(board, key=board.get) if board else None
+                blame = summary.get("straggler_blame", {})
+                blame_top = max(blame, key=blame.get) if blame else None
+                checks["trace_straggler_attributed"] = (
+                    board_top == STRAGGLE_HOST
+                    and (blame_top is None
+                         or blame_top == STRAGGLE_HOST))
+
         ok = bool(checks) and all(checks.values())
         print(json.dumps({
             "ok": ok, "plan": args.plan, "seed": args.seed,
@@ -421,6 +475,8 @@ def main():
             "leader_incarnation": sched.incarnation if ha_plan else None,
             "pipeline_buckets":
                 pipeline_buckets if summary else None,
+            "causal": summary.get("causal") if summary else None,
+            "straggler": summary.get("straggler") if summary else None,
             "transport": tstats,
             "final_loss": {h: r.get("final_loss")
                            for h, r in results.items()},

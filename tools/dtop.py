@@ -1,18 +1,29 @@
 #!/usr/bin/env python
 """dtop — terminal summary of a dt_tpu.obs job timeline.
 
-Renders step-time percentiles, stall attribution, per-worker retry/fault
-counts, and the membership-change timeline from either a merged chrome
-trace written by ``dt_tpu.obs.export`` (e.g. ``tools/chaos_run.py
---trace out.json``) or a LIVE scheduler (the ``obs_dump`` control
-command — the job-level counterpart of the reference's remote profiler
-dump, ``kvstore_dist_server.h:275-322``).
+Renders step-time percentiles, stall attribution, the r13 critical-path
+split (compute / d2h / send / server queue / straggler-wait / reply /
+h2d), the straggler board, per-worker retry/fault counts, and the
+membership/leadership timeline from either a merged chrome trace
+written by ``dt_tpu.obs.export`` (e.g. ``tools/chaos_run.py --trace
+out.json``) or a LIVE scheduler (the ``obs_dump`` control command — the
+job-level counterpart of the reference's remote profiler dump,
+``kvstore_dist_server.h:275-322``).
 
 Usage::
 
     python tools/dtop.py /tmp/trace.json
     python tools/dtop.py --scheduler 127.0.0.1:9091
+    python tools/dtop.py --scheduler 127.0.0.1:9091 --follow   # live
+    python tools/dtop.py /tmp/trace.json --critical-path 3     # one step
     python tools/dtop.py /tmp/trace.json --json   # machine-readable
+
+``--follow`` polls ``obs_dump`` every ``--interval`` seconds and
+re-renders a compact live board (step rate since the previous poll,
+critical-path split, straggler board, membership/leadership events);
+``--iterations`` bounds the loop (0 = until interrupted — tests run one
+cycle).  ``--critical-path N`` drills into step N's decomposition on
+every worker track.
 
 jax-free: loads only ``dt_tpu.obs.export`` (and the wire protocol for
 ``--scheduler``).
@@ -22,6 +33,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
@@ -110,6 +122,41 @@ def render(summary) -> str:
             if f:
                 parts = "  ".join(f"{k}={v}" for k, v in sorted(f.items()))
                 lines.append(f"  {name:<20}{parts}")
+    # r13 critical path: where each worker's step time actually went —
+    # decomposed via the cross-process span join (docs/observability.md)
+    cp = summary.get("critical_path", {})
+    if cp:
+        lines.append("")
+        lines.append("critical path (ms, totals over steps; stage spans "
+                     "overlap, so sums can exceed step wall-clock):")
+        for name in sorted(cp):
+            t = cp[name]["totals"]
+            lines.append(
+                f"  {name:<20}compute={t['compute_ms']:.1f}  "
+                f"d2h={t['d2h_ms']:.1f}  send={t['send_ms']:.1f}  "
+                f"queue={t['server_queue_ms']:.1f}  "
+                f"straggler={t['straggler_wait_ms']:.1f}  "
+                f"reply={t['reply_ms']:.1f}  h2d={t['h2d_ms']:.1f}")
+        blame = summary.get("straggler_blame", {})
+        if blame:
+            lines.append("  straggler-wait attribution (ms): " + "  ".join(
+                f"{h}={v:.1f}" for h, v in
+                sorted(blame.items(), key=lambda kv: -kv[1])))
+    # straggler board: the scheduler's live round-lag EWMA per worker
+    stragglers = summary.get("straggler", {})
+    if stragglers:
+        lines.append("")
+        lines.append("straggler board (round-lag EWMA ms):")
+        for h, v in sorted(stragglers.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {h:<20}{v:10.1f}")
+    causal = summary.get("causal", {})
+    if causal.get("client_spans"):
+        lines.append("")
+        lines.append(
+            f"causal join: {causal['matched']}/{causal['client_spans']} "
+            f"client requests linked to server spans "
+            f"({causal['orphans']} orphaned, "
+            f"{causal['server_unmatched']} server-only)")
     mem = summary.get("membership_changes", [])
     lines.append("")
     lines.append(f"membership changes: {len(mem)}")
@@ -136,6 +183,71 @@ def render(summary) -> str:
     return "\n".join(lines)
 
 
+def render_critical_step(summary, step: int) -> str:
+    """One step's critical-path decomposition across every worker track
+    (the ``--critical-path N`` drill-down).  ``step`` indexes each
+    track's OWN recorded step sequence (a restarted worker's fresh
+    incarnation counts from 0 again), so rows across tracks correspond
+    only while membership is stable — compare per track, not across a
+    crash boundary."""
+    lines = [f"critical path, step {step} (ms; per-track step index — "
+             "a restarted incarnation recounts from 0):"]
+    cp = summary.get("critical_path", {})
+    if not cp:
+        return "no critical-path data (run with DT_OBS=1 and step spans)"
+    cols = ("step_ms", "compute_ms", "d2h_ms", "send_ms",
+            "server_queue_ms", "straggler_wait_ms", "reply_ms", "h2d_ms")
+    heads = ("step", "compute", "d2h", "send", "queue", "straggler",
+             "reply", "h2d")
+    lines.append(f"{'track':<22}" + "".join(f"{h:>11}" for h in heads))
+    for name in sorted(cp):
+        steps = cp[name].get("per_step", [])
+        if step >= len(steps):
+            lines.append(f"{name:<22}  (no step {step}; track has "
+                         f"{len(steps)} listed)")
+            continue
+        row = steps[step]
+        lines.append(f"{name:<22}" + "".join(
+            f"{row[c]:>11.1f}" for c in cols))
+    return "\n".join(lines)
+
+
+def _follow(args) -> int:
+    """Live mode: poll the scheduler's ``obs_dump`` and re-render a
+    compact board each cycle.  The step RATE is computed from the delta
+    of per-track step counts between polls — the number an operator
+    watches during a resize or failover."""
+    from dt_tpu.obs import export as obs_export
+    prev_counts = {}
+    prev_t = None
+    n = 0
+    while True:
+        chrome = _load_chrome(args)
+        summary = obs_export.summarize_chrome(chrome)
+        now = time.monotonic()
+        counts = {t: d["steps"]["count"]
+                  for t, d in summary.get("tracks", {}).items()}
+        rate_parts = []
+        if prev_t is not None and now > prev_t:
+            dt = now - prev_t
+            for t in sorted(counts):
+                if t == "control-plane":
+                    continue
+                d = counts[t] - prev_counts.get(t, 0)
+                rate_parts.append(f"{t}={d / dt:.2f}/s")
+        prev_counts, prev_t = counts, now
+        print(f"=== dtop --follow poll {n + 1} "
+              f"[{time.strftime('%H:%M:%S')}] ===")
+        if rate_parts:
+            print("step rate: " + "  ".join(rate_parts))
+        print(render(summary))
+        sys.stdout.flush()
+        n += 1
+        if args.iterations and n >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="dtop", description=__doc__,
@@ -146,13 +258,37 @@ def main(argv=None):
                     help="live scheduler host:port (obs_dump)")
     ap.add_argument("--json", action="store_true",
                     help="print the summary dict instead of the table")
+    ap.add_argument("--follow", action="store_true",
+                    help="live mode: poll --scheduler periodically and "
+                         "re-render (step rate, critical path, "
+                         "straggler board, membership/leadership)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll period in seconds (default 2)")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="stop --follow after N polls (0 = forever)")
+    ap.add_argument("--critical-path", type=int, default=None,
+                    metavar="STEP",
+                    help="drill into step STEP's critical-path "
+                         "decomposition on every worker track (STEP "
+                         "indexes each track's own recorded steps; a "
+                         "restarted incarnation recounts from 0)")
     args = ap.parse_args(argv)
+
+    if args.follow:
+        if not args.scheduler:
+            raise SystemExit("--follow needs --scheduler host:port")
+        try:
+            return _follow(args)
+        except KeyboardInterrupt:
+            return 0
 
     from dt_tpu.obs import export as obs_export
     chrome = _load_chrome(args)
     summary = obs_export.summarize_chrome(chrome)
     if args.json:
         print(json.dumps(summary, indent=2))
+    elif args.critical_path is not None:
+        print(render_critical_step(summary, args.critical_path))
     else:
         print(render(summary))
     return 0
