@@ -20,9 +20,10 @@ and, when the budget runs out first, LEAVES IT RUNNING as an orphan (it
 either succeeds late — its tier rows still land in the committed jsonl —
 or fails cleanly; round-5 probes show a hung init returns UNAVAILABLE on
 its own after ~25 min).  Clean failures retry with a short backoff.  The
-XLA persistent compile cache is enabled (``DT_COMPILE_CACHE``, defaulted
-next to this file) so ResNet-152's multi-minute first compile is paid
-once per image, not once per round.
+XLA persistent compile cache is enabled (``DT_JAX_CACHE_DIR``, defaulted
+next to this file; ``DT_COMPILE_CACHE`` remains the back-compat alias)
+so ResNet-152's multi-minute first compile is paid once per image, not
+once per round.
 """
 
 import json
@@ -76,9 +77,15 @@ def _emit_failure(err):
 
 def _child_env():
     env = dict(os.environ)
-    env.setdefault("DT_COMPILE_CACHE",
-                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".xla_cache"))
+    # persistent jax compilation cache (ROADMAP item 5 capture
+    # discipline): preflight retries and measurement re-runs after a
+    # wedged tunnel re-hit compiled programs instead of paying the
+    # multi-minute ResNet-152 compile again.  DT_JAX_CACHE_DIR is the
+    # registered knob (config.enable_compilation_cache reads it first);
+    # DT_COMPILE_CACHE remains the back-compat alias.
+    if not env.get("DT_JAX_CACHE_DIR") and not env.get("DT_COMPILE_CACHE"):
+        env["DT_JAX_CACHE_DIR"] = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
     return env
 
 
@@ -270,12 +277,17 @@ def main():
         jsonl = os.environ.get("DT_BENCH_JSONL")
         if jsonl is None and result.get("backend") == "tpu":
             jsonl = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "BENCH_local_r05.jsonl")
+                                 "BENCH_r14.jsonl")
         if jsonl:
+            # append + fsync per tier: a late tunnel wedge (or an
+            # orphaned child dying much later) can't erase — or leave
+            # buffered and unwritten — an early tier's success
             with open(jsonl, "a") as f:
                 f.write(json.dumps(
                     {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), **result})
                     + "\n")
+                f.flush()
+                os.fsync(f.fileno())
         print(f"# tier {net} done: {line}", file=sys.stderr, flush=True)
     if line is None:
         # EVERY tier failed: a bare "None" on stdout with rc 0 would read

@@ -49,6 +49,7 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     # runtime / backend
     "DT_FORCE_CPU": ("", "1 = flip jax to the CPU backend before init (tests/CI)"),
     "DT_COMPILE_CACHE": ("", "persistent XLA compile-cache dir (elastic restarts hit it)"),
+    "DT_JAX_CACHE_DIR": ("", "persistent jax_compilation_cache_dir (ROADMAP item 5 capture discipline; takes precedence over DT_COMPILE_CACHE)"),
     # Pallas kernel opt-ins (model zoo / op surface swaps)
     "DT_PALLAS_BN": ("", "1 = model zoo uses the Pallas fused BN (models/common.py)"),
     "DT_PALLAS_ATTN": ("", "1 = TransformerLM local attention uses the Pallas flash kernel"),
@@ -81,6 +82,14 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "DT_OBS": ("", "1 = enable dt_tpu.obs tracing (span/event ring buffer + heartbeat export)"),
     "DT_OBS_RING": (str(4096), "obs ring-buffer capacity (records per tracer; overflow drops oldest)"),
     "DT_STRAGGLER_MS": ("500", "round-contribution-lag EWMA threshold (ms) that fires the worker.straggler event"),
+    # policy engine (dt_tpu/policy — straggler-adaptive dynamic mini-batch
+    # + autoscaling; docs/policy.md)
+    "DT_POLICY": ("", "1 = enable the scheduler-side policy engine (batch-share rebalancing, auto-eviction, scale proposals)"),
+    "DT_POLICY_STRAGGLER_MS": ("", "breach threshold (ms) for policy decisions (default: DT_STRAGGLER_MS)"),
+    "DT_POLICY_SHRINK": ("0.5", "per-breach-streak geometric batch-share shrink factor"),
+    "DT_POLICY_MIN_FRAC": ("0.25", "floor on a straggler's relative share weight before eviction"),
+    "DT_POLICY_EVICT_AFTER": ("0", "consecutive breaches before a non-base straggler is evicted (0 = off)"),
+    "DT_POLICY_TARGET_WORKERS": ("", "autoscale target worker count for scale proposals (empty = off)"),
     # fault injection / chaos
     "DT_FAULT_PLAN": ("", "fault-plan JSON (or @/path) for subprocess workers (elastic/faults.py)"),
     "DT_DROP_MSG": ("", "percent of received control messages to drop (ps-lite PS_DROP_MSG fuzz)"),
@@ -144,11 +153,14 @@ def env_str(name: str, default: str = "") -> str:
 def enable_compilation_cache(cache_dir: str = "") -> str:
     """Persistent XLA compilation cache (SURVEY §7 mesh-resize mitigation:
     recompiles after elastic world rebuilds hit the cache, keyed by program
-    + world size).  Reads ``DT_COMPILE_CACHE`` when ``cache_dir`` is empty.
+    + world size).  Reads ``DT_JAX_CACHE_DIR`` (the ROADMAP item-5 capture
+    discipline: bench retries after a wedged tunnel must not recompile)
+    then ``DT_COMPILE_CACHE`` when ``cache_dir`` is empty.
     ``Module.__init__`` calls this, so setting the env var on the launcher
     command line enables it job-wide (workers inherit the environment)."""
     import jax
-    cache_dir = cache_dir or env("DT_COMPILE_CACHE")
+    cache_dir = cache_dir or env("DT_JAX_CACHE_DIR") or \
+        env("DT_COMPILE_CACHE")
     if cache_dir:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

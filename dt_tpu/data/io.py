@@ -147,10 +147,22 @@ class NDArrayIter(DataIter):
                  batch_size: int = 32, shuffle: bool = False,
                  last_batch_handle: str = "pad", num_parts: int = 1,
                  part_index: int = 0, seed: int = 0,
-                 data_name: str = "data", label_name: str = "softmax_label"):
+                 data_name: str = "data", label_name: str = "softmax_label",
+                 part_weights: Optional[Sequence[float]] = None):
+        """``part_weights`` (r14, dt_tpu/policy): per-part relative
+        weights — the shard split becomes contiguous largest-remainder
+        ranges proportional to the weights instead of the equal strided
+        split, so a worker whose policy batch share shrank also reads
+        proportionally fewer examples (weighted re-sharding per Lin et
+        al. dynamic mini-batch; equal weights reproduce near-equal
+        contiguous parts)."""
         super().__init__(batch_size)
         if not 0 <= part_index < num_parts:
             raise ValueError(f"part_index {part_index} not in [0, {num_parts})")
+        if part_weights is not None and len(part_weights) != num_parts:
+            raise ValueError(
+                f"part_weights has {len(part_weights)} entries for "
+                f"{num_parts} parts")
         if last_batch_handle not in ("pad", "discard", "roll_over"):
             raise ValueError(last_batch_handle)
         # data/label: array | dict {name: array} | list of arrays
@@ -176,6 +188,8 @@ class NDArrayIter(DataIter):
         self.last_batch_handle = last_batch_handle
         self.num_parts = num_parts
         self.part_index = part_index
+        self.part_weights = list(part_weights) if part_weights is not None \
+            else None
         self._epoch = 0
         self._seed = seed
         self._leftover: Optional[np.ndarray] = None
@@ -188,8 +202,19 @@ class NDArrayIter(DataIter):
         if self.shuffle:
             rng = np.random.RandomState(self._seed + self._epoch)
             rng.shuffle(idx)
-        # strided shard: every part gets ceil/floor(n/num_parts) examples
-        idx = idx[self.part_index::self.num_parts]
+        if self.part_weights is not None:
+            # weighted shard (r14 policy re-sharding): contiguous
+            # largest-remainder ranges of the (shuffled) index — every
+            # part derives the same bounds from the same weights, the
+            # ranges are disjoint, and their union is the whole epoch
+            from dt_tpu.policy import rescale
+            counts = rescale.apportion(self.part_weights, n, min_each=0)
+            start = int(sum(counts[:self.part_index]))
+            idx = idx[start:start + counts[self.part_index]]
+        else:
+            # strided shard: every part gets ceil/floor(n/num_parts)
+            # examples
+            idx = idx[self.part_index::self.num_parts]
         if self._leftover is not None:
             idx = np.concatenate([self._leftover, idx])
             self._leftover = None
@@ -553,14 +578,38 @@ class ElasticDataIterator:
     per-worker batch rescales (Lin et al. policy, ``train_resnet.py:315-317``);
     set ``fixed_per_worker_batch=True`` for the alternative policy shipped in
     ``fit.py:28-44``.
+
+    r14 share-aware path (dt_tpu/policy): when the kvstore's elastic
+    controller carries policy batch shares (``WorkerClient.policy_shares``,
+    delivered in the membership-barrier response), the per-worker batch
+    comes from the share map — summing EXACTLY to ``global_batch_size``
+    fleet-wide — and a factory accepting a 4th ``weights`` argument gets
+    the rank-ordered weight list for weighted sharding
+    (``NDArrayIter(part_weights=...)``).  Three-argument factories keep
+    working unchanged (weighted batch, equal example shard).
     """
 
-    def __init__(self, factory: Callable[[int, int, int], tuple],
+    def __init__(self, factory: Callable[..., tuple],
                  global_batch_size: int,
                  fixed_per_worker_batch: bool = False):
         self.factory = factory
         self.global_batch_size = global_batch_size
         self.fixed_per_worker_batch = fixed_per_worker_batch
+        self._takes_weights: Optional[bool] = None
+
+    def _factory_takes_weights(self) -> bool:
+        """Whether the factory opts into weighted sharding (accepts a
+        4th positional/keyword ``weights`` parameter)."""
+        if self._takes_weights is None:
+            import inspect
+            try:
+                params = inspect.signature(self.factory).parameters
+                # only an EXPLICIT `weights` parameter opts in — a
+                # legacy `*args` factory must keep its 3-arg contract
+                self._takes_weights = "weights" in params
+            except (TypeError, ValueError):
+                self._takes_weights = False
+        return self._takes_weights
 
     def per_worker_batch(self, num_workers: int) -> int:
         if self.fixed_per_worker_batch:
@@ -576,6 +625,22 @@ class ElasticDataIterator:
         return per
 
     def get_data_iterator(self, kv) -> tuple:
-        """``kv`` exposes ``num_workers`` and ``rank`` (KVStore facade)."""
+        """``kv`` exposes ``num_workers`` and ``rank`` (KVStore facade);
+        with policy shares on the attached controller the batch/shard
+        split is share-weighted (see class docstring)."""
+        ctrl = getattr(kv, "_controller", None)
+        shares = getattr(ctrl, "policy_shares", None)
+        workers = list(getattr(ctrl, "workers", None) or [])
+        if shares and workers and not self.fixed_per_worker_batch:
+            from dt_tpu.policy import rescale
+            bmap = rescale.batch_map(shares, workers,
+                                     self.global_batch_size)
+            bs = bmap.get(getattr(ctrl, "host", None))
+            if bs is not None:
+                weights = [float(bmap[h]) for h in workers]
+                if self._factory_takes_weights():
+                    return self.factory(kv.num_workers, kv.rank, bs,
+                                        weights)
+                return self.factory(kv.num_workers, kv.rank, bs)
         bs = self.per_worker_batch(kv.num_workers)
         return self.factory(kv.num_workers, kv.rank, bs)

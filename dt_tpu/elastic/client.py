@@ -128,6 +128,12 @@ class WorkerClient:
         # re-admits this host; resume_epoch is where to rejoin
         self.recovery_pending: bool = bool(resp.get("recovery_pending"))
         self.resume_epoch: int = int(resp.get("resume_epoch", 0))
+        # r14 policy engine (dt_tpu/policy): the scheduler's applied
+        # batch-share units + LR scale ride every membership-barrier
+        # response; written alongside rank/workers on the caller thread
+        self.policy_shares: Dict[str, int] = {}  # guarded-by: _prof_lock
+        self.policy_lr_scale: float = 1.0  # guarded-by: _prof_lock
+        self.policy_seq: int = 0  # guarded-by: _prof_lock
         # range-server fleet (sharded data plane): when non-empty, bulk
         # data routes to these instead of the scheduler's embedded plane
         self.servers: List[Tuple[str, int]] = [
@@ -535,8 +541,27 @@ class WorkerClient:
         with self._prof_lock:
             self.workers = resp["workers"]
             self.rank = resp["rank"]
+            self._adopt_policy_locked(resp)
             if self.recovery_pending and self.rank >= 0:
                 self.recovery_pending = False  # re-admitted as ourselves
+
+    def _adopt_policy_locked(self, resp: dict) -> None:
+        """Adopt the policy payload of a barrier response (shares in
+        :data:`dt_tpu.policy.rescale.UNITS`, LR scale, decision seq) —
+        the share-aware fit loop and the elastic data iterator read
+        these after the barrier.  A ``policy_seq`` regression (stale
+        cached result replayed after a newer decision was adopted) is
+        ignored.  Caller holds ``_prof_lock``."""
+        pol = resp.get("policy")
+        if not pol:
+            return
+        seq = int(pol.get("seq", 0))
+        if seq < self.policy_seq:
+            return
+        self.policy_seq = seq
+        self.policy_shares = {h: int(u) for h, u in
+                              (pol.get("shares") or {}).items()}
+        self.policy_lr_scale = float(pol.get("lr_scale", 1.0))
 
     def wait_rejoin(self, timeout_s: float = 600.0) -> int:
         """Recovery re-entry (``van.cc:187-218``): park at the next
@@ -565,6 +590,7 @@ class WorkerClient:
                 with self._prof_lock:
                     self.workers = resp["workers"]
                     self.rank = resp["rank"]
+                    self._adopt_policy_locked(resp)
                     self.recovery_pending = False
                 obs_trace.tracer().complete_span(
                     "recovery.rejoin", t0,

@@ -17,6 +17,7 @@ never calls back out, so the nesting is one-way and deadlock-free.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, Optional, Set
 
 import numpy as np
@@ -40,7 +41,7 @@ class DataPlane:
 
     def __init__(self, expected_fn: Callable[[], Set[str]],
                  confirm_fn: Optional[Callable[[], Set[str]]] = None,
-                 tracer=None, replicate_fn=None):
+                 tracer=None, replicate_fn=None, track_lag: bool = False):
         # observability sink (dt_tpu/obs): the embedding server passes its
         # control-plane tracer so round counters/events land on its track
         from dt_tpu.obs import trace as obs_trace
@@ -55,6 +56,12 @@ class DataPlane:
         # Best-effort: a dead standby degrades HA, never the round.
         self._replicate = replicate_fn
         self._replicate_warned = False  # one log line per outage, not per round
+        # r14 policy engine: stamp round arrivals (and feed the straggler
+        # EWMA) even with tracing off — the dynamic mini-batch decisions
+        # need the lag signal whether or not DT_OBS exports a timeline.
+        # Spans/events stay obs-gated; only the ns arrival stamps and the
+        # EWMA fold run on this flag (a clock read per contribution).
+        self._track_lag = bool(track_lag)
         # called right before a round completes, for an AUTHORITATIVE
         # membership recheck: a range server serves allreduce against a
         # TTL-cached mirror, and completing a round off a stale cache
@@ -156,7 +163,7 @@ class DataPlane:
     @staticmethod
     def _new_slot() -> dict:
         return {"vals": {}, "gen": 0, "result": None, "served": {},
-                "t0": None, "arrive": {}, "meta": None}
+                "t0": None, "lag0": None, "arrive": {}, "meta": None}
 
     def install_round(self, key: str, gen: int, seqs: Dict[str, int],
                       result) -> None:
@@ -230,20 +237,25 @@ class DataPlane:
             if seq >= 0 and served is not None and served[0] == seq:
                 return {"value": served[1]}  # retry of a completed round
             gen = slot["gen"]
-            if tnow is not None:
+            lag_ns = tnow[1] if tnow is not None else \
+                (time.monotonic_ns() if self._track_lag else None)
+            if lag_ns is not None:
                 # round span bookkeeping: the FIRST contribution opens
                 # the round's window; every host's FIRST arrival is
                 # stamped so the finish can name the last (straggling)
                 # contributor and score per-worker lag (straggler EWMA,
-                # r13).  setdefault, not assignment: an at-least-once
-                # RETRY of an in-flight contribution (lost response,
-                # recv-drop fault) must not re-stamp the host later and
-                # steal the straggler blame from the genuinely slow
-                # contributor everyone is actually waiting on
+                # r13; with track_lag the stamps run obs-off too — the
+                # r14 policy engine's input).  setdefault, not
+                # assignment: an at-least-once RETRY of an in-flight
+                # contribution (lost response, recv-drop fault) must not
+                # re-stamp the host later and steal the straggler blame
+                # from the genuinely slow contributor everyone is
+                # actually waiting on
                 if not slot["vals"]:
-                    slot["t0"] = tnow
+                    slot["t0"] = tnow  # span token; None with obs off
+                    slot["lag0"] = lag_ns
                     slot["arrive"] = {}
-                slot["arrive"].setdefault(host, tnow[1])
+                slot["arrive"].setdefault(host, lag_ns)
             slot["vals"][host] = (seq, arr)
             expected = self.expected_fn()
             if expected and set(slot["vals"]) >= set(expected):
@@ -327,27 +339,31 @@ class DataPlane:
                     logging.getLogger("dt_tpu.elastic").warning(
                         "HA round replication to standby failed (%s); "
                         "continuing unreplicated", e)
-        t0 = slot.get("t0")
-        if t0 is not None:
+        lag0 = slot.get("lag0")
+        if lag0 is not None:
             # the round's server-side span: first contribution →
             # completion, naming the last (straggling) contributor and
             # the wait-for-last window; per-worker lags feed the
-            # straggler EWMA (scheduler status / obs_dump / dtop board)
+            # straggler EWMA (scheduler status / obs_dump / dtop board,
+            # and the r14 policy engine's rebalance decisions).  The
+            # span itself stays obs-gated (t0 is None when tracing is
+            # off and complete_span no-ops); the EWMA fold runs on the
+            # lag stamps alone
             arrive = slot.get("arrive") or {}
-            first = t0[1]
-            last_host, last_t = None, first
+            last_host, last_t = None, lag0
             for h, t in arrive.items():
                 if t >= last_t:
                     last_host, last_t = h, t
-            wait_ms = round(max(last_t - first, 0) / 1e6, 3)
+            wait_ms = round(max(last_t - lag0, 0) / 1e6, 3)
             slot["meta"] = (slot["gen"] + 1, last_host, wait_ms)
-            self._update_straggler_locked(arrive, first)
+            self._update_straggler_locked(arrive, lag0)
             self._obs.complete_span(
-                "dataplane.round", t0,
+                "dataplane.round", slot.get("t0"),
                 {"key": key, "gen": slot["gen"] + 1,
                  "contributors": len(contributors),
                  "last": last_host, "wait_ms": wait_ms})
             slot["t0"] = None
+            slot["lag0"] = None
             slot["arrive"] = {}
         slot["vals"] = {}
         slot["gen"] += 1
@@ -382,9 +398,10 @@ class DataPlane:
     def straggler_scores(self) -> Dict[str, float]:
         """Per-worker round-contribution-lag EWMA (ms) — the straggler
         board surfaced by the scheduler's ``status``/``obs_dump`` and
-        the range server's ``stats``.  Empty until tracing (``DT_OBS``)
-        is on: arrival stamping rides the obs gate so the disabled fast
-        path stays zero-cost."""
+        the range server's ``stats``, and the r14 policy engine's input.
+        Empty unless tracing (``DT_OBS``) or ``track_lag`` (the policy
+        engine, ``DT_POLICY``) is on: arrival stamping rides those gates
+        so the disabled fast path stays zero-cost."""
         with self._cv:
             return {h: round(v, 3)
                     for h, v in sorted(self._straggler.items())}

@@ -55,6 +55,14 @@ Crash sites currently instrumented:
 - ``module.epoch_begin`` — worker dies exactly at an epoch boundary
   (rule ``epoch=`` pins which one)
 
+Site-scoped **delay** rules (r14): a ``delay`` rule carrying ``site=``
+matches a named :func:`delay_point` instead of transport traffic — a
+deterministic compute-time slowdown.  The chaos harness's straggler plan
+uses ``site="worker.step"`` with the sleep scaled by the worker's
+current batch share, so a policy rebalance that shrinks the share
+genuinely recovers step rate (the dynamic mini-batch effect under test,
+``tools/chaos_run.py --plan straggler``).
+
 Determinism
 -----------
 
@@ -143,6 +151,9 @@ class FaultRule:
             raise ValueError(f"unknown crash action {action!r}")
         if kind == "crash" and not site:
             raise ValueError("crash rules need a site=")
+        if site and kind not in ("crash", "delay"):
+            raise ValueError(f"site= applies to crash/delay rules, "
+                             f"not {kind!r}")
         self.kind = kind
         self.op = op
         self.cmd = (cmd,) if isinstance(cmd, str) else \
@@ -159,7 +170,10 @@ class FaultRule:
 
     def matches(self, op: str, cmd: Optional[str],
                 host: Optional[str]) -> bool:
-        if self.kind == "crash" or self.op != op:
+        # site-scoped rules (crash, site-delay) never match transport
+        # traffic — they fire at their named hook only
+        if self.kind == "crash" or self.site is not None or \
+                self.op != op:
             return False
         if self.cmd is not None and cmd not in self.cmd:
             return False
@@ -298,7 +312,28 @@ class FaultPlan:
                 if self._reorder.get(idx) is wait:
                     self._reorder[idx] = None
 
-    # -- crash hooks ------------------------------------------------------
+    # -- site hooks -------------------------------------------------------
+
+    def delay_at(self, site: str, host: Optional[str] = None,
+                 scale: float = 1.0) -> float:
+        """Apply any matching site-scoped delay rules: sleep
+        ``delay_s * scale`` per applied rule (``scale`` lets the call
+        site tie the stall to real work, e.g. this step's batch share).
+        Returns the total seconds slept (0.0 = nothing fired)."""
+        slept = 0.0
+        for idx, r in enumerate(self.rules):
+            if r.kind != "delay" or r.site != site:
+                continue
+            if r.host is not None and host not in r.host:
+                continue
+            if not self._fire(idx, r, host):
+                continue
+            _obs_fault("delay", "site", idx, host=host, site=site)
+            d = r.delay_s * float(scale)
+            if d > 0:
+                time.sleep(d)
+            slept += d
+        return slept
 
     def crash(self, site: str, host: Optional[str] = None,
               **ctx: Any) -> None:
@@ -389,3 +424,15 @@ def crash_point(site: str, host: Optional[str] = None, **ctx: Any) -> None:
     plan = active_plan()
     if plan is not None:
         plan.crash(site, host=host, **ctx)
+
+
+def delay_point(site: str, host: Optional[str] = None,
+                scale: float = 1.0) -> float:
+    """Named delay hook (site-scoped ``delay`` rules, r14): a no-op
+    unless an active plan has a matching rule.  Returns seconds slept —
+    the chaos harness's straggler plan scales it by the worker's live
+    batch share so rebalancing measurably recovers step rate."""
+    plan = active_plan()
+    if plan is None:
+        return 0.0
+    return plan.delay_at(site, host=host, scale=scale)

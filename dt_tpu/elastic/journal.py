@@ -393,6 +393,14 @@ class ControlState:
         self.plain_served: Dict[str, int] = {}
         self.snapshot = None
         self.mc_partial: Optional[Dict[str, Any]] = None
+        # r14 policy engine (dt_tpu/policy): applied batch-share units,
+        # breach streaks, and the decision log — journaled so a warm-
+        # standby failover preserves an in-flight rebalance (ISSUE 11)
+        self.policy_shares: Dict[str, int] = {}
+        self.policy_streaks: Dict[str, int] = {}
+        self.policy_lr_scale: float = 1.0
+        self.policy_seq = 0
+        self.policy_log: List[Dict[str, Any]] = []
         # journal path for resolving snapshot sidecar markers at replay
         # (set by the embedding scheduler and by :meth:`rebuild`)
         self.sidecar_base: Optional[str] = None
@@ -437,6 +445,7 @@ class ControlState:
         self.pending_recovery.add(host)
         self.barrier_arrived.discard(host)
         self.log_seq = max(self.log_seq, int(seq))
+        self._policy_forget(host)
 
     def _op_evict(self, host: str, seq: int) -> None:
         if host in self.workers:
@@ -445,6 +454,7 @@ class ControlState:
         self.base.discard(host)
         self.removed_hosts.add(host)
         self.log_seq = max(self.log_seq, int(seq))
+        self._policy_forget(host)
 
     def _op_barrier_arrive(self, host: str, epoch: int) -> None:
         if epoch <= self.last_completed_epoch:
@@ -473,6 +483,7 @@ class ControlState:
         self.base.discard(host)
         self.log_seq = max(self.log_seq, int(seq))
         self._mc_track("removed", host)
+        self._policy_forget(host)
 
     def _op_mc_recover(self, host: str, epoch: int, seq: int) -> None:
         self.pending_recovery.discard(host)
@@ -511,6 +522,44 @@ class ControlState:
         if int(gen) > self.plain_gen:
             self.plain_gen = int(gen)
         self.plain_arrived = set()
+
+    def _policy_forget(self, host: str) -> None:
+        """A removed/evicted host leaves the policy board: stale shares
+        or streaks would otherwise skew the next apportionment.  Called
+        from the removal ops, so replay forgets exactly when live did."""
+        self.policy_shares.pop(host, None)
+        self.policy_streaks.pop(host, None)
+
+    #: decision-log rows retained in memory/struct (the journal keeps
+    #: every record; this only bounds the live tail dtop renders)
+    POLICY_LOG_KEEP = 256
+
+    def _op_policy_decide(self, epoch: int, seq: int,
+                          breached: List[str],
+                          streaks: Dict[str, int],
+                          shares: Dict[str, int],
+                          lr_scale: float = 1.0,
+                          evicted: Optional[List[str]] = None,
+                          proposals: Optional[List[dict]] = None) -> None:
+        """One applied policy decision (dt_tpu/policy, ISSUE 11):
+        absolute streaks/shares ride in the record — replay installs,
+        never recomputes — and ``seq`` makes a replayed record a no-op
+        (idempotent like every op here)."""
+        if int(seq) <= self.policy_seq:
+            return
+        self.policy_seq = int(seq)
+        self.policy_streaks = {h: int(s) for h, s in sorted(streaks.items())}
+        self.policy_shares = {h: int(u) for h, u in sorted(shares.items())}
+        self.policy_lr_scale = float(lr_scale)
+        self.policy_log.append({
+            "seq": int(seq), "epoch": int(epoch),
+            "breached": sorted(breached),
+            "streaks": dict(self.policy_streaks),
+            "shares": dict(self.policy_shares),
+            "lr_scale": float(lr_scale),
+            "evicted": sorted(evicted or []),
+            "proposals": list(proposals or [])})
+        del self.policy_log[:-self.POLICY_LOG_KEEP]
 
     def _op_snapshot(self, blob: Any) -> None:
         if snapshot_marker(blob) and self.sidecar_base:
@@ -562,4 +611,9 @@ class ControlState:
             "plain_served": dict(sorted(self.plain_served.items())),
             "mc_partial": self.mc_partial,
             "has_snapshot": self.snapshot is not None,
+            "policy_seq": self.policy_seq,
+            "policy_shares": dict(sorted(self.policy_shares.items())),
+            "policy_streaks": dict(sorted(self.policy_streaks.items())),
+            "policy_lr_scale": self.policy_lr_scale,
+            "policy_log": list(self.policy_log),
         }

@@ -60,6 +60,7 @@ from typing import Callable, Dict, List, Optional, Set
 import numpy as np
 
 from dt_tpu import config
+from dt_tpu import policy as policy_lib
 from dt_tpu.elastic import faults, journal, protocol
 from dt_tpu.elastic.dataplane import DataPlane
 from dt_tpu.obs import trace as obs_trace
@@ -195,6 +196,12 @@ class Scheduler:
         # in-process analog of the EC2 manager thread that rewrites the file
         # (launch.py:88-235); used by operator automation and tests.
         self._pre_change_hook = pre_change_hook
+        # r14 policy engine (dt_tpu/policy, ISSUE 11): straggler EWMAs →
+        # journaled batch-share rebalances, chronic-straggler evictions
+        # (via the host_worker diff, like the EC2 lifecycle daemon), and
+        # scale proposals.  DT_POLICY=1 arms it; immutable after init.
+        self._policy = policy_lib.PolicyEngine.from_env() \
+            if policy_lib.enabled() else None
 
         # snapshot publish/fetch keep their own lock so a multi-MB blob
         # copy never blocks membership traffic (the blob itself lives in
@@ -219,7 +226,8 @@ class Scheduler:
         self._dp = DataPlane(
             expected_fn=lambda: list(self._state.workers),
             tracer=self._obs,
-            replicate_fn=self._make_replicator() if self.peer else None)
+            replicate_fn=self._make_replicator() if self.peer else None,
+            track_lag=self._policy is not None)
         # range-server registry: index -> (host, port); fixed after launch
         # (the reference's server count is DMLC_NUM_SERVER, not elastic).
         # Own lock: _server_list() is called from inside _register, which
@@ -646,10 +654,14 @@ class Scheduler:
                 "dropped": own["dropped"] + proc["dropped"]}
         tracks["control-plane"] = ctrl
         # per-worker straggler scores (round-contribution-lag EWMA, ms)
-        # ride the dump so dtop's live straggler board needs no second
-        # command; the export threads them through otherData
+        # and the r14 policy view (shares / streaks / decision log) ride
+        # the dump so dtop's live boards need no second command; the
+        # export threads both through otherData
+        with self._lock:
+            pol = self._policy_view_locked()
         return {"tracks": tracks,
-                "straggler": self._dp.straggler_scores()}
+                "straggler": self._dp.straggler_scores(),
+                "policy": pol}
 
     def close(self):
         """Shut the service down.  Idempotent, and bounded even when a
@@ -744,7 +756,8 @@ class Scheduler:
                        "incarnation": self._incarnation,
                        "workers": list(self._state.workers),
                        "last_completed_epoch":
-                           self._state.last_completed_epoch}
+                           self._state.last_completed_epoch,
+                       "policy": self._policy_view_locked()}
             out["straggler"] = self._dp.straggler_scores()
             return out
         if cmd == "profile":
@@ -1140,6 +1153,41 @@ class Scheduler:
                 self._pre_change_hook(epoch)
             except Exception:
                 logger.exception("pre_change_hook failed")
+        decision = None
+        if self._policy is not None:
+            # r14 policy decision, phase 1 (pre-diff): breach streaks
+            # from the straggler board; chronic stragglers are dropped
+            # from host_worker HERE so the normal diff below applies the
+            # removal — exactly how the reference's EC2 lifecycle daemon
+            # evicted instances (launch.py:218-224 rewrite, then diff).
+            # The decision is journaled post-diff as ONE policy_decide
+            # op; a leader killed between this rewrite and that op
+            # leaves the rewritten file on the shared fs, so the
+            # successor resumes the same removal direction.
+            decision = self._policy.decide(
+                epoch, list(st.workers), set(st.base),
+                dict(st.policy_streaks), self._dp.straggler_scores())
+            # evictions AND accepted scale-down proposals act through
+            # the file + diff; scale-UP proposals stay advisory (the
+            # engine cannot invent hosts — the launcher/operator adds
+            # them to host_worker, reference launch.py:88-235)
+            drop = list(decision.evict) + [
+                p["host"] for p in decision.proposals
+                if p.get("kind") == "scale_down" and "host" in p]
+            if drop and not (self.host_worker_file and
+                             os.path.exists(self.host_worker_file)):
+                # no host file = no removal path through the diff:
+                # demote the eviction to an advisory proposal (the
+                # proposal-dedup in _policy_apply_locked keeps the
+                # journal from re-recording it every epoch)
+                import dataclasses as _dc
+                decision = _dc.replace(
+                    decision, evict=[],
+                    proposals=list(decision.proposals) + [
+                        {"kind": "evict", "host": h} for h in drop])
+                drop = []
+            if drop:
+                self._rewrite_host_file(drop)
         desired = set(st.workers)
         if self.host_worker_file and os.path.exists(self.host_worker_file):
             desired = set(_read_hosts(self.host_worker_file))
@@ -1210,8 +1258,77 @@ class Scheduler:
             logger.info("Epoch[%d] membership change: removed=%s added=%s "
                         "recovered=%s -> %s", epoch, removed, added,
                         recovered, st.workers)
-        return {"workers": list(st.workers), "removed": removed,
-                "added": added, "recovered": recovered, "epoch": epoch}
+        result = {"workers": list(st.workers), "removed": removed,
+                  "added": added, "recovered": recovered, "epoch": epoch}
+        if self._policy is not None and decision is not None:
+            # phase 2 (post-diff): shares over the FINAL worker set ride
+            # the barrier result (journaled inside barrier_complete, so
+            # every arrival — and a failed-over successor — serves the
+            # identical shares)
+            result["policy"] = self._policy_apply_locked(epoch, decision)
+        return result
+
+    def _policy_apply_locked(self, epoch: int, decision) -> dict:
+        """Apply one policy decision: share units over the post-diff
+        rank-ordered workers, journaled as a single idempotent
+        ``policy_decide`` op when anything changed (the WAL path DT010
+        pins).  Returns the barrier-response payload.  Caller holds the
+        lock."""
+        st = self._state
+        live = set(st.workers)
+        streaks = {h: s for h, s in decision.streaks.items() if h in live}
+        shares = self._policy.shares(list(st.workers), streaks)
+        last_props = st.policy_log[-1].get("proposals", []) \
+            if st.policy_log else []
+        if (shares != st.policy_shares or streaks != st.policy_streaks
+                or decision.evict
+                or list(decision.proposals) != list(last_props)):
+            self._apply("policy_decide", epoch=epoch,
+                        seq=st.policy_seq + 1,
+                        breached=list(decision.breached),
+                        streaks=streaks, shares=shares,
+                        lr_scale=decision.lr_scale,
+                        evicted=list(decision.evict),
+                        proposals=list(decision.proposals))
+            self._obs.counter("policy.decisions")
+            self._obs.event("policy.rebalance",
+                            {"epoch": epoch, "seq": st.policy_seq,
+                             "breached": list(decision.breached),
+                             "shares": dict(shares)})
+            for h in decision.evict:
+                self._obs.event("policy.evict",
+                                {"epoch": epoch, "host": h})
+            # only NEW proposals become events (an unchanged pending
+            # proposal re-journaled alongside a streak change must not
+            # re-fire per epoch); demoted evictions are evictions, not
+            # scale proposals — they go out under policy.evict
+            for p in decision.proposals:
+                if p in last_props:
+                    continue
+                if p.get("kind") == "evict":
+                    self._obs.event("policy.evict",
+                                    {"epoch": epoch, "host": p.get("host"),
+                                     "advisory": True})
+                else:
+                    self._obs.event("policy.scale", {"epoch": epoch, **p})
+            logger.info(
+                "Epoch[%d] policy decision %d: breached=%s shares=%s "
+                "evicted=%s proposals=%s", epoch, st.policy_seq,
+                decision.breached, shares, decision.evict,
+                decision.proposals)
+        return {"shares": dict(st.policy_shares),
+                "lr_scale": st.policy_lr_scale, "seq": st.policy_seq}
+
+    def _policy_view_locked(self) -> dict:
+        """Operator view of the policy state (``status`` / ``obs_dump``
+        → dtop's policy section).  Caller holds the lock."""
+        st = self._state
+        return {"enabled": self._policy is not None,
+                "shares": dict(st.policy_shares),
+                "streaks": dict(st.policy_streaks),
+                "lr_scale": st.policy_lr_scale,
+                "seq": st.policy_seq,
+                "log": list(st.policy_log[-32:])}
 
     def _audit_locked(self, action: str, host: str):
         """``SEQ ADDED|REMOVED IP TIME`` (``elastic_training.cc:108-126``).

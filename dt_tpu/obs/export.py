@@ -73,6 +73,11 @@ def chrome_trace(job: Dict[str, Any]) -> Dict[str, Any]:
     other: Dict[str, Any] = {"tracks": {}}
     if "straggler" in job:
         other["straggler"] = dict(job["straggler"] or {})
+    if "policy" in job:
+        # r14 policy view (shares / streaks / decision log) rides the
+        # export like the straggler board: dtop's policy section and the
+        # chaos straggler checks read it from the summary
+        other["policy"] = dict(job["policy"] or {})
     # pass 1: index every id-carrying span by (track, sid) so pass 2 can
     # bind flow starts to the exact client slice
     span_at: Dict[tuple, dict] = {}
@@ -375,7 +380,9 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
                                 key=lambda m: m.get("ts") or 0),
            "total_fault_events": total_faults,
            "straggler": dict((chrome.get("otherData") or {})
-                             .get("straggler") or {})}
+                             .get("straggler") or {}),
+           "policy": dict((chrome.get("otherData") or {})
+                          .get("policy") or {})}
     out.update(_causal_and_critical(chrome, track_of_pid))
     return out
 

@@ -88,6 +88,14 @@ NAME_REGISTRY: Mapping[str, Tuple[str, str]] = {
                                     "overlap pipeline"),
     "pipeline.aux_rounds": ("counter", "aux rounds ridden on the pipeline "
                                        "window (e.g. stats)"),
+    # -- policy engine (dt_tpu/policy via elastic/scheduler.py) ------------
+    "policy.rebalance": ("event", "one applied policy decision: breach "
+                                  "set + the journaled batch-share units"),
+    "policy.evict": ("event", "a chronic straggler dropped from "
+                              "host_worker by the policy engine"),
+    "policy.scale": ("event", "a scale-up/down proposal toward "
+                              "DT_POLICY_TARGET_WORKERS"),
+    "policy.decisions": ("counter", "journaled policy_decide ops"),
     # -- fault injection (elastic/faults.py) -------------------------------
     "fault.*": ("event", "every APPLIED fault (fault.<kind>); the chaos "
                          "harness cross-checks these against "

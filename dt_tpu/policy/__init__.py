@@ -1,0 +1,20 @@
+"""dt_tpu.policy — straggler-adaptive dynamic mini-batch + autoscaling.
+
+The closed loop the source paper is about (Lin et al., *Dynamic
+Mini-batch SGD for Elastic Distributed Training*, arXiv:1904.12043;
+reference lifecycle daemon ``tools/launch.py:88-235``): the scheduler
+turns the r13 straggler board into journaled control-plane decisions —
+per-worker batch-share rebalancing (convergence-preserving via the
+:mod:`~dt_tpu.policy.rescale` weighting), chronic-straggler
+auto-eviction through the ``membership_change`` machinery, and scale
+proposals.  ``docs/policy.md`` has the decision rules, the journal op
+catalog, and the env knobs; enable with ``DT_POLICY=1``.
+
+jax-free by design: the scheduler and jax-free operator tools
+(``tools/dtop.py``) both import this package.
+"""
+
+from dt_tpu.policy import rescale as rescale
+from dt_tpu.policy.engine import (Decision as Decision,
+                                  PolicyEngine as PolicyEngine,
+                                  enabled as enabled)

@@ -1,0 +1,171 @@
+"""Scheduler-side policy engine: obs signals → control-plane decisions.
+
+Reference parity: ``tools/launch.py:88-235`` — the EC2 instance-lifecycle
+daemon that watched the job and rewrote ``host_worker`` to add/remove
+instances — done TPU-native: the inputs are the scheduler data plane's
+per-worker round-lag EWMAs (the r13 straggler board,
+``dt_tpu/elastic/dataplane.py``) instead of CloudWatch, and the outputs
+are (a) **dynamic mini-batch share decisions** (Lin et al.,
+arXiv:1904.12043: shrink a straggler's batch share, grow the others',
+keep the global batch — and therefore the effective update — fixed via
+the :mod:`dt_tpu.policy.rescale` weighting), (b) **auto-evictions** of
+chronic stragglers through the existing ``membership_change`` machinery
+(the engine rewrites ``host_worker`` exactly like the EC2 manager thread,
+``launch.py:218-224``, and the next barrier's diff applies the removal),
+and (c) **scale proposals** toward ``DT_POLICY_TARGET_WORKERS`` for the
+launcher/operator to act on.
+
+The engine itself is PURE: :meth:`PolicyEngine.decide` maps
+``(workers, base, streaks, scores)`` to a :class:`Decision` with no side
+effects and no clock/RNG access, so the same inputs always produce the
+same decision — the bit-reproducible decision log the chaos harness
+gates on.  All durable state (streaks, applied shares, the decision log)
+lives in the scheduler's journaled ``ControlState`` (``policy_decide``
+op, DT010-clean), so a warm-standby failover resumes mid-rebalance with
+the applied shares intact (``docs/policy.md``; HA protocol
+``docs/ha.md``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Set
+
+from dt_tpu import config
+from dt_tpu.policy import rescale
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One epoch's policy decision (pure data; the scheduler journals it
+    as the ``policy_decide`` op when it changes anything)."""
+
+    epoch: int
+    #: workers whose round-lag EWMA crossed the threshold this epoch
+    breached: List[str]
+    #: full post-decision streak map (zero streaks omitted) — absolute
+    #: values ride in the journal record, never recomputed at replay
+    streaks: Dict[str, int]
+    #: chronic stragglers to drop from ``host_worker`` before the
+    #: membership diff (the diff applies the actual removal)
+    evict: List[str]
+    #: scale proposals for the launcher/operator: [{"kind": "scale_up",
+    #: "want": n}] — the engine never invents hosts, it proposes
+    proposals: List[dict]
+    #: linear LR scale (B'/B); 1.0 under the fixed-global-batch policy
+    lr_scale: float = 1.0
+
+
+class PolicyEngine:
+    """Deterministic decision rules over the straggler board.
+
+    ``threshold_ms``: EWMA lag at/above which a worker counts as
+    breaching this epoch (default: the ``DT_STRAGGLER_MS`` event
+    threshold).  ``shrink``/``min_frac``: the dynamic mini-batch shrink
+    schedule (:func:`dt_tpu.policy.rescale.weight_for_streak`).
+    ``evict_after``: consecutive breaches before a non-base worker is
+    proposed for removal (0 disables auto-eviction).
+    ``target_workers``: autoscale target (0 disables proposals).
+    """
+
+    def __init__(self, threshold_ms: float = 500.0, shrink: float = 0.5,
+                 min_frac: float = 0.25, evict_after: int = 0,
+                 target_workers: int = 0):
+        self.threshold_ms = float(threshold_ms)
+        self.shrink = float(shrink)
+        self.min_frac = float(min_frac)
+        self.evict_after = int(evict_after)
+        self.target_workers = int(target_workers)
+
+    @classmethod
+    def from_env(cls) -> "PolicyEngine":
+        """Build from the ``DT_POLICY*`` registry rows
+        (``dt_tpu.config.ENV_REGISTRY``)."""
+        thr = config.env("DT_POLICY_STRAGGLER_MS")
+        return cls(
+            threshold_ms=float(thr) if thr
+            else float(config.env("DT_STRAGGLER_MS")),
+            shrink=float(config.env("DT_POLICY_SHRINK")),
+            min_frac=float(config.env("DT_POLICY_MIN_FRAC")),
+            evict_after=int(config.env("DT_POLICY_EVICT_AFTER")),
+            target_workers=int(config.env("DT_POLICY_TARGET_WORKERS")
+                               or 0))
+
+    # ------------------------------------------------------------------
+
+    def decide(self, epoch: int, workers: Sequence[str], base: Set[str],
+               streaks: Mapping[str, int],
+               scores: Mapping[str, float]) -> Decision:
+        """Pure decision for one epoch barrier.  ``workers`` is the
+        scheduler's rank-ordered live set BEFORE the membership diff;
+        ``streaks`` the journaled breach streaks; ``scores`` the live
+        round-lag EWMAs (ms).  Base workers are never evicted (the
+        reference's base protection, README.md:54-61) — a chronically
+        breaching base worker keeps its floored share instead."""
+        if not scores:
+            # no lag signal at all — the first barrier of a job, or a
+            # freshly failed-over successor whose (deliberately
+            # unjournaled) EWMA sensor hasn't observed a round yet.
+            # HOLD the journaled streaks instead of resetting them: a
+            # reset here would silently revert an in-flight rebalance
+            # right after a failover, the exact state the journal
+            # exists to preserve.  One observed round repopulates the
+            # board and normal decisions resume.
+            breached: List[str] = []
+            new_streaks = {h: int(s) for h, s in streaks.items()
+                           if h in set(workers) and int(s) > 0}
+        else:
+            breached = sorted(h for h in workers
+                              if scores.get(h, 0.0) >= self.threshold_ms)
+            # streaks saturate: past the point where the share weight is
+            # floored AND eviction (if armed) has triggered, a bigger
+            # number carries no information — capping it stops a chronic
+            # (eviction-blocked) straggler from minting one journaled
+            # decision per epoch forever
+            cap = max(self.evict_after, 8)
+            new_streaks = {}
+            for h in workers:
+                s = min(int(streaks.get(h, 0)) + 1, cap) \
+                    if h in breached else 0
+                if s:
+                    new_streaks[h] = s
+        evict = sorted(
+            h for h, s in new_streaks.items()
+            if self.evict_after and s >= self.evict_after
+            and h not in base)
+        proposals: List[dict] = []
+        survivors = [h for h in workers if h not in evict]
+        if self.target_workers:
+            if len(survivors) < self.target_workers:
+                proposals.append({"kind": "scale_up",
+                                  "want": self.target_workers
+                                  - len(survivors)})
+            elif len(survivors) > self.target_workers:
+                # scale-down proposal names the slowest non-base worker;
+                # ties (equal scores, e.g. all zero) break by reverse
+                # rank order — last joined leaves first, deterministic
+                cands = [h for h in survivors if h not in base]
+                if cands:
+                    slowest = max(
+                        cands, key=lambda h: (scores.get(h, 0.0),
+                                              list(workers).index(h)))
+                    proposals.append({"kind": "scale_down",
+                                      "host": slowest})
+        return Decision(epoch=int(epoch), breached=breached,
+                        streaks=new_streaks, evict=evict,
+                        proposals=proposals, lr_scale=1.0)
+
+    def shares(self, workers: Sequence[str],
+               streaks: Mapping[str, int]) -> Dict[str, int]:
+        """Post-diff share units over the FINAL rank-ordered worker set
+        (computed after the membership change so evicted hosts never
+        hold a share)."""
+        return rescale.share_units(workers, streaks,
+                                   shrink=self.shrink,
+                                   min_frac=self.min_frac)
+
+
+def enabled() -> bool:
+    """Whether the policy engine is on for this process (``DT_POLICY=1``
+    in ``dt_tpu.config.ENV_REGISTRY``)."""
+    return config.env("DT_POLICY").strip().lower() in ("1", "true")

@@ -1,0 +1,156 @@
+"""The paper's LR/batch rescaling math — pure, deterministic, jax-free.
+
+Lin et al. (*Dynamic Mini-batch SGD for Elastic Distributed Training*,
+arXiv:1904.12043) keep the EFFECTIVE update invariant while the worker
+set (and therefore the per-worker mini-batch) changes: the reference
+fixes the global batch and rescales each worker's share
+(``example/dynamic-training/train_resnet.py:315-317`` ``batch_size //
+kv.num_workers``) and scales the learning rate linearly when the
+realized global batch itself moves (the Goyal-style linear scaling rule
+the paper builds its smooth transition on).  This module is the single
+declaration point for that arithmetic so the scheduler, the client, the
+data layer, and the tests all compute the *identical* integers:
+
+- :func:`apportion` — largest-remainder integer apportionment of a
+  total (batch examples, share units) over float weights.  Exact sum,
+  deterministic tie-break (lower index wins), per-part floor.
+- :func:`weight_for_streak` — a worker's relative speed weight from its
+  consecutive-straggler-breach streak: ``max(shrink**streak,
+  min_frac)`` (the dynamic mini-batch shrink schedule).
+- :func:`share_units` — the journaled share vocabulary: integer weights
+  summing to :data:`UNITS` so the control plane never needs to know the
+  training-side global batch.
+- :func:`batch_map` — share units → per-worker integer batch sizes for
+  a concrete global batch (every worker derives the same map from the
+  same barrier response).
+- :func:`grad_weight` — ``b_i * W / B``: the factor worker *i* folds
+  into its gradient so the fleet's plain 1/W average equals the
+  batch-weighted average ``sum(b_i/B * g_i)`` — i.e. exactly the fixed
+  global batch's gradient, which is what makes the rebalance
+  convergence-preserving (the paper's invariant).
+- :func:`lr_scale` — the linear LR scaling ``B'/B`` for when the
+  realized global batch departs from the configured one.
+
+All functions are pure and total over their documented domains;
+``tests/test_policy.py`` pins them number-by-number.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional, Sequence
+
+#: resolution of the journaled share weights: shares ride the journal and
+#: the barrier response as integers summing to UNITS, so the control
+#: plane stays agnostic of the training-side global batch size
+UNITS = 10000
+
+
+def apportion(weights: Sequence[float], total: int,
+              min_each: int = 1) -> List[int]:
+    """Split integer ``total`` over ``weights`` by largest remainder.
+
+    Properties the callers rely on: the parts sum EXACTLY to ``total``;
+    every part is ``>= min_each``; equal weights split as evenly as
+    possible (remainder goes to the lowest indices); the result is a
+    pure function of the inputs (ties broken by index, no RNG) — the
+    bit-reproducibility the decision log is gated on."""
+    n = len(weights)
+    if n == 0:
+        return []
+    if total < min_each * n:
+        raise ValueError(
+            f"cannot apportion {total} over {n} parts with floor "
+            f"{min_each}")
+    s = float(sum(max(float(w), 0.0) for w in weights))
+    if s <= 0.0:
+        raw = [total / n] * n
+    else:
+        raw = [max(float(w), 0.0) / s * total for w in weights]
+    out = [int(r) for r in raw]  # floors
+    # distribute the integer shortfall by largest fractional remainder,
+    # lower index winning ties
+    short = total - sum(out)
+    order = sorted(range(n), key=lambda i: (-(raw[i] - out[i]), i))
+    for i in order[:short]:
+        out[i] += 1
+    # enforce the floor, taking the excess from the largest parts
+    # (repeatedly, so several floored-up parts can't leave a part
+    # over-reduced below its own floor); lowest index wins ties
+    need = sum(max(min_each - v, 0) for v in out)
+    out = [max(v, min_each) for v in out]
+    while need > 0:
+        j = max(range(n), key=lambda i: (out[i], -i))
+        take = min(need, out[j] - min_each)
+        if take <= 0:  # pragma: no cover - guarded by the total check
+            raise ValueError("apportion floor unsatisfiable")
+        out[j] -= take
+        need -= take
+    return out
+
+
+def weight_for_streak(streak: int, shrink: float = 0.5,
+                      min_frac: float = 0.25) -> float:
+    """Relative speed weight of a worker with ``streak`` consecutive
+    straggler-threshold breaches: geometric shrink, floored so a slow
+    worker keeps a useful (and recoverable) share until eviction."""
+    if streak <= 0:
+        return 1.0
+    return max(float(shrink) ** int(streak), float(min_frac))
+
+
+def share_units(workers: Sequence[str], streaks: Mapping[str, int],
+                shrink: float = 0.5, min_frac: float = 0.25
+                ) -> Dict[str, int]:
+    """The journaled decision payload: per-worker integer share weights
+    summing to :data:`UNITS`, ordered/tie-broken by the scheduler's rank
+    order (``workers``)."""
+    if not workers:
+        return {}
+    parts = apportion(
+        [weight_for_streak(streaks.get(h, 0), shrink, min_frac)
+         for h in workers], UNITS, min_each=1)
+    return {h: parts[i] for i, h in enumerate(workers)}
+
+
+def equal_units(workers: Sequence[str]) -> Dict[str, int]:
+    """The no-decision default: an equal split of :data:`UNITS`."""
+    return share_units(workers, {})
+
+
+def batch_map(units: Optional[Mapping[str, int]], workers: Sequence[str],
+              global_batch: int) -> Dict[str, int]:
+    """Per-worker integer batch sizes for ``global_batch``, derived from
+    the journaled share units.  Hosts missing from ``units`` (a worker
+    added after the decision) weigh in at the equal share.  Every worker
+    computes this from the same barrier response, so the full map — not
+    just its own entry — is identical fleet-wide; ``sum == global_batch``
+    exactly (the fixed-global-batch policy)."""
+    if not workers:
+        return {}
+    units = units or {}
+    default = UNITS / max(len(workers), 1)
+    parts = apportion([float(units.get(h, default)) for h in workers],
+                      int(global_batch), min_each=1)
+    return {h: parts[i] for i, h in enumerate(workers)}
+
+
+def grad_weight(batch: int, num_workers: int, global_batch: int) -> float:
+    """``b_i * W / B``: pre-weights worker *i*'s gradient so the data
+    plane's plain ``1/W`` average equals ``sum(b_i/B * g_i)`` — the
+    exact gradient of the fixed global batch, regardless of how the
+    shares are skewed (the convergence-preservation identity
+    ``tests/test_policy.py`` proves against a numpy oracle)."""
+    if global_batch <= 0 or num_workers <= 0:
+        return 1.0
+    return float(batch) * float(num_workers) / float(global_batch)
+
+
+def lr_scale(new_global_batch: int, base_global_batch: int) -> float:
+    """Linear LR scaling ``B'/B`` (Goyal et al., adopted by the paper's
+    smooth transition) for when the REALIZED global batch departs from
+    the configured one — under the fixed-global-batch policy the shares
+    always re-apportion to the same total, so this stays 1.0 unless an
+    operator changes the target batch mid-job."""
+    if base_global_batch <= 0:
+        return 1.0
+    return float(new_global_batch) / float(base_global_batch)

@@ -119,9 +119,10 @@ class Module:
         # reshards state through it (SURVEY.md §7 "mesh resize" hard part).
         self.mesh_manager = mesh_manager
         self.seed = seed
-        # Persistent compilation cache (no-op unless DT_COMPILE_CACHE is
-        # set): elastic world rebuilds re-hit cached programs instead of
-        # paying full recompiles (SURVEY §7 mesh-resize mitigation).
+        # Persistent compilation cache (no-op unless DT_JAX_CACHE_DIR /
+        # DT_COMPILE_CACHE is set): elastic world rebuilds re-hit cached
+        # programs instead of paying full recompiles (SURVEY §7
+        # mesh-resize mitigation).
         config_lib.enable_compilation_cache()
         # Whole-loss jax.checkpoint.  NOTE (r4, tools/memcost.py): a
         # SINGLE checkpoint segment is memory-neutral — the recomputed
@@ -544,16 +545,25 @@ class Module:
             # while ranks shift (r5 review finding) — a count comparison
             # would skip the rebuild and double-/un-process data shards.
             # getattr, like the recovery block above: a duck-typed
-            # kvstore without _controller must not fail fit() here
+            # kvstore without _controller must not fail fit() here.
+            # The r14 policy decision seq rides as the LAST element: a
+            # batch-share rebalance without a membership change must
+            # still rebuild the weighted iterators (dt_tpu/policy), but
+            # must NOT trigger the mesh rebuild (fit slices it off for
+            # that comparison).
             ctrl = getattr(self.kv, "_controller", None)
+            pol = getattr(ctrl, "policy_seq", 0) if ctrl is not None else 0
             members_list = getattr(ctrl, "workers", None)
             if members_list is not None:
-                return (tuple(members_list), ctrl.rank)
+                return (tuple(members_list), ctrl.rank, pol)
             # duck-typed controllers without a member list fall back to
             # the (count, rank) signal
-            return (self.kv.num_workers, self.kv.rank)
+            return (self.kv.num_workers, self.kv.rank, pol)
 
         members = membership_sig()
+        # share-aware gradient pre-weight (dt_tpu/policy): 1.0 — and the
+        # multiply is skipped entirely — until a policy decision arrives
+        grad_scale = self._policy_grad_scale(elastic_data_iterator)
 
         # --- dist_async: master weights live on the scheduler ---
         is_async = self.kv.type == "dist_async"
@@ -608,13 +618,19 @@ class Module:
                     logger.info("Epoch[%d] this worker was removed from the "
                                 "job; stopping", epoch)
                     return eval_metric
-                if membership_sig() != members:
+                new_sig = membership_sig()
+                if new_sig != members:
                     logger.info(
                         "Epoch[%d] membership changed: %s -> %s",
-                        epoch, members, membership_sig())
-                    members = membership_sig()
+                        epoch, members, new_sig)
+                    # the mesh rebuild keys on members/rank only — a
+                    # share-only rebalance (policy seq bump, last slot)
+                    # rebuilds iterators and the grad weight, not the
+                    # distributed world
+                    core_changed = new_sig[:-1] != members[:-1]
+                    members = new_sig
                     num_workers = self.kv.num_workers
-                    if self.mesh_manager is not None:
+                    if core_changed and self.mesh_manager is not None:
                         # rebuild the distributed world + mesh, reshard the
                         # live state, recompile the steps for the new mesh
                         self._mesh, self.state = self.mesh_manager.rebuild(
@@ -627,6 +643,8 @@ class Module:
                             elastic_data_iterator.get_data_iterator(self.kv)
                         if new_eval is not None:
                             eval_data = new_eval
+                    grad_scale = self._policy_grad_scale(
+                        elastic_data_iterator)
 
             tic = time.time()
             eval_metric.reset()
@@ -686,6 +704,13 @@ class Module:
                     flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
                     prefetched = self._prefetch_batch(train_data)
+                    if grad_scale != 1.0:
+                        # share-aware pre-weight b_i*W/B (dt_tpu/policy/
+                        # rescale.py): the fleet's plain 1/W average
+                        # becomes the exact fixed-global-batch gradient
+                        # under unequal shares; skipped (bit-identical
+                        # path) when the policy engine is off
+                        flat_g = flat_g * grad_scale
                     gc = self.kv._gradient_compression
                     from dt_tpu.training import overlap as overlap_lib
                     if overlap_lib.enabled(ctrl):
@@ -772,6 +797,38 @@ class Module:
                     eval_end_callback(epoch, validation_metric)
 
         return eval_metric
+
+    def _policy_grad_scale(self, elastic_data_iterator) -> float:
+        """The r14 share-aware gradient pre-weight (dt_tpu/policy):
+        ``b_i * W / B`` from the controller's journaled share units,
+        times the decision's LR scale (linear scaling, Lin et al.
+        arXiv:1904.12043).  Exactly 1.0 — so the hot path never
+        multiplies — when the policy engine is off, no decision has
+        arrived, or there is no elastic iterator to define the global
+        batch."""
+        ctrl = getattr(self.kv, "_controller", None)
+        shares = getattr(ctrl, "policy_shares", None)
+        if not shares or elastic_data_iterator is None or \
+                self.sync_mode != "host":
+            return 1.0
+        if getattr(elastic_data_iterator, "fixed_per_worker_batch", False):
+            # the fixed-per-worker-batch policy never reshapes batches,
+            # so weighting the gradients would skew an average of
+            # equally-sized contributions — mirror the data layer's
+            # guard (io.py get_data_iterator) and stay at 1.0
+            return 1.0
+        workers = list(getattr(ctrl, "workers", None) or [])
+        b_global = int(getattr(elastic_data_iterator,
+                               "global_batch_size", 0) or 0)
+        if not workers or b_global <= 0:
+            return 1.0
+        from dt_tpu.policy import rescale
+        bmap = rescale.batch_map(shares, workers, b_global)
+        b = bmap.get(getattr(ctrl, "host", None))
+        if b is None:
+            return 1.0
+        return rescale.grad_weight(b, len(workers), sum(bmap.values())) \
+            * float(getattr(ctrl, "policy_lr_scale", 1.0))
 
     def _flush_metric(self, pending, eval_metric, epoch, nbatch,
                       batch_end_callback):

@@ -55,6 +55,36 @@ def make_val_dataset(n=2048):
     return make_dataset(n, seed=777)  # held-out: disjoint draw
 
 
+class SlowIter:
+    """Pass-through iterator that fires the ``worker.step`` delay hook
+    after each batch, scaled by the batch's share of the equal split —
+    the chaos harness's straggler probe (``--plan straggler``): a policy
+    rebalance that shrinks this worker's batch share proportionally
+    shrinks the injected stall, so step-rate recovery is measurable.
+    ``SLEPT["s"]`` accumulates the injected seconds for the result
+    file's per-epoch accounting."""
+
+    SLEPT = {"s": 0.0}
+
+    def __init__(self, it, host, equal_batch):
+        self._it = it
+        self._host = host
+        self._equal = max(int(equal_batch), 1)
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        batch = self._it.next()
+        self.SLEPT["s"] += faults.delay_point(
+            "worker.step", host=self._host,
+            scale=batch.data.shape[0] / self._equal)
+        return batch
+
+    def __getattr__(self, name):
+        return getattr(self._it, name)
+
+
 class TinyBNNet:
     """Conv+BN+dense — exercises batch-stats sync across workers."""
 
@@ -106,12 +136,19 @@ def main():
     kv = kvstore_lib.create("tpu_sync")
     kv.set_controller(ctrl)
 
-    def factory(num_parts, part_index, batch_size):
+    def factory(num_parts, part_index, batch_size, weights=None):
+        # ``weights`` (r14): rank-ordered policy batch shares — the shard
+        # becomes weighted contiguous ranges (dt_tpu/policy re-sharding);
+        # None reproduces the equal strided split
         it = data.NDArrayIter(x, y, batch_size=batch_size, shuffle=True,
                               num_parts=num_parts, part_index=part_index,
-                              seed=99)
-        # equal batches per worker (fit.py:38-43 ResizeIter semantics)
-        return data.ResizeIter(it, size=len(x) // args.global_batch), None
+                              seed=99, part_weights=weights)
+        # fixed steps per worker per epoch (fit.py:38-43 ResizeIter
+        # semantics) — host-sync rounds stay matched across unequal
+        # batch shares
+        resized = data.ResizeIter(it, size=len(x) // args.global_batch)
+        return SlowIter(resized, args.host,
+                        args.global_batch // max(num_parts, 1)), None
 
     eit = data.ElasticDataIterator(factory, args.global_batch)
     train, _ = eit.get_data_iterator(kv)
@@ -144,11 +181,24 @@ def main():
     # (example/image-classification/README.md:325-329)
     vx, vy = make_val_dataset()
     acc_curve = []
+    # per-epoch wall time + injected-sleep accounting (r14): the chaos
+    # straggler plan derives its step-rate-recovery check from these —
+    # (epoch wall − injected sleep) estimates the fault-free epoch time
+    epoch_times = []
+    sleep_by_epoch = []
+    import time as _time
+    _marks = {"t": _time.monotonic(), "slept": SlowIter.SLEPT["s"]}
 
     def record_val(epoch, state, metric):
+        now = _time.monotonic()
+        epoch_times.append(round(now - _marks["t"], 4))
+        sleep_by_epoch.append(
+            round(SlowIter.SLEPT["s"] - _marks["slept"], 4))
         acc = dict(mod.score(data.NDArrayIter(vx, vy, batch_size=256),
                              "acc"))
         acc_curve.append((epoch, float(acc["accuracy"])))
+        _marks["t"] = _time.monotonic()  # validation time excluded
+        _marks["slept"] = SlowIter.SLEPT["s"]
 
     mod.fit(train, num_epoch=args.num_epoch, begin_epoch=begin_epoch,
             elastic_data_iterator=eit,
@@ -171,6 +221,12 @@ def main():
         "param_hash": float(np.abs(np.asarray(flat)).sum()),
         "num_workers_at_end": kv.num_workers,
         "bootstrap_step": bootstrap_step,
+        # r14 policy accounting (dt_tpu/policy; chaos --plan straggler)
+        "epoch_times": epoch_times,
+        "sleep_by_epoch": sleep_by_epoch,
+        "steps_per_epoch": len(x) // args.global_batch,
+        "policy_shares": dict(ctrl.policy_shares),
+        "policy_seq": ctrl.policy_seq,
     }
     # (kind, host, count) of every fault THIS incarnation applied — the
     # chaos harness's --trace mode cross-checks these against the fault

@@ -1,0 +1,590 @@
+"""dt_tpu.policy — straggler-adaptive dynamic mini-batch + autoscaling.
+
+Pins the r14 policy engine (ISSUE 11; Lin et al. arXiv:1904.12043;
+reference lifecycle daemon ``tools/launch.py:88-235``):
+
+- the rescaling math number-by-number (largest-remainder apportionment,
+  shrink schedule, share units → batch map, the ``b_i*W/B`` gradient
+  weight, linear LR scaling) — the numeric oracle the paper rule rests
+  on;
+- convergence preservation: weighted unequal-share gradients average to
+  EXACTLY the full fixed-global-batch gradient (numpy oracle);
+- decision determinism: identical seeded EWMA inputs through a
+  fake-clock breach sequence produce an identical decision log, twice;
+- ``ControlState`` policy ops: idempotent replay, journal rebuild ==
+  live (the failover-preserves-rebalance contract), eviction cleanup;
+- weighted data sharding: disjoint, exhaustive, proportional contiguous
+  ranges; share-aware ``ElasticDataIterator`` batch derivation;
+- scheduler integration: a DT_POLICY scheduler delivers shares in the
+  membership-barrier response, shrinks a breaching worker's share, and
+  auto-evicts it through the normal membership machinery after N
+  breaches (base workers protected).
+
+Process-level end-to-end (real workers, injected compute delay,
+step-rate recovery) lives in ``tools/chaos_run.py --plan straggler``.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dt_tpu import config
+from dt_tpu.elastic import Scheduler, WorkerClient, journal
+from dt_tpu.elastic.client import WorkerRemoved
+from dt_tpu.policy import Decision, PolicyEngine, rescale
+
+
+@pytest.fixture(autouse=True)
+def _policy_env(monkeypatch):
+    monkeypatch.setenv("DT_POLICY", "1")
+    monkeypatch.setenv("DT_POLICY_STRAGGLER_MS", "50")
+    monkeypatch.delenv("DT_CTRL_ENDPOINTS", raising=False)
+    yield
+
+
+# ---------------------------------------------------------------------------
+# rescale math — the numeric oracle
+# ---------------------------------------------------------------------------
+
+def test_apportion_exact_numbers():
+    assert rescale.apportion([1, 1, 1], 10000) == [3334, 3333, 3333]
+    assert rescale.apportion([1, 0.5, 1], 10000) == [4000, 2000, 4000]
+    assert rescale.apportion([1, 1, 0.25], 10000) == [4445, 4444, 1111]
+    # equal weights, indivisible total: remainder to the lowest indices
+    assert rescale.apportion([1, 1, 1], 32) == [11, 11, 10]
+    # zero weight still gets the floor
+    assert rescale.apportion([0, 1, 0], 6, min_each=1) == [1, 4, 1]
+    # degenerate weights fall back to the equal split
+    assert rescale.apportion([0, 0], 5, min_each=0) == [3, 2]
+    with pytest.raises(ValueError):
+        rescale.apportion([1, 1], 1, min_each=1)
+
+
+def test_apportion_invariants():
+    rng = np.random.RandomState(7)
+    for _ in range(200):
+        n = int(rng.randint(1, 8))
+        total = int(rng.randint(n, 500))
+        w = rng.uniform(0, 3, n).tolist()
+        parts = rescale.apportion(w, total, min_each=1)
+        assert sum(parts) == total
+        assert min(parts) >= 1
+        # determinism: same inputs, same output
+        assert parts == rescale.apportion(w, total, min_each=1)
+
+
+def test_shrink_schedule_and_shares():
+    assert rescale.weight_for_streak(0) == 1.0
+    assert rescale.weight_for_streak(1) == 0.5
+    assert rescale.weight_for_streak(2) == 0.25
+    assert rescale.weight_for_streak(3) == 0.25  # min_frac floor
+    assert rescale.share_units(["w0", "w2", "w1"], {"w1": 1}) == \
+        {"w0": 4000, "w2": 4000, "w1": 2000}
+    assert rescale.equal_units(["a", "b", "c"]) == \
+        {"a": 3334, "b": 3333, "c": 3333}
+
+
+def test_batch_map_and_grad_weight_paper_rule():
+    units = {"w0": 4000, "w1": 2000, "w2": 4000}
+    bmap = rescale.batch_map(units, ["w0", "w1", "w2"], 32)
+    assert bmap == {"w0": 13, "w1": 6, "w2": 13}
+    assert sum(bmap.values()) == 32  # fixed global batch, exactly
+    # b_i * W / B — 13*3/32 and 6*3/32 are exact binary fractions
+    assert rescale.grad_weight(13, 3, 32) == 1.21875
+    assert rescale.grad_weight(6, 3, 32) == 0.5625
+    # hosts missing from the decision weigh in at the equal share
+    bmap2 = rescale.batch_map({"w0": 5000, "w1": 5000},
+                              ["w0", "w1", "new"], 30)
+    assert sum(bmap2.values()) == 30 and bmap2["new"] >= 1
+    assert rescale.lr_scale(48, 32) == 1.5
+    assert rescale.lr_scale(32, 32) == 1.0
+
+
+def test_weighted_average_equals_full_batch_gradient():
+    """The convergence-preservation identity: with w_i = b_i*W/B the
+    fleet's plain 1/W average of pre-weighted per-share gradients equals
+    the full fixed-global-batch gradient EXACTLY (linear model => the
+    batch gradient is the mean of per-example gradients)."""
+    rng = np.random.RandomState(3)
+    B, D = 32, 5
+    g_ex = rng.randn(B, D)  # per-example gradients
+    full = g_ex.mean(axis=0)
+    bmap = rescale.batch_map({"a": 4000, "b": 2000, "c": 4000},
+                             ["a", "b", "c"], B)
+    bounds = np.cumsum([0] + [bmap[h] for h in ("a", "b", "c")])
+    weighted = []
+    for i, h in enumerate(("a", "b", "c")):
+        local = g_ex[bounds[i]:bounds[i + 1]].mean(axis=0)
+        weighted.append(local * rescale.grad_weight(bmap[h], 3, B))
+    avg = np.mean(weighted, axis=0)
+    np.testing.assert_allclose(avg, full, rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# the decision engine — determinism over a seeded breach sequence
+# ---------------------------------------------------------------------------
+
+def _run_decision_sequence():
+    """Fake-clock EWMA inputs: a fixed per-epoch score table drives the
+    engine exactly as the scheduler would at each epoch barrier."""
+    eng = PolicyEngine(threshold_ms=50.0, shrink=0.5, min_frac=0.25,
+                       evict_after=3)
+    workers = ["w0", "w2", "w1"]
+    base = {"w0", "w2"}
+    scores_by_epoch = [
+        {},                                  # epoch 0: no rounds yet
+        {"w0": 2.0, "w2": 1.0, "w1": 205.0},
+        {"w0": 2.0, "w2": 1.5, "w1": 123.0},
+        {"w0": 1.0, "w2": 2.0, "w1": 82.0},
+    ]
+    log = []
+    streaks = {}
+    for epoch, scores in enumerate(scores_by_epoch):
+        d = eng.decide(epoch, workers, base, streaks, scores)
+        streaks = d.streaks
+        live = [h for h in workers if h not in d.evict]
+        log.append((d.epoch, tuple(d.breached),
+                    tuple(sorted(d.streaks.items())), tuple(d.evict),
+                    tuple(sorted(eng.shares(live, d.streaks).items()))))
+        workers = live
+    return log
+
+
+def test_decision_sequence_exact_and_deterministic():
+    log = _run_decision_sequence()
+    assert log == [
+        (0, (), (), (), (("w0", 3334), ("w1", 3333), ("w2", 3333))),
+        (1, ("w1",), (("w1", 1),), (),
+         (("w0", 4000), ("w1", 2000), ("w2", 4000))),
+        (2, ("w1",), (("w1", 2),), (),
+         (("w0", 4445), ("w1", 1111), ("w2", 4444))),
+        # streak 3 >= evict_after: w1 (non-base) leaves; survivors split
+        (3, ("w1",), (("w1", 3),), ("w1",),
+         (("w0", 5000), ("w2", 5000))),
+    ]
+    # two-run determinism of the full log, bit for bit
+    assert log == _run_decision_sequence()
+
+
+def test_base_workers_never_evicted_and_scale_proposals():
+    eng = PolicyEngine(threshold_ms=50.0, evict_after=2,
+                       target_workers=4)
+    d = eng.decide(5, ["w0", "w1"], {"w0", "w1"}, {"w0": 1},
+                   {"w0": 999.0, "w1": 1.0})
+    assert d.evict == []  # base protection beats chronic breaching
+    assert d.streaks == {"w0": 2}
+    assert d.proposals == [{"kind": "scale_up", "want": 2}]
+    # scale-down names the slowest NON-base worker
+    eng2 = PolicyEngine(threshold_ms=50.0, target_workers=2)
+    d2 = eng2.decide(1, ["w0", "w1", "w2"], {"w0"}, {},
+                     {"w1": 10.0, "w2": 30.0})
+    assert d2.proposals == [{"kind": "scale_down", "host": "w2"}]
+
+
+def test_empty_scores_hold_streaks_not_reset():
+    """A fresh leader's EWMA sensor is empty right after failover (the
+    board is deliberately unjournaled); an empty signal must HOLD the
+    journaled streaks — resetting them would silently revert an
+    in-flight rebalance the journal exists to preserve."""
+    eng = PolicyEngine(threshold_ms=50.0, evict_after=5)
+    d = eng.decide(4, ["w0", "w2", "w1"], {"w0", "w2"},
+                   {"w1": 2, "gone": 3}, {})
+    assert d.breached == []
+    assert d.streaks == {"w1": 2}  # held (departed hosts dropped)
+    assert d.evict == []
+    # shares therefore stay shrunk across the failover barrier
+    assert eng.shares(["w0", "w2", "w1"], d.streaks)["w1"] == 1111
+    # one observed round resumes normal decisions (here: w1 recovered)
+    d2 = eng.decide(5, ["w0", "w2", "w1"], {"w0", "w2"}, d.streaks,
+                    {"w0": 1.0, "w2": 1.0, "w1": 2.0})
+    assert d2.streaks == {}
+
+
+def test_engine_from_env(monkeypatch):
+    monkeypatch.setenv("DT_POLICY_STRAGGLER_MS", "")
+    monkeypatch.setenv("DT_STRAGGLER_MS", "321")
+    monkeypatch.setenv("DT_POLICY_EVICT_AFTER", "4")
+    eng = PolicyEngine.from_env()
+    assert eng.threshold_ms == 321.0
+    assert eng.evict_after == 4
+    assert eng.shrink == 0.5 and eng.min_frac == 0.25
+
+
+# ---------------------------------------------------------------------------
+# ControlState policy ops — idempotence + replay (the HA contract)
+# ---------------------------------------------------------------------------
+
+def test_policy_ops_idempotent_and_replayable(tmp_path):
+    path = str(tmp_path / "j")
+    w = journal.JournalWriter(path)
+    st = journal.ControlState()
+    for op, kw in [
+        ("init", {"workers": ["w0", "w2"], "expected": 2}),
+        ("worker_add", {"host": "w1", "base": False}),
+        ("policy_decide", {"epoch": 1, "seq": 1, "breached": ["w1"],
+                           "streaks": {"w1": 1},
+                           "shares": {"w0": 4000, "w2": 4000,
+                                      "w1": 2000}}),
+        ("mc_begin", {"epoch": 2}),
+        ("mc_remove", {"host": "w1", "seq": 1}),
+        ("policy_decide", {"epoch": 2, "seq": 2, "breached": ["w1"],
+                           "streaks": {}, "shares": {"w0": 5000,
+                                                     "w2": 5000},
+                           "evicted": ["w1"]}),
+        ("barrier_complete", {"epoch": 2, "result": {"workers":
+                                                     ["w0", "w2"],
+                                                     "removed": ["w1"],
+                                                     "added": [],
+                                                     "epoch": 2}}),
+    ]:
+        w.append(op, kw)
+        st.apply(op, **kw)
+    w.close()
+    # the removal op scrubbed w1 off the policy board before decision 2
+    assert st.policy_shares == {"w0": 5000, "w2": 5000}
+    assert st.policy_streaks == {}
+    assert st.policy_seq == 2
+    assert [d["seq"] for d in st.policy_log] == [1, 2]
+    assert st.policy_log[1]["evicted"] == ["w1"]
+    # rebuild == live (deterministic replay), and twice == once
+    assert journal.ControlState.rebuild(path).struct() == st.struct()
+    st2 = journal.ControlState.rebuild(path)
+    for _f, op, kw in journal.replay(path):
+        st2.apply(op, **kw)
+    assert st2.struct() == st.struct()
+
+
+# ---------------------------------------------------------------------------
+# weighted data sharding
+# ---------------------------------------------------------------------------
+
+def test_ndarray_iter_weighted_shard_disjoint_exhaustive():
+    from dt_tpu import data
+    x = np.arange(100, dtype=np.float32).reshape(100, 1)
+    y = np.arange(100, dtype=np.int32)
+    weights = [13.0, 6.0, 13.0]
+    seen = []
+    sizes = []
+    for part in range(3):
+        it = data.NDArrayIter(x, y, batch_size=4, shuffle=True, seed=5,
+                              num_parts=3, part_index=part,
+                              part_weights=weights)
+        got = []
+        while True:
+            try:
+                b = it.next()
+            except StopIteration:
+                break
+            got.extend(int(v) for v in
+                       np.asarray(b.label)[:b.label.shape[0] - b.pad])
+        sizes.append(it.num_examples)
+        seen.extend(got[:it.num_examples])
+    # proportional largest-remainder split of 100 over 13/6/13
+    assert sizes == rescale.apportion(weights, 100, min_each=0)
+    assert sizes == [41, 19, 40]
+    # disjoint and exhaustive across parts
+    assert sorted(seen) == list(range(100))
+
+
+def test_elastic_iterator_share_aware():
+    from dt_tpu.data.io import ElasticDataIterator
+
+    class Ctrl:
+        host = "w1"
+        workers = ["w0", "w1", "w2"]
+        policy_shares = {"w0": 4000, "w1": 2000, "w2": 4000}
+
+    class KV:
+        _controller = Ctrl()
+        num_workers = 3
+        rank = 1
+
+    calls = []
+
+    def factory(num_parts, part_index, batch_size, weights=None):
+        calls.append((num_parts, part_index, batch_size, weights))
+        return "train", None
+
+    eit = ElasticDataIterator(factory, global_batch_size=32)
+    assert eit.get_data_iterator(KV()) == ("train", None)
+    assert calls == [(3, 1, 6, [13.0, 6.0, 13.0])]
+
+    # a 3-arg factory still works (weighted batch, equal shard)
+    legacy = []
+
+    def factory3(num_parts, part_index, batch_size):
+        legacy.append((num_parts, part_index, batch_size))
+        return "t", None
+
+    ElasticDataIterator(factory3, 32).get_data_iterator(KV())
+    assert legacy == [(3, 1, 6)]
+
+    # no shares -> the historical equal path
+    class KVPlain:
+        _controller = None
+        num_workers = 4
+        rank = 2
+
+    calls.clear()
+    eit2 = ElasticDataIterator(factory, global_batch_size=32)
+    eit2.get_data_iterator(KVPlain())
+    assert calls == [(4, 2, 8, None)]
+
+    # fixed_per_worker_batch: shares must not reshape batches (io.py
+    # guard) NOR pre-weight gradients (the matching module.py guard)
+    calls.clear()
+    eit_fixed = ElasticDataIterator(factory, global_batch_size=32,
+                                    fixed_per_worker_batch=True)
+    eit_fixed.get_data_iterator(KV())
+    assert calls == [(3, 1, 32, None)]
+    from dt_tpu.training.module import Module
+
+    class _FakeMod:
+        kv = KV()
+        sync_mode = "host"
+    assert Module._policy_grad_scale(_FakeMod(), eit_fixed) == 1.0
+    eit_weighted = ElasticDataIterator(factory, global_batch_size=32)
+    assert Module._policy_grad_scale(_FakeMod(), eit_weighted) == 0.5625
+
+    # a *args factory keeps its legacy 3-arg contract (only an explicit
+    # `weights` parameter opts into the 4th argument)
+    star = []
+
+    def factory_star(*args):
+        star.append(args)
+        return "t", None
+
+    ElasticDataIterator(factory_star, 32).get_data_iterator(KV())
+    assert star == [(3, 1, 6)]
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: shares ride the barrier, eviction via the
+# membership machinery, journal replay preserves the rebalance
+# ---------------------------------------------------------------------------
+
+def _write_hosts(path, hosts):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(hosts) + "\n")
+    os.replace(tmp, path)
+
+
+def _seed_lag(sched, scores):
+    """Install straggler EWMAs on the scheduler's data plane (the unit
+    seam for the timing-driven signal the chaos harness produces for
+    real)."""
+    dp = sched._dp
+    with dp._cv:
+        dp._straggler.clear()
+        dp._straggler.update(scores)
+
+
+def _barrier_all(clients, epoch):
+    results, errs = {}, {}
+
+    def run(c):
+        try:
+            c.membership_change_barrier({"EPOCH_BEGIN": epoch})
+            results[c.host] = dict(c.policy_shares)
+        except WorkerRemoved:
+            errs[c.host] = "removed"
+
+    ts = [threading.Thread(target=run, args=(c,)) for c in clients]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    return results, errs
+
+
+def test_scheduler_policy_rebalance_and_eviction(tmp_path, monkeypatch):
+    monkeypatch.setenv("DT_POLICY_EVICT_AFTER", "2")
+    hw = str(tmp_path / "host_worker")
+    jpath = str(tmp_path / "ctrl.journal")
+    _write_hosts(hw, ["w0", "w2"])
+    s = Scheduler(host_worker_file=hw, journal_path=jpath)
+    try:
+        assert s._policy is not None
+        assert s._dp._track_lag  # lag stamps on without DT_OBS
+        c0 = WorkerClient("127.0.0.1", s.port, host="w0", is_new=False,
+                          heartbeat_interval_s=30.0)
+        c2 = WorkerClient("127.0.0.1", s.port, host="w2", is_new=False,
+                          heartbeat_interval_s=30.0)
+        _write_hosts(hw, ["w0", "w2", "w1"])  # w1 joins elastic
+        res, errs = _barrier_all([c0, c2], epoch=0)
+        assert not errs
+        c1 = WorkerClient("127.0.0.1", s.port, host="w1", is_new=True,
+                          heartbeat_interval_s=30.0)
+        c1.membership_change_barrier({"EPOCH_BEGIN": 0})
+        # epoch 0: no lag signal yet -> the equal baseline decision
+        assert c1.policy_shares == {"w0": 3334, "w2": 3333, "w1": 3333}
+        assert c1.policy_seq == 1
+
+        # epoch 1: w1 breaches -> its share shrinks, everyone receives
+        # the SAME map in the barrier response
+        _seed_lag(s, {"w0": 2.0, "w2": 1.0, "w1": 205.0})
+        res, errs = _barrier_all([c0, c2, c1], epoch=1)
+        assert not errs
+        assert res["w0"] == res["w1"] == res["w2"] == \
+            {"w0": 4000, "w2": 4000, "w1": 2000}
+
+        # epoch 2: second consecutive breach >= evict_after=2 -> w1 is
+        # dropped from host_worker and removed by the SAME barrier's
+        # diff; survivors re-split equally
+        _seed_lag(s, {"w0": 2.0, "w2": 1.0, "w1": 123.0})
+        res, errs = _barrier_all([c0, c2, c1], epoch=2)
+        assert errs == {"w1": "removed"}
+        assert res["w0"] == {"w0": 5000, "w2": 5000}
+        assert "w1" not in open(hw).read().split()
+        with s._lock:
+            log = [dict(d) for d in s._state.policy_log]
+            live = s._state.struct()
+        assert [d["epoch"] for d in log] == [0, 1, 2]
+        assert log[2]["evicted"] == ["w1"]
+        # failover contract: a fresh replay of the journal equals the
+        # live state, policy fields included
+        assert journal.ControlState.rebuild(jpath).struct() == live
+        c0.close()
+        c2.close()
+        c1.close()
+    finally:
+        s.close()
+
+
+def test_scheduler_scale_down_acts_through_membership(tmp_path,
+                                                      monkeypatch):
+    """DT_POLICY_TARGET_WORKERS below the fleet size: the slowest
+    non-base worker is dropped from host_worker and removed by the same
+    barrier's diff (scale-down through the membership machinery)."""
+    monkeypatch.setenv("DT_POLICY_TARGET_WORKERS", "2")
+    hw = str(tmp_path / "host_worker")
+    _write_hosts(hw, ["w0", "w2"])
+    s = Scheduler(host_worker_file=hw)
+    try:
+        c0 = WorkerClient("127.0.0.1", s.port, host="w0", is_new=False,
+                          heartbeat_interval_s=30.0)
+        c2 = WorkerClient("127.0.0.1", s.port, host="w2", is_new=False,
+                          heartbeat_interval_s=30.0)
+        _write_hosts(hw, ["w0", "w2", "w1"])
+        _barrier_all([c0, c2], epoch=0)  # admits w1's listing
+        c1 = WorkerClient("127.0.0.1", s.port, host="w1", is_new=True,
+                          heartbeat_interval_s=30.0)
+        c1.membership_change_barrier({"EPOCH_BEGIN": 0})
+        res, errs = _barrier_all([c0, c2, c1], epoch=1)
+        assert errs == {"w1": "removed"}
+        assert res["w0"] == {"w0": 5000, "w2": 5000}
+        with s._lock:
+            props = [p for d in s._state.policy_log
+                     for p in d["proposals"]]
+        assert {"kind": "scale_down", "host": "w1"} in props
+        c0.close()
+        c2.close()
+        c1.close()
+    finally:
+        s.close()
+
+
+def test_eviction_without_host_file_demotes_to_proposal(tmp_path,
+                                                        monkeypatch):
+    """No host_worker file = no removal path through the diff: a
+    chronic straggler's eviction becomes an advisory {'kind': 'evict'}
+    proposal — journaled ONCE (proposal dedup), not re-recorded every
+    epoch, and the worker stays in the job."""
+    monkeypatch.setenv("DT_POLICY_EVICT_AFTER", "1")
+    from dt_tpu.obs import trace as obs_trace
+    obs_trace.set_enabled(True)  # record the policy.* events
+    s = Scheduler(initial_workers=["w0"])
+    try:
+        c0 = WorkerClient("127.0.0.1", s.port, host="w0", is_new=False,
+                          heartbeat_interval_s=30.0)
+        c1 = WorkerClient("127.0.0.1", s.port, host="w1", is_new=True,
+                          heartbeat_interval_s=30.0)
+        for epoch in range(10):
+            _seed_lag(s, {"w0": 1.0, "w1": 500.0})
+            res, errs = _barrier_all([c0, c1], epoch=epoch)
+            assert not errs  # never actually removed
+        with s._lock:
+            log = [dict(d) for d in s._state.policy_log]
+            assert "w1" in s._state.workers
+        props = [p for d in log for p in d["proposals"]]
+        assert {"kind": "evict", "host": "w1"} in props
+        assert all(d["evicted"] == [] for d in log)
+        # streak saturation (cap 8): once the streak stops growing and
+        # the pending proposal is unchanged, NOTHING new is journaled —
+        # a chronic eviction-blocked straggler cannot grow the journal
+        # one decision per epoch forever
+        assert log[-1]["streaks"] == {"w1": 8}
+        assert len(log) == 8
+        # policy.evict fired for the demoted proposal exactly once (new
+        # proposals only), never under the scale name
+        evs = [r for r in s._obs.snapshot()["records"]
+               if r[0] == "i" and r[2].startswith("policy.")]
+        kinds = [r[2] for r in evs]
+        assert kinds.count("policy.evict") == 1
+        assert "policy.scale" not in kinds
+        c0.close()
+        c1.close()
+    finally:
+        s.close()
+        obs_trace.set_enabled(None)
+
+
+def test_obs_dump_and_dtop_policy_section(tmp_path):
+    """The policy view rides obs_dump → export → .metrics.json → the
+    dtop "policy decisions" section (one-shot and --follow share
+    render())."""
+    import json
+    import sys
+
+    from dt_tpu.obs import export as obs_export
+    hw = str(tmp_path / "host_worker")
+    _write_hosts(hw, ["w0", "w1"])
+    s = Scheduler(host_worker_file=hw)
+    try:
+        cs = [WorkerClient("127.0.0.1", s.port, host=h, is_new=False,
+                           heartbeat_interval_s=30.0)
+              for h in ("w0", "w1")]
+        _barrier_all(cs, epoch=0)
+        _seed_lag(s, {"w0": 1.0, "w1": 150.0})
+        _barrier_all(cs, epoch=1)
+        trace = str(tmp_path / "t.json")
+        summary = obs_export.write(trace, s.obs_dump())
+        assert summary["policy"]["shares"] == {"w0": 6667, "w1": 3333}
+        assert [d["epoch"] for d in summary["policy"]["log"]] == [0, 1]
+        # the metrics sidecar carries the same section
+        m = json.load(open(obs_export.metrics_path(trace)))
+        assert m["policy"]["streaks"] == {"w1": 1}
+        tools_dir = os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools")
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        import dtop
+        out = dtop.render(summary)
+        assert "policy decisions" in out
+        assert "batch shares: w0=6667 (66.7%)  w1=3333 (33.3%)" in out
+        assert "breached=['w1']" in out
+        for c in cs:
+            c.close()
+    finally:
+        s.close()
+
+
+def test_policy_off_means_no_payload(tmp_path, monkeypatch):
+    monkeypatch.setenv("DT_POLICY", "")
+    hw = str(tmp_path / "host_worker")
+    _write_hosts(hw, ["w0"])
+    s = Scheduler(host_worker_file=hw)
+    try:
+        assert s._policy is None
+        c0 = WorkerClient("127.0.0.1", s.port, host="w0", is_new=False,
+                          heartbeat_interval_s=30.0)
+        c0.membership_change_barrier({"EPOCH_BEGIN": 0})
+        assert c0.policy_shares == {} and c0.policy_seq == 0
+        with s._lock:
+            assert s._state.policy_log == []
+        c0.close()
+    finally:
+        s.close()

@@ -6,7 +6,7 @@
 # UNAVAILABLE after ~25 min).  The moment one probe succeeds, capture as
 # much TPU evidence as possible while the tunnel is provably healthy:
 #   1. bench.py default tiers (resnet18 -> transformer_lm -> resnet152,
-#      the BASELINE row) — every TPU tier appends to BENCH_local_r05.jsonl
+#      the BASELINE row) — every TPU tier appends to BENCH_r14.jsonl
 #   2. the other reference baseline rows (inception_v3 b32@299,
 #      alexnet b512) — best effort
 #   3. tools/profile_step.py trace of the ResNet-152 step

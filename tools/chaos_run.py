@@ -37,11 +37,29 @@ incarnation, the timeline shows exactly ONE ``scheduler.failover`` span
 under 10 s, and (via ``--expect-param-hash`` against a ``--plan none``
 run) final params are bit-identical to the kill-free baseline.
 
+**Straggler plan (r14 policy engine, docs/policy.md):** ``--plan
+straggler`` arms the scheduler-side policy engine (``DT_POLICY=1``,
+breach threshold 50 ms, eviction after 3 consecutive breaches) and
+makes ``w1`` a genuinely slow worker: a site-scoped delay rule fires at
+the ``worker.step`` hook with the sleep scaled by w1's CURRENT batch
+share, so the injected stall shrinks exactly as the policy shrinks the
+share (the dynamic mini-batch effect under test).  ``w1`` joins as an
+ELASTIC worker (base workers are eviction-protected).  Success adds:
+every policy breach names w1 and only w1, a rebalance decision shrinks
+w1's share below its equal split, w1 is auto-evicted through the
+``membership_change`` machinery, survivors hold bit-identical params,
+and the last epoch's step rate recovers to >= 80% of the fault-free
+estimate (epoch wall minus injected sleep; or pass the ``--plan none``
+run's rate via ``--expect-step-rate`` for an external baseline).  The
+decision log's sha256 is printed — two runs at the same seed must
+print the same hash (bit-reproducible decisions).
+
 Usage::
 
     python tools/chaos_run.py --seed 0 --plan default
     python tools/chaos_run.py --plan none          # fault-free baseline
     python tools/chaos_run.py --plan scheduler_kill   # HA failover drill
+    python tools/chaos_run.py --plan straggler     # policy-engine drill
 
 Prints one JSON summary line and exits non-zero on any failed check.
 """
@@ -69,6 +87,11 @@ CRASH_EPOCH = 3
 #: causal-attribution acceptance check of the cross-process tracing
 STRAGGLE_HOST = "w1"
 STRAGGLE_DELAY_S = 0.15
+#: the straggler plan's per-step compute stall (seconds, scaled by the
+#: worker's live batch share) and the policy knobs it runs under
+POLICY_DELAY_S = 0.5
+POLICY_ENV = {"DT_POLICY": "1", "DT_POLICY_STRAGGLER_MS": "50",
+              "DT_POLICY_EVICT_AFTER": "3"}
 
 #: scheduler-kill sites per HA plan (rule kwargs for the one crash rule
 #: the PRIMARY scheduler process loads via DT_FAULT_PLAN).  The `after`
@@ -127,6 +150,12 @@ def _plans(num_epoch):
         "noise": (noise, sched_noise),          # churn-free transport fuzz
         "default": (noise + crash, sched_noise),  # fuzz + crash + recovery
         "crash-only": (crash, []),
+        # the r14 policy drill: a site-scoped compute delay on ONE
+        # worker, scaled by its live batch share (tests/elastic_worker.py
+        # SlowIter) — rebalancing measurably recovers step rate
+        "straggler": ([FaultRule("delay", site="worker.step",
+                                 host=STRAGGLE_HOST,
+                                 delay_s=POLICY_DELAY_S)], []),
     }
     # scheduler-kill plans: clean worker transport (the fault under test
     # is the CONTROL PLANE dying, and bit-identity vs --plan none is an
@@ -166,8 +195,8 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--plan", default="default",
-                    choices=["default", "noise", "crash-only", "none"]
-                    + sorted(SCHED_KILL_SITES))
+                    choices=["default", "noise", "crash-only", "none",
+                             "straggler"] + sorted(SCHED_KILL_SITES))
     ap.add_argument("--num-epoch", type=int, default=8)
     ap.add_argument("--timeout-s", type=float, default=1200.0)
     ap.add_argument("--trace", default="",
@@ -185,9 +214,20 @@ def main():
                          "params; a faulted run does NOT match --plan "
                          "none bitwise: the crash shrinks membership "
                          "for some rounds, in both modes, by design)")
+    ap.add_argument("--expect-step-rate", type=float, default=0.0,
+                    help="steps/sec of a --plan none run at the same "
+                         "config; the straggler plan's recovery gate "
+                         "becomes last-epoch rate >= 0.8x this (without "
+                         "it, the fault-free rate is estimated as epoch "
+                         "wall minus the known injected sleep)")
     args = ap.parse_args()
 
     ha_plan = args.plan in SCHED_KILL_SITES
+    policy_plan = args.plan == "straggler"
+    if policy_plan:
+        # arm the policy engine BEFORE the in-process scheduler is built;
+        # workers inherit through _spawn's env copy
+        os.environ.update(POLICY_ENV)
     if args.trace or ha_plan:
         # before any dt_tpu.obs use: the scheduler reads it in-process,
         # workers inherit it through _spawn's env copy.  The HA plans
@@ -210,8 +250,13 @@ def main():
 
     tmp = tempfile.mkdtemp(prefix="chaos_run_")
     hw = os.path.join(tmp, "host_worker")
+    # straggler plan: the probe host joins as an ELASTIC worker (not in
+    # the base line-set) so the policy engine may evict it — base
+    # workers are eviction-protected (README.md:54-61)
+    base_hosts = [h for h in HOSTS if h != STRAGGLE_HOST] \
+        if policy_plan else HOSTS
     with open(hw, "w") as f:
-        f.write("\n".join(HOSTS) + "\n")
+        f.write("\n".join(base_hosts) + "\n")
     outs = {h: os.path.join(tmp, f"{h}.json") for h in HOSTS}
     primary_proc = None
     worker_extra = {}
@@ -251,10 +296,31 @@ def main():
         sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=30.0,
                           journal_path=journal)
         spawn_port = sched.port
-    procs = {h: _spawn(spawn_port, h, outs[h], args.num_epoch,
-                       worker_plan.to_json() if worker_rules else "",
-                       extra_env=worker_extra)
-             for h in HOSTS}
+    plan_json = worker_plan.to_json() if worker_rules else ""
+    if policy_plan:
+        # list the elastic probe host in host_worker AFTER the scheduler
+        # captured the base set, and register it BEFORE the base workers
+        # can reach their first barrier — the epoch-0 barrier must see
+        # the full fleet or the probe would enter as a mid-epoch joiner
+        with open(hw, "a") as f:
+            f.write(STRAGGLE_HOST + "\n")
+        procs = {STRAGGLE_HOST: _spawn(
+            spawn_port, STRAGGLE_HOST, outs[STRAGGLE_HOST],
+            args.num_epoch, plan_json,
+            extra_env={**worker_extra, "NEW_WORKER": "1"})}
+        reg_deadline = time.time() + 120
+        while STRAGGLE_HOST not in sched._workers:
+            if time.time() > reg_deadline:
+                raise SystemExit("straggler probe worker never registered")
+            time.sleep(0.1)
+        for h in HOSTS:
+            if h != STRAGGLE_HOST:
+                procs[h] = _spawn(spawn_port, h, outs[h], args.num_epoch,
+                                  plan_json, extra_env=worker_extra)
+    else:
+        procs = {h: _spawn(spawn_port, h, outs[h], args.num_epoch,
+                           plan_json, extra_env=worker_extra)
+                 for h in HOSTS}
     expect_crash = any(r.kind == "crash" for r in worker_rules)
     restarted = False
     deadline = time.time() + args.timeout_s
@@ -301,24 +367,29 @@ def main():
             except (OSError, ValueError):
                 checks[f"result_{h}"] = False
         param_hash = None
+        # the straggler plan EVICTS the probe host by design: the
+        # bit-identity / lockstep / membership checks cover the
+        # survivors (the evictee's params froze at its removal epoch)
+        final_hosts = [h for h in HOSTS
+                       if not (policy_plan and h == STRAGGLE_HOST)]
         if len(results) == len(HOSTS):
             losses = [r["final_loss"] for r in results.values()]
             checks["loss_finite"] = all(math.isfinite(l) for l in losses)
             checks["params_identical"] = \
-                len({r["param_hash"] for r in results.values()}) == 1
+                len({results[h]["param_hash"] for h in final_hosts}) == 1
             if checks["params_identical"]:
-                param_hash = next(iter(results.values()))["param_hash"]
+                param_hash = results[final_hosts[0]]["param_hash"]
             if args.expect_param_hash:
                 # the overlapped host-sync pipeline under the fault plan
                 # must be bit-identical to the fault-free baseline run
                 checks["params_match_baseline"] = \
                     repr(param_hash) == args.expect_param_hash
             checks["steps_identical"] = \
-                len({r["final_step"] for r in results.values()}) == 1
+                len({results[h]["final_step"] for h in final_hosts}) == 1
             checks["membership_converged"] = (
-                sorted(sched._workers) == sorted(HOSTS)
-                and all(r["num_workers_at_end"] == len(HOSTS)
-                        for r in results.values()))
+                sorted(sched._workers) == sorted(final_hosts)
+                and all(results[h]["num_workers_at_end"]
+                        == len(final_hosts) for h in final_hosts))
             if expect_crash:
                 checks["crash_recovered"] = restarted and \
                     "RECOVERED w2" in open(hw + "_log").read()
@@ -340,6 +411,76 @@ def main():
             live_struct = sched._state.struct()
             rebuilt = ctrl_journal.ControlState.rebuild(journal).struct()
         checks["journal_replay_matches"] = rebuilt == live_struct
+
+        policy_summary = None
+        if policy_plan:
+            import hashlib
+            import statistics
+            from dt_tpu.policy import rescale as policy_rescale
+            with sched._lock:
+                plog = [dict(d) for d in sched._state.policy_log]
+                live_shares = dict(sched._state.policy_shares)
+            # bit-reproducibility evidence: two runs at the same seed
+            # must print the same decision-log hash (and the replay
+            # check above already pins journal == live)
+            log_sha = hashlib.sha256(
+                json.dumps(plog, sort_keys=True).encode()).hexdigest()
+            equal_share = policy_rescale.UNITS // len(HOSTS)
+            breaches = [d.get("breached", []) for d in plog]
+            # every breach names the seeded straggler and nobody else
+            checks["policy_blames_straggler"] = (
+                any(b == [STRAGGLE_HOST] for b in breaches)
+                and all(b in ([], [STRAGGLE_HOST]) for b in breaches))
+            # a rebalance decision shrank the straggler's share
+            checks["policy_rebalance_fired"] = any(
+                d.get("shares", {}).get(STRAGGLE_HOST, 1 << 30)
+                < equal_share for d in plog)
+            # the chronic straggler was evicted through the normal
+            # membership_change machinery
+            checks["policy_evicted_straggler"] = (
+                any(STRAGGLE_HOST in d.get("evicted", ()) for d in plog)
+                and STRAGGLE_HOST not in sched._workers)
+            # step-rate recovery: (epoch wall - injected sleep) is the
+            # fault-free epoch-time estimate — the harness KNOWS the
+            # stall it injected; --expect-step-rate swaps in a measured
+            # --plan none baseline instead
+            rate_last = rate_base = None
+            surv = results.get(final_hosts[0], {})
+            times = surv.get("epoch_times") or []
+            sleeps = results.get(STRAGGLE_HOST, {}) \
+                .get("sleep_by_epoch") or []
+            steps = surv.get("steps_per_epoch") or 0
+            base = [times[i] - sleeps[i]
+                    for i in range(min(len(times), len(sleeps)))
+                    if sleeps[i] > 0 and times[i] > sleeps[i]]
+            if times and steps:
+                rate_last = steps / times[-1]
+            if base and rate_last:
+                base_med = statistics.median(base)
+                rate_base = steps / base_med
+            if args.expect_step_rate and rate_last:
+                # an externally measured baseline needs only the final
+                # rate — it must work even when the internal sleep-based
+                # estimate is not computable
+                checks["step_rate_recovered"] = \
+                    rate_last >= 0.8 * args.expect_step_rate
+            elif rate_base and rate_last:
+                # 1/0.8 = 1.25x the estimate, plus a 1 s grace for
+                # CPU scheduling noise on these short epochs
+                base_med = steps / rate_base
+                checks["step_rate_recovered"] = \
+                    times[-1] <= max(1.25 * base_med, base_med + 1.0)
+            else:
+                checks["step_rate_recovered"] = False
+            policy_summary = {
+                "decision_log": plog,
+                "decision_log_sha256": log_sha,
+                "final_shares": live_shares,
+                "rate_last_steps_per_s":
+                    round(rate_last, 3) if rate_last else None,
+                "rate_fault_free_est_steps_per_s":
+                    round(rate_base, 3) if rate_base else None,
+                "straggler_scores": sched._dp.straggler_scores()}
 
         failover_ms = None
         if ha_plan:
@@ -477,6 +618,7 @@ def main():
                 pipeline_buckets if summary else None,
             "causal": summary.get("causal") if summary else None,
             "straggler": summary.get("straggler") if summary else None,
+            "policy": policy_summary,
             "transport": tstats,
             "final_loss": {h: r.get("final_loss")
                            for h, r in results.items()},

@@ -3,7 +3,9 @@
 
 Renders step-time percentiles, stall attribution, the r13 critical-path
 split (compute / d2h / send / server queue / straggler-wait / reply /
-h2d), the straggler board, per-worker retry/fault counts, and the
+h2d), the straggler board, the r14 policy-decisions section (current
+batch shares, breach streaks, decision timeline — ``docs/policy.md``),
+per-worker retry/fault counts, and the
 membership/leadership timeline from either a merged chrome trace
 written by ``dt_tpu.obs.export`` (e.g. ``tools/chaos_run.py --trace
 out.json``) or a LIVE scheduler (the ``obs_dump`` control command — the
@@ -149,6 +151,39 @@ def render(summary) -> str:
         lines.append("straggler board (round-lag EWMA ms):")
         for h, v in sorted(stragglers.items(), key=lambda kv: -kv[1]):
             lines.append(f"  {h:<20}{v:10.1f}")
+    # policy decisions (r14, dt_tpu/policy): current batch shares,
+    # breach streaks, and the decision timeline — from obs_dump (live)
+    # or the .metrics.json snapshot, same section either way
+    pol = summary.get("policy", {})
+    if pol.get("enabled") or pol.get("log"):
+        lines.append("")
+        lines.append(f"policy decisions (seq {pol.get('seq', 0)}, "
+                     f"lr_scale {pol.get('lr_scale', 1.0):g}):")
+        shares = pol.get("shares") or {}
+        if shares:
+            total = sum(shares.values()) or 1
+            parts = "  ".join(
+                f"{h}={u} ({100.0 * u / total:.1f}%)"
+                for h, u in sorted(shares.items()))
+            lines.append(f"  batch shares: {parts}")
+        streaks = {h: s for h, s in (pol.get("streaks") or {}).items()
+                   if s}
+        if streaks:
+            lines.append("  breach streaks: " + "  ".join(
+                f"{h}={s}" for h, s in sorted(streaks.items())))
+        for d in pol.get("log", []):
+            what = []
+            if d.get("breached"):
+                what.append(f"breached={d['breached']}")
+            if d.get("evicted"):
+                what.append(f"evicted={d['evicted']}")
+            for p in d.get("proposals", []):
+                what.append(f"proposal={p}")
+            sh = d.get("shares") or {}
+            what.append("shares=" + "/".join(
+                str(sh[h]) for h in sorted(sh)))
+            lines.append(f"  #{d.get('seq')} epoch {d.get('epoch')}: "
+                         + "  ".join(what))
     causal = summary.get("causal", {})
     if causal.get("client_spans"):
         lines.append("")
