@@ -255,7 +255,8 @@ def main():
         head = next((completed[n] for n in priority if n in completed),
                     result)
         others = {n: {k: r[k] for k in ("metric", "value", "unit", "mfu",
-                                        "step_ms", "sync_agreement")
+                                        "step_ms", "sync_agreement",
+                                        "steps_per_sec", "final_loss")
                       if k in r}
                   for n, r in completed.items()
                   if r is not head}
@@ -330,6 +331,19 @@ def _peak_tflops(device_kind: str):
         if key in kind:
             return peak
     return None
+
+
+def _bench_health(tier, dt_step, loss):
+    """r15: per-tier training-health gauges for the committed BENCH
+    jsonl — step rate and final loss land next to ``sync_agreement``,
+    so the evidence trajectory carries health series from the first
+    successful TPU tier onward (ISSUE 12 satellite; the live
+    time-series plane belongs to training jobs — a bench child has no
+    heartbeat export or scraper, so the row fields ARE the surface)."""
+    import jax
+    del tier  # rows are already per-tier; kept for call-site clarity
+    return {"steps_per_sec": round(1.0 / dt_step, 3),
+            "final_loss": round(float(jax.device_get(loss)), 5)}
 
 
 def measure_tier(net, batch, size):
@@ -471,6 +485,7 @@ def measure_tier(net, batch, size):
         "mfu": round(model_tflops / peak, 3) if peak and flops_per_img
         else None,
         "backend": jax.default_backend(),
+        **_bench_health(net, dt_step, loss),
     }
 
 
@@ -588,6 +603,7 @@ def measure_tier_lm():
         "mfu": round(model_tflops / peak, 3)
         if peak and model_tflops else None,
         "backend": jax.default_backend(),
+        **_bench_health("transformer_lm", dt_step, loss),
     }
 
 

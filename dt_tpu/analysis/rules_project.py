@@ -287,9 +287,15 @@ _OBS_NAMES_RELPATH = "dt_tpu/obs/names.py"
 #: tracer emission methods whose first literal argument is an obs name.
 #: Read-side accessors (get_counter, counters) are not emission and may
 #: query any name.
-_OBS_EMITTERS = frozenset({"span", "complete_span", "event", "counter"})
+#: r15 adds the metrics-plane emitters: ``MetricsRegistry.gauge`` /
+#: ``.observe`` (``dt_tpu/obs/metrics.py``) are held to the same catalog
+#: as spans/events/counters — a renamed gauge must fail the lint, not
+#: silently vanish from the Prometheus exposition and dtop health board
+_OBS_EMITTERS = frozenset({"span", "complete_span", "event", "counter",
+                           "gauge", "observe"})
 _OBS_KIND_OF = {"span": "span", "complete_span": "span",
-                "event": "event", "counter": "counter"}
+                "event": "event", "counter": "counter",
+                "gauge": "gauge", "observe": "histogram"}
 
 
 def _load_obs_registry(project: ProjectContext) -> Dict[str, Tuple[str,

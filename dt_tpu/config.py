@@ -82,6 +82,13 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "DT_OBS": ("", "1 = enable dt_tpu.obs tracing (span/event ring buffer + heartbeat export)"),
     "DT_OBS_RING": (str(4096), "obs ring-buffer capacity (records per tracer; overflow drops oldest)"),
     "DT_STRAGGLER_MS": ("500", "round-contribution-lag EWMA threshold (ms) that fires the worker.straggler event"),
+    # metrics / health plane (dt_tpu/obs/metrics.py — docs/observability.md r15)
+    "DT_METRICS": ("", "1 = enable the dt_tpu.obs.metrics plane (gauges/histograms, time-series sampling, heartbeat export, health RPC)"),
+    "DT_METRICS_INTERVAL_S": ("2.0", "wall-clock cadence of the per-process time-series sampler"),
+    "DT_METRICS_RING": ("360", "time-series ring capacity (samples per process; overflow drops oldest)"),
+    "DT_METRICS_PORT": ("", "scheduler Prometheus/health HTTP port (empty = no endpoint; 0 = ephemeral for tests)"),
+    "DT_HEALTH_HALT": ("", "1 = training-health sentinel stops cleanly BEFORE a non-finite update is applied"),
+    "DT_SLO_RULES": ("", "JSON list (or @/path) overriding the default SLO rule set by rule name (dt_tpu.obs.metrics.DEFAULT_SLO_RULES)"),
     # policy engine (dt_tpu/policy — straggler-adaptive dynamic mini-batch
     # + autoscaling; docs/policy.md)
     "DT_POLICY": ("", "1 = enable the scheduler-side policy engine (batch-share rebalancing, auto-eviction, scale proposals)"),

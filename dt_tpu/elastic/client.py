@@ -23,6 +23,7 @@ import numpy as np
 
 from dt_tpu import config
 from dt_tpu.elastic import faults, protocol
+from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 
 logger = logging.getLogger("dt_tpu.elastic")
@@ -33,6 +34,10 @@ _OBS_PENDING_MAX = 8192
 #: records per flush message (bounded bites: a post-outage backlog drains
 #: over a few heartbeats instead of one oversized frame)
 _OBS_FLUSH_MAX = 2048
+#: pending (unacked) metrics time-series samples / samples per flush —
+#: the r15 metrics twin of the span-ring bounds above (samples are tiny)
+_HM_PENDING_MAX = 1024
+_HM_FLUSH_MAX = 256
 
 
 def _parse_endpoints(spec: str) -> List[Tuple[str, int]]:
@@ -187,6 +192,20 @@ class WorkerClient:
                 fn()
             self._obs_hook = _flush_hook
             obs_trace.register_flush(self._obs_hook)
+        # r15 metrics export (dt_tpu/obs/metrics.py): the process
+        # registry's time-series samples ride the heartbeat next to the
+        # span rings with the same at-least-once pending/ack + seq-dedup
+        # contract; eligibility is captured at construction exactly like
+        # the obs export (the launcher sets DT_METRICS before workers
+        # start).  The background sampler snapshots the gauges on the
+        # DT_METRICS_INTERVAL_S cadence.
+        self._hm_export = obs_metrics.enabled()
+        self._hm_lock = threading.Lock()
+        self._hm_pending: list = []  # guarded-by: _hm_lock
+        self._hm_shed = 0  # guarded-by: _hm_lock
+        self._hm_gseq = 0  # gauge/hist snapshot ordering; guarded-by: _hm_lock
+        self._hm_sampler = obs_metrics.Sampler(obs_metrics.registry()) \
+            if self._hm_export else None
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval_s,),
@@ -403,6 +422,12 @@ class WorkerClient:
                     and obs_trace.enabled() else None
                 if payload is not None:
                     msg["obs"] = payload
+                # the r15 metrics time-series batch rides the same
+                # heartbeat (cleared only on ack, like the span batch)
+                hm = self._hm_payload() if self._hm_export \
+                    and obs_metrics.enabled() else None
+                if hm is not None:
+                    msg["hm"] = hm
                 # retries=1: a lost heartbeat is superseded by the next
                 # interval's; a long retry loop would only delay close()
                 if obs_trace.enabled():
@@ -410,6 +435,8 @@ class WorkerClient:
                 resp = self._req(msg, timeout=10, retries=1)
                 if payload is not None:
                     self._obs_ack(payload)
+                if hm is not None:
+                    self._hm_ack(hm)
                 for c in resp.get("profile_cmds", []):
                     self._apply_profile_cmd(c)
             except (OSError, RuntimeError):
@@ -466,20 +493,81 @@ class WorkerClient:
         must not stall a closing (or dying) worker for long — the
         "long retry loop would only delay close()" hazard the heartbeat
         path's retries=1 guards against."""
-        if not (self._obs_export and obs_trace.enabled()):
+        if not (self._obs_export and obs_trace.enabled()) and \
+                not (self._hm_export and obs_metrics.enabled()):
             return
         # bounded-bite payloads: loop until the pending batch is empty
         # (a post-outage backlog is at most _OBS_PENDING_MAX records)
-        for _ in range(1 + _OBS_PENDING_MAX // _OBS_FLUSH_MAX):
-            payload = self._obs_payload()
-            if payload is None:
-                return
-            try:
-                self._req({"cmd": "obs_push", "host": self.host,
-                           "obs": payload}, timeout=timeout, retries=1)
-                self._obs_ack(payload)
-            except (OSError, RuntimeError):
-                return  # observability is never fatal
+        if self._obs_export and obs_trace.enabled():
+            for _ in range(1 + _OBS_PENDING_MAX // _OBS_FLUSH_MAX):
+                payload = self._obs_payload()
+                if payload is None:
+                    break
+                try:
+                    self._req({"cmd": "obs_push", "host": self.host,
+                               "obs": payload}, timeout=timeout,
+                              retries=1)
+                    self._obs_ack(payload)
+                except (OSError, RuntimeError):
+                    return  # observability is never fatal
+        # final metrics tail (the r15 time-series since the last
+        # heartbeat) rides the same obs_push channel, same best-effort
+        # bounded bites as the span loop above — a post-outage backlog
+        # beyond one _HM_FLUSH_MAX payload must drain too, not strand
+        if self._hm_export and obs_metrics.enabled():
+            # a final sample captures gauges set since the last cadence
+            # tick (e.g. the halting step's loss) before the drain
+            obs_metrics.registry().sample()
+            for _ in range(1 + _HM_PENDING_MAX // _HM_FLUSH_MAX):
+                hm = self._hm_payload()
+                if hm is None:
+                    return
+                try:
+                    self._req({"cmd": "obs_push", "host": self.host,
+                               "hm": hm}, timeout=timeout, retries=1)
+                    self._hm_ack(hm)
+                except (OSError, RuntimeError):
+                    return
+                if not hm.get("samples"):
+                    return  # gauges-only payload: nothing left to ack
+
+    # -- metrics export (dt_tpu/obs/metrics.py; rides the heartbeat like
+    # the span rings above) ------------------------------------------------
+
+    def _hm_payload(self) -> Optional[dict]:
+        """Drain the process registry's time-series ring into the
+        pending batch and return the flush payload (``None`` when there
+        is nothing to ship).  Pending is cleared only by
+        :meth:`_hm_ack` — at-least-once, dedup'd scheduler-side by
+        sample seq; the cumulative gauge/hist snapshots ride every
+        payload ordered by ``gseq`` (a stale heartbeat delivered after
+        the close-flush must not roll them back)."""
+        reg = obs_metrics.registry()
+        with self._hm_lock:
+            self._hm_pending.extend(reg.drain_series())
+            over = len(self._hm_pending) - _HM_PENDING_MAX
+            if over > 0:
+                self._hm_shed += over  # counted timeline loss
+                del self._hm_pending[:over]
+            gauges = reg.gauges_export()
+            hists = reg.hists_export()
+            if not self._hm_pending and not gauges and not hists:
+                return None
+            self._hm_gseq += 1
+            return {"inc": self._obs_inc, "gseq": self._hm_gseq,
+                    "samples": list(self._hm_pending[:_HM_FLUSH_MAX]),
+                    "gauges": gauges, "hists": hists,
+                    "dropped": reg.dropped() + self._hm_shed}
+
+    def _hm_ack(self, payload: dict) -> None:
+        """The scheduler confirmed ``payload``: drop its samples from
+        the pending batch (by seq — samples taken since stay)."""
+        if not payload.get("samples"):
+            return
+        last = payload["samples"][-1]["seq"]
+        with self._hm_lock:
+            self._hm_pending = [s for s in self._hm_pending
+                                if s["seq"] > last]
 
     def _apply_profile_cmd(self, c: dict) -> None:
         """Apply one remote profiler command locally (rank-prefixed output),
@@ -1071,6 +1159,8 @@ class WorkerClient:
         # otherwise never reach the scheduler's job timeline
         if self._obs_hook is not None:
             obs_trace.unregister_flush(self._obs_hook)
+        if self._hm_sampler is not None:
+            self._hm_sampler.stop()
         self.obs_flush()
         self._stop.set()
         # bounded join: an in-flight heartbeat would otherwise release

@@ -23,6 +23,7 @@ from typing import Callable, Dict, Optional, Set
 import numpy as np
 
 from dt_tpu import config
+from dt_tpu.obs import metrics as obs_metrics
 
 #: EWMA smoothing for the per-worker straggler score (round-contribution
 #: lag, ms).  ~0.3 weights the last ~5 rounds — fast enough to catch a
@@ -237,8 +238,12 @@ class DataPlane:
             if seq >= 0 and served is not None and served[0] == seq:
                 return {"value": served[1]}  # retry of a completed round
             gen = slot["gen"]
+            # lag stamps ride the obs gate, the policy flag, OR the r15
+            # metrics plane (the round.wait_ms histogram + round_wait SLO
+            # rule need the signal whether or not a timeline is exported)
             lag_ns = tnow[1] if tnow is not None else \
-                (time.monotonic_ns() if self._track_lag else None)
+                (time.monotonic_ns()
+                 if self._track_lag or obs_metrics.enabled() else None)
             if lag_ns is not None:
                 # round span bookkeeping: the FIRST contribution opens
                 # the round's window; every host's FIRST arrival is
@@ -357,6 +362,10 @@ class DataPlane:
             wait_ms = round(max(last_t - lag0, 0) / 1e6, 3)
             slot["meta"] = (slot["gen"] + 1, last_host, wait_ms)
             self._update_straggler_locked(arrive, lag0)
+            # r15 metrics plane: the round's wait window feeds the
+            # fixed-bucket histogram the health exposition and the
+            # round-wait SLO percentile read from (no-op when off)
+            obs_metrics.registry().observe("round.wait_ms", wait_ms)
             self._obs.complete_span(
                 "dataplane.round", slot.get("t0"),
                 {"key": key, "gen": slot["gen"] + 1,
@@ -399,9 +408,10 @@ class DataPlane:
         """Per-worker round-contribution-lag EWMA (ms) — the straggler
         board surfaced by the scheduler's ``status``/``obs_dump`` and
         the range server's ``stats``, and the r14 policy engine's input.
-        Empty unless tracing (``DT_OBS``) or ``track_lag`` (the policy
-        engine, ``DT_POLICY``) is on: arrival stamping rides those gates
-        so the disabled fast path stays zero-cost."""
+        Empty unless tracing (``DT_OBS``), ``track_lag`` (the policy
+        engine, ``DT_POLICY``), or the r15 metrics plane
+        (``DT_METRICS``) is on: arrival stamping rides those gates so
+        the disabled fast path stays zero-cost."""
         with self._cv:
             return {h: round(v, 3)
                     for h, v in sorted(self._straggler.items())}

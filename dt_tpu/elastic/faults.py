@@ -63,6 +63,16 @@ current batch share, so a policy rebalance that shrinks the share
 genuinely recovers step rate (the dynamic mini-batch effect under test,
 ``tools/chaos_run.py --plan straggler``).
 
+Site-scoped **nan** rules (r15): a ``nan`` rule fires at a named
+:func:`nan_point` — ``Module.fit`` hooks ``site="worker.grad"`` right
+after the gradient leaves the compiled step, poisoning it with a
+non-finite value when the rule fires.  Seeded/scoped exactly like
+``delay_point`` (``after=`` pins the step, ``times=`` bounds it), it is
+the injection the r15 training-health sentinel exists to catch: the
+fused non-finite check must fire on that step and, under
+``DT_HEALTH_HALT=1``, stop BEFORE the poisoned update is applied
+(``tools/chaos_run.py --plan nan``).
+
 Determinism
 -----------
 
@@ -96,7 +106,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 from dt_tpu import config
 from dt_tpu.obs import trace as obs_trace
 
-KINDS = ("drop", "dup", "delay", "reorder", "reset", "partition", "crash")
+KINDS = ("drop", "dup", "delay", "reorder", "reset", "partition", "crash",
+         "nan")
 
 
 def _obs_fault(kind: str, op: str, idx: int, cmd: Optional[str] = None,
@@ -149,10 +160,10 @@ class FaultRule:
             raise ValueError(f"unknown fault op {op!r}")
         if action not in ("raise", "exit"):
             raise ValueError(f"unknown crash action {action!r}")
-        if kind == "crash" and not site:
-            raise ValueError("crash rules need a site=")
-        if site and kind not in ("crash", "delay"):
-            raise ValueError(f"site= applies to crash/delay rules, "
+        if kind in ("crash", "nan") and not site:
+            raise ValueError(f"{kind} rules need a site=")
+        if site and kind not in ("crash", "delay", "nan"):
+            raise ValueError(f"site= applies to crash/delay/nan rules, "
                              f"not {kind!r}")
         self.kind = kind
         self.op = op
@@ -335,6 +346,27 @@ class FaultPlan:
             slept += d
         return slept
 
+    def nan_at(self, site: str, host: Optional[str] = None,
+               **ctx: Any) -> int:
+        """Apply any matching site-scoped ``nan`` rules: returns how
+        many fired (the call site poisons its value with that many
+        non-finite entries — in practice 0 or 1).  Counted through the
+        same ``_fire`` machinery as every other rule, so ``after=``
+        pins the exact step and ``applied_summary()`` records it for
+        the chaos cross-check."""
+        fired = 0
+        for idx, r in enumerate(self.rules):
+            if r.kind != "nan" or r.site != site:
+                continue
+            if r.host is not None and host not in r.host:
+                continue
+            if not self._fire(idx, r, host):
+                continue
+            _obs_fault("nan", "site", idx, host=host, site=site,
+                       **{k: v for k, v in ctx.items() if k == "step"})
+            fired += 1
+        return fired
+
     def crash(self, site: str, host: Optional[str] = None,
               **ctx: Any) -> None:
         for idx, r in enumerate(self.rules):
@@ -436,3 +468,16 @@ def delay_point(site: str, host: Optional[str] = None,
     if plan is None:
         return 0.0
     return plan.delay_at(site, host=host, scale=scale)
+
+
+def nan_point(site: str, host: Optional[str] = None, **ctx: Any) -> int:
+    """Named nan-injection hook (site-scoped ``nan`` rules, r15): a
+    no-op returning 0 unless an active plan has a matching rule.  The
+    call site poisons its value when the return is non-zero —
+    ``Module.fit`` hooks ``worker.grad`` so the r15 health sentinel's
+    detection/halt path can be *caused* deterministically
+    (``chaos_run --plan nan``)."""
+    plan = active_plan()
+    if plan is None:
+        return 0
+    return plan.nan_at(site, host=host, **ctx)

@@ -43,6 +43,8 @@ import threading
 import time
 import zlib
 
+from dt_tpu.obs import metrics as obs_metrics
+
 try:  # posix-only; the HA pair targets linux hosts (CLAUDE.md)
     import fcntl
 except ImportError:  # pragma: no cover - non-posix fallback
@@ -101,6 +103,10 @@ class JournalWriter:
                                protocol=pickle.HIGHEST_PROTOCOL)
         if len(payload) > MAX_RECORD:
             raise JournalError(f"journal record too large: {len(payload)}")
+        # r15 metrics plane: fsync-append latency histogram — the
+        # journal_append_p99 SLO rule's input (no-op when DT_METRICS is
+        # off; one monotonic read per append when on)
+        _t0 = time.monotonic() if obs_metrics.enabled() else None
         with self._wlock:
             # cross-PROCESS writer exclusion (a deposed ex-leader and
             # the successor both hold "ab" handles): without it, a
@@ -136,6 +142,9 @@ class JournalWriter:
             finally:
                 if fcntl is not None:
                     fcntl.flock(self._f.fileno(), fcntl.LOCK_UN)
+        if _t0 is not None:
+            obs_metrics.registry().observe(
+                "journal.append_ms", (time.monotonic() - _t0) * 1000.0)
 
     def close(self) -> None:
         try:

@@ -63,6 +63,7 @@ from dt_tpu import config
 from dt_tpu import policy as policy_lib
 from dt_tpu.elastic import faults, journal, protocol
 from dt_tpu.elastic.dataplane import DataPlane
+from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 
 logger = logging.getLogger("dt_tpu.elastic")
@@ -76,14 +77,14 @@ _TOKEN_EXEMPT = frozenset({"fetch_snapshot", "allreduce", "async_init",
                            "async_push", "async_pull_rows", "async_stats",
                            "heartbeat", "num_dead", "membership",
                            "servers", "obs_push", "obs_dump", "ha_round",
-                           "status"})
+                           "status", "health"})
 
 #: commands a PASSIVE instance (warm standby / fenced ex-leader) still
 #: serves: round replication from the live primary, obs ingest/export,
 #: health introspection, and shutdown — everything else is refused with
 #: ``not_leader`` so clients rotate to the real leader
 _PASSIVE_CMDS = frozenset({"ha_round", "obs_push", "obs_dump", "status",
-                           "shutdown"})
+                           "health", "shutdown"})
 
 #: bound on retained (host, incarnation) obs tracks — LRU-evicted so a
 #: job with heavy restart churn can't grow scheduler memory unboundedly
@@ -242,6 +243,48 @@ class Scheduler:
         # mode); TTL + LRU bound its memory on a long-running scheduler
         self._tokens = protocol.TokenCache(
             ttl_s=float(config.env("DT_CTRL_TOKEN_TTL_S")))
+
+        # r15 metrics/health plane (dt_tpu/obs/metrics.py): the process
+        # registry carries the scheduler-derived gauges (heartbeat
+        # staleness, worker step rate, ring drops) and the histograms
+        # the data plane / journal observe into; worker time-series
+        # batches arrive on the heartbeat (msg["hm"]) and accumulate in
+        # _hm_tracks with sample-seq dedup — the metrics twin of the
+        # span-ring ingest above.  The declarative SLO engine runs on
+        # every background sample / health read and fires edge-triggered
+        # health.breach/clear events on the control-plane track.
+        self._metrics = obs_metrics.registry() \
+            if obs_metrics.enabled() else None
+        self._slo = obs_metrics.SLOEngine.from_env() \
+            if self._metrics is not None else None
+        self._hm_lock = threading.Lock()
+        self._hm_tracks: Dict[str, dict] = {}  # guarded-by: _hm_lock
+        self._hm_sampler: Optional[obs_metrics.Sampler] = None
+        self._http: Optional[obs_metrics.HealthServer] = None
+        self.metrics_port: Optional[int] = None
+        if self._metrics is not None:
+            self._hm_sampler = obs_metrics.Sampler(
+                self._metrics, hook=self._health_refresh,
+                tracer=self._obs)
+            port_spec = config.env("DT_METRICS_PORT")
+            if port_spec != "":
+                try:
+                    self._http = obs_metrics.HealthServer(
+                        int(port_spec), self.metrics_text,
+                        self.health_view)
+                    self.metrics_port = self._http.port
+                    logger.info("metrics/health endpoint on :%d",
+                                self.metrics_port)
+                except (OSError, ValueError) as e:
+                    # never fatal (every other path in this plane is
+                    # best-effort): a same-host HA pair reads the same
+                    # DT_METRICS_PORT, so the standby's bind loses to
+                    # the primary's — it must still come up and protect
+                    # failover, just without its own endpoint; a
+                    # non-numeric port (ValueError) degrades the same
+                    logger.warning("metrics/health endpoint on :%s "
+                                   "unavailable (%s); continuing "
+                                   "without it", port_spec, e)
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -659,9 +702,194 @@ class Scheduler:
         # export threads both through otherData
         with self._lock:
             pol = self._policy_view_locked()
-        return {"tracks": tracks,
-                "straggler": self._dp.straggler_scores(),
-                "policy": pol}
+        out = {"tracks": tracks,
+               "straggler": self._dp.straggler_scores(),
+               "policy": pol}
+        if self._metrics is not None:
+            # the r15 time-series + health sections ride the dump so
+            # export.write lands them in .metrics.json and dtop's health
+            # board needs no second command
+            self._health_refresh()
+            out["health"] = self.health_view()
+            with self._hm_lock:
+                mtracks = {
+                    k: {"samples": list(t["samples"]),
+                        "gauges": [list(g) for g in t["gauges"]],
+                        "dropped": t["dropped"] + t.get("trunc", 0)}
+                    for k, t in self._hm_tracks.items()}
+            mtracks["control-plane"] = {
+                "samples": self._metrics.series(),
+                "gauges": self._metrics.gauges_export(),
+                "dropped": self._metrics.dropped()}
+            out["metrics"] = {"tracks": mtracks}
+        return out
+
+    # ------------------------------------------------------------------
+    # metrics/health plane (dt_tpu/obs/metrics.py, r15)
+    # ------------------------------------------------------------------
+
+    def _hm_ingest(self, host: str, payload: dict) -> None:
+        """Fold one worker's shipped metrics batch into its
+        (host, incarnation) track.  At-least-once safe: time-series
+        samples carry a strictly increasing ``seq`` and a replayed
+        batch's already-ingested prefix is skipped; the cumulative
+        gauge/hist snapshots apply only when NEWER (``gseq`` orders the
+        payloads, like the span ingest's ``fseq``)."""
+        if self._metrics is None:
+            return
+        key = f"{host}#{payload.get('inc', 0)}"
+        cap = self._metrics._cap
+        with self._hm_lock:
+            tr = self._hm_tracks.setdefault(
+                key, {"samples": [], "sseq": -1, "gseq": -1,
+                      "gauges": [], "hists": [], "dropped": 0,
+                      "trunc": 0})
+            # LRU by update order, same track bound as the span ingest
+            self._hm_tracks.pop(key)
+            self._hm_tracks[key] = tr
+            while len(self._hm_tracks) > _OBS_MAX_TRACKS:
+                del self._hm_tracks[next(iter(self._hm_tracks))]
+            fresh = [s for s in (payload.get("samples") or ())
+                     if s.get("seq", 0) > tr["sseq"]]
+            if fresh:
+                tr["samples"].extend(fresh)
+                tr["sseq"] = max(s["seq"] for s in fresh)
+                over = len(tr["samples"]) - cap
+                if over > 0:
+                    tr["trunc"] += over
+                    del tr["samples"][:over]
+            gseq = int(payload.get("gseq", 0))
+            if gseq > tr["gseq"]:
+                tr["gseq"] = gseq
+                tr["gauges"] = [list(g) for g in
+                                (payload.get("gauges") or ())]
+                tr["hists"] = [list(h) for h in
+                               (payload.get("hists") or ())]
+                tr["dropped"] = int(payload.get("dropped",
+                                                tr["dropped"]))
+
+    def _metrics_forget(self, hosts) -> None:
+        """Membership removals scrub the per-worker metrics state (the
+        ``_policy_forget`` analog): the retained time-series tracks and
+        the worker-labeled gauges would otherwise advertise an evicted
+        worker as a live series — frozen step rate and all — for the
+        rest of the job."""
+        if self._metrics is None:
+            return
+        hosts = set(hosts)
+        with self._hm_lock:
+            for key in [k for k in self._hm_tracks
+                        if k.split("#")[0] in hosts]:
+                del self._hm_tracks[key]
+        for h in sorted(hosts):
+            self._metrics.forget_label("worker", h)
+
+    def _worker_step_rates(self) -> Dict[str, float]:
+        """steps/s per worker host, derived from the last few shipped
+        time-series samples carrying ``train.steps`` (the freshest
+        incarnation wins — dict update order is LRU)."""
+        out: Dict[str, float] = {}
+        with self._hm_lock:
+            for key, tr in self._hm_tracks.items():
+                host = key.split("#")[0]
+                pts = [(s["ts_ms"], s["gauges"].get("train.steps"))
+                       for s in tr["samples"][-8:]
+                       if s.get("gauges", {}).get("train.steps")
+                       is not None]
+                if len(pts) >= 2 and pts[-1][0] > pts[0][0]:
+                    out[host] = round(
+                        max(pts[-1][1] - pts[0][1], 0) * 1000.0
+                        / (pts[-1][0] - pts[0][0]), 4)
+        return out
+
+    def _health_refresh(self) -> None:
+        """One health pass: refresh the scheduler-derived gauges and run
+        the live SLO rules.  Called from the background sampler, the
+        ``health``/``obs_dump`` commands, and ``/metrics`` scrapes —
+        cheap (a few dict folds), and takes ``_lock`` / ``_obs_lock`` /
+        ``_hm_lock`` one at a time (no nesting).  PASSIVE instances
+        skip the pass entirely: a warm standby never receives
+        heartbeats (not in ``_PASSIVE_CMDS``), so sampling staleness
+        there would fire bogus breaches for every healthy worker —
+        the refresh resumes the moment the instance leads."""
+        if self._metrics is None or not self._active.is_set():
+            return
+        reg = self._metrics
+        now = time.time()
+        with self._lock:
+            stale = {h: round(now - self._heartbeats.get(h, now), 3)
+                     for h in self._state.workers}
+        for h, v in sorted(stale.items()):
+            reg.gauge("sched.heartbeat_staleness_s", v,
+                      labels={"worker": h})
+        rates = self._worker_step_rates()
+        for h, r in sorted(rates.items()):
+            reg.gauge("worker.step_rate", r, labels={"worker": h})
+        with self._obs_lock:
+            drops = sum(t["dropped"] + t.get("trunc", 0)
+                        for t in self._obs_tracks.values())
+        drops += self._obs.dropped() + obs_trace.tracer().dropped()
+        reg.gauge("obs.ring_dropped", drops)
+        inputs: Dict[str, object] = {
+            "worker.step_rate": rates,
+            "round.wait_ms": self._dp.straggler_scores(),
+            "sched.heartbeat_staleness_s": stale,
+            "obs.ring_dropped": float(drops),
+        }
+        p99 = reg.hist_quantile("journal.append_ms", 0.99)
+        if p99 is not None:
+            inputs["journal.append_ms.p99"] = p99
+        self._slo.evaluate(inputs, tracer=self._obs)
+
+    def health_view(self) -> dict:
+        """Machine-readable training-health surface: SLO rule state +
+        scheduler gauges/hists + each worker incarnation's latest
+        shipped gauges — the ``health`` RPC / ``obs_dump`` payload the
+        serving plane and dtop's board read."""
+        if self._metrics is None:
+            return {"enabled": False}
+        with self._hm_lock:
+            workers = {
+                k: {"samples": len(t["samples"]),
+                    "dropped": t["dropped"] + t.get("trunc", 0),
+                    "gauges": dict(t["samples"][-1].get("gauges") or {})
+                    if t["samples"] else {}}
+                for k, t in sorted(self._hm_tracks.items())}
+        return {"enabled": True,
+                "interval_s": obs_metrics.interval_s(),
+                "slo": self._slo.state(),
+                "gauges": self._metrics.gauges_export(),
+                "hists": self._metrics.hists_export(),
+                "workers": workers}
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition: the scheduler/process registry
+        (+ live counters) under ``role="scheduler"``, plus every worker
+        incarnation's cumulative gauges/hists and counters under
+        ``worker``/``inc`` label sets — the machine-readable surface the
+        reference's ``PS_VERBOSE`` logging never was.  Empty exposition
+        when the plane is off (graceful like ``health_view``)."""
+        if self._metrics is None:
+            return ""
+        self._health_refresh()
+        jobs = [({"role": "scheduler"},
+                 {"gauges": self._metrics.gauges_export(),
+                  "hists": self._metrics.hists_export()},
+                 {**obs_trace.tracer().counters(),
+                  **self._obs.counters()})]
+        with self._obs_lock:
+            ctrs = {k: dict(v["counters"])
+                    for k, v in self._obs_tracks.items()}
+        with self._hm_lock:
+            tracks = [(k, [list(g) for g in t["gauges"]],
+                       [list(h) for h in t["hists"]])
+                      for k, t in sorted(self._hm_tracks.items())]
+        for key, gauges, hists in tracks:
+            host, _, inc = key.partition("#")
+            jobs.append(({"worker": host, "inc": inc},
+                         {"gauges": gauges, "hists": hists},
+                         ctrs.get(key, {})))
+        return obs_metrics.render_prometheus(jobs)
 
     def close(self):
         """Shut the service down.  Idempotent, and bounded even when a
@@ -712,6 +940,10 @@ class Scheduler:
                   self._lease_thread, self._thread):
             if t is not None and t is not me and t.is_alive():
                 t.join(timeout=5.0)
+        if self._hm_sampler is not None:
+            self._hm_sampler.stop()
+        if self._http is not None:
+            self._http.close()
         if self._journal is not None:
             self._journal.close()
 
@@ -732,10 +964,14 @@ class Scheduler:
                                   reattach=bool(msg.get("reattach")))
         if cmd == "heartbeat":
             # worker span rings piggyback on the heartbeat, exactly like
-            # profiler control already does (kvstore_dist.h:102-110)
+            # profiler control already does (kvstore_dist.h:102-110);
+            # the r15 metrics time-series batches ride the same message
             ob = msg.get("obs")
             if ob is not None:
                 self._obs_ingest(msg["host"], ob)
+            hm = msg.get("hm")
+            if hm is not None:
+                self._hm_ingest(msg["host"], hm)
             with self._lock:
                 self._heartbeats[msg["host"]] = time.time()
                 pseq = int(msg.get("pseq", 0))
@@ -743,11 +979,18 @@ class Scheduler:
             return {"profile_cmds": newer} if newer else {}
         if cmd == "obs_push":
             # synchronous flush (worker close / injected-crash path);
-            # rseq dedup makes replays idempotent
-            self._obs_ingest(msg["host"], msg.get("obs") or {})
+            # rseq/sample-seq dedup makes replays idempotent
+            if msg.get("obs") is not None:
+                self._obs_ingest(msg["host"], msg["obs"])
+            if msg.get("hm") is not None:
+                self._hm_ingest(msg["host"], msg["hm"])
             return {}
         if cmd == "obs_dump":
             return {"job": self.obs_dump()}
+        if cmd == "health":
+            # the r15 training-health RPC: SLO state + gauges, fresh
+            self._health_refresh()
+            return {"health": self.health_view()}
         if cmd == "ha_round":
             return self._ha_round(msg)
         if cmd == "status":
@@ -904,6 +1147,7 @@ class Scheduler:
                 self._apply("quick_evict", host=host, seq=st.log_seq + 1)
                 self._audit_locked("REMOVED", host)
                 self._dp.hosts_removed({host})
+                self._metrics_forget({host})
                 self._rewrite_host_file([host])
                 self._complete_pending_locked()
             if host in st.removed_hosts:
@@ -1000,6 +1244,7 @@ class Scheduler:
                         self._apply("evict", host=h, seq=st.log_seq + 1)
                         self._audit_locked("REMOVED", h)
                     self._dp.hosts_removed(set(dead))
+                    self._metrics_forget(dead)
                     self._rewrite_host_file(dead)
                     # _complete_pending_locked journal-appends too
                     # (barrier_complete / mc_* ops) — a Fenced escaping
@@ -1215,6 +1460,7 @@ class Scheduler:
                 self._apply("mc_remove", host=h, seq=st.log_seq + 1)
                 self._audit_locked("REMOVED", h)
             self._dp.hosts_removed(removable)
+            self._metrics_forget(removable)
         else:
             # identity reissue first (van.cc:187-218): evicted-but-
             # restarted hosts come back AS THEMSELVES — base protection

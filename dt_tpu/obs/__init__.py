@@ -1,12 +1,16 @@
 """dt_tpu.obs — structured tracing + metrics for the elastic control/data
-plane (see ``dt_tpu/obs/trace.py`` for the core API and
+plane (see ``dt_tpu/obs/trace.py`` for the core API,
+``dt_tpu/obs/metrics.py`` for the r15 gauge/histogram/health plane, and
 ``dt_tpu/obs/export.py`` for the merged chrome://tracing export)."""
 
+from dt_tpu.obs.metrics import (HealthHalt, MetricsRegistry, SLOEngine,
+                                registry)
 from dt_tpu.obs.names import NAME_REGISTRY
 from dt_tpu.obs.trace import (Tracer, enabled, flush, origin,
                               register_flush, set_enabled, set_origin,
                               tracer, unregister_flush)
 
-__all__ = ["NAME_REGISTRY", "Tracer", "enabled", "flush", "origin",
-           "register_flush", "set_enabled", "set_origin", "tracer",
+__all__ = ["HealthHalt", "MetricsRegistry", "NAME_REGISTRY", "SLOEngine",
+           "Tracer", "enabled", "flush", "origin", "register_flush",
+           "registry", "set_enabled", "set_origin", "tracer",
            "unregister_flush"]

@@ -78,6 +78,14 @@ def chrome_trace(job: Dict[str, Any]) -> Dict[str, Any]:
         # export like the straggler board: dtop's policy section and the
         # chaos straggler checks read it from the summary
         other["policy"] = dict(job["policy"] or {})
+    if "health" in job:
+        # r15 health plane (SLO state + gauges) and the per-track
+        # metrics time-series ride the export the same way — dtop's
+        # health board and the chaos SLO checks read them from the
+        # summary / .metrics.json
+        other["health"] = dict(job["health"] or {})
+    if "metrics" in job:
+        other["metrics"] = dict(job["metrics"] or {})
     # pass 1: index every id-carrying span by (track, sid) so pass 2 can
     # bind flow starts to the exact client slice
     span_at: Dict[tuple, dict] = {}
@@ -384,6 +392,29 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
            "policy": dict((chrome.get("otherData") or {})
                           .get("policy") or {})}
     out.update(_causal_and_critical(chrome, track_of_pid))
+    # r15 health plane: thread the scheduler's SLO/gauge state + the
+    # per-track time-series through, then run the post-hoc SLO pass over
+    # export-derived inputs (the causal join only exists here — the
+    # causal_orphans rule is declared source:"export" for exactly this).
+    # now_ms=0 keeps the write byte-deterministic.
+    health = dict((chrome.get("otherData") or {}).get("health") or {})
+    out["metrics"] = dict((chrome.get("otherData") or {})
+                          .get("metrics") or {})
+    if health.get("enabled"):
+        causal = out["causal"]
+        rate = (causal["orphans"] / causal["client_spans"]) \
+            if causal["client_spans"] else 0.0
+        health["derived"] = {"causal.orphan_rate": round(rate, 4)}
+        try:
+            from dt_tpu.obs import metrics as obs_metrics
+            eng = obs_metrics.SLOEngine(
+                (health.get("slo") or {}).get("rules"))
+            health["export_breaches"] = eng.evaluate(
+                {"causal.orphan_rate": rate}, now_ms=0, source="export")
+        except Exception:  # noqa: BLE001 — a malformed rule set must
+            # not break the export; the live sections still land
+            health["export_breaches"] = []
+    out["health"] = health
     return out
 
 

@@ -96,6 +96,41 @@ NAME_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "policy.scale": ("event", "a scale-up/down proposal toward "
                               "DT_POLICY_TARGET_WORKERS"),
     "policy.decisions": ("counter", "journaled policy_decide ops"),
+    # -- metrics / health plane (obs/metrics.py, r15) ----------------------
+    # gauges and histograms are emitted through MetricsRegistry.gauge /
+    # .observe and sampled into the DT_METRICS time-series ring; dtlint
+    # DT011 holds them to this catalog exactly like spans/events/counters
+    "train.loss": ("gauge", "last completed step's training loss"),
+    "train.steps": ("gauge", "cumulative optimizer steps this process "
+                             "applied (the scheduler derives step rate "
+                             "from successive samples)"),
+    "health.grad_norm": ("gauge", "last step's global gradient L2 norm "
+                                  "(non-finite entries excluded)"),
+    "health.param_norm": ("gauge", "last step's parameter L2 norm"),
+    "worker.step_rate": ("gauge", "scheduler-derived per-worker step "
+                                  "rate (steps/s) from the shipped "
+                                  "train.steps series"),
+    "sched.heartbeat_staleness_s": ("gauge", "seconds since each live "
+                                             "worker's last heartbeat"),
+    "obs.ring_dropped": ("gauge", "total obs ring/pending records shed "
+                                  "job-wide (scheduler view)"),
+    "step.ms": ("histogram", "host-side wall-clock of one training step"),
+    "round.wait_ms": ("histogram", "allreduce round wait-for-last-"
+                                   "contributor window (data plane)"),
+    "journal.append_ms": ("histogram", "control-journal fsync-append "
+                                       "latency"),
+    "metrics.samples": ("counter", "time-series samples taken by the "
+                                   "background sampler"),
+    "metrics.scrapes": ("counter", "/metrics exposition scrapes served"),
+    "health.nonfinite": ("event", "the fused non-finite sentinel fired: "
+                                  "a gradient/loss went NaN/Inf this "
+                                  "step"),
+    "health.halt": ("event", "DT_HEALTH_HALT stopped training before "
+                             "the poisoned update was applied"),
+    "health.breach": ("event", "an SLO rule started breaching (attrs "
+                               "carry rule, blamed worker, value, "
+                               "threshold)"),
+    "health.clear": ("event", "a breaching SLO rule recovered"),
     # -- fault injection (elastic/faults.py) -------------------------------
     "fault.*": ("event", "every APPLIED fault (fault.<kind>); the chaos "
                          "harness cross-checks these against "

@@ -302,6 +302,15 @@ class Tracer:
         with self._lock:
             return dict(self._counters)
 
+    def reset_counters(self) -> None:
+        """Zero the live counters (tests: the process tracer is shared
+        across a whole pytest session, so exact-count asserts must start
+        from a clean slate whatever ran before — the r15 fix for the
+        test-order dependency where obs tests failed after overlap/ha
+        tests had already bumped ``allreduce.rounds`` etc.)."""
+        with self._lock:
+            self._counters.clear()
+
     # -- export -----------------------------------------------------------
 
     def dropped(self) -> int:

@@ -39,6 +39,7 @@ import numpy as np
 import optax
 
 from dt_tpu import config as config_lib
+from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 from dt_tpu.ops import losses as losses_lib
 from dt_tpu.parallel import kvstore as kvstore_lib
@@ -52,6 +53,28 @@ logger = logging.getLogger("dt_tpu")
 
 def softmax_ce_loss(logits, labels):
     return losses_lib.softmax_cross_entropy(logits, labels)
+
+
+def sentinel_health_vec(flat_g, params, loss):
+    """The fused device-side training-health vector
+    ``[nonfinite_count, grad_norm, param_norm]`` (r15 sentinels,
+    ``docs/observability.md``) — ONE definition shared by Module's
+    compiled steps and ``Trainer._build``, so the two surfaces can
+    never drift apart on the arithmetic the ``chaos_run --plan nan``
+    gates depend on.  ``loss`` folds into the non-finite count (pass a
+    finite constant where no loss is in scope); non-finite gradient
+    entries are masked out of the norm so it stays informative during
+    an excursion."""
+    finite = jnp.isfinite(flat_g)
+    nonfinite = (flat_g.size - jnp.sum(finite)
+                 + jnp.where(jnp.isfinite(loss), 0, 1))
+    gnorm = jnp.sqrt(jnp.sum(
+        jnp.square(jnp.where(finite, flat_g, 0.0))))
+    flat_p = jax.flatten_util.ravel_pytree(params)[0]
+    pnorm = jnp.sqrt(jnp.sum(jnp.square(flat_p)))
+    return jnp.stack([jnp.asarray(nonfinite, jnp.float32),
+                      jnp.asarray(gnorm, jnp.float32),
+                      jnp.asarray(pnorm, jnp.float32)])
 
 
 def _local_np(x) -> np.ndarray:
@@ -186,6 +209,13 @@ class Module:
         # D2H -> wire -> H2D pipeline, lazy — built on first host-sync
         # step when DT_AR_OVERLAP is on and the controller supports it
         self._overlap = None
+        # r15 training-health sentinels (dt_tpu/obs/metrics.py): the
+        # compiled steps carry a fused [nonfinite, grad_norm, param_norm]
+        # vector when armed; DT_HEALTH_HALT=1 stops fit cleanly BEFORE a
+        # poisoned update is applied and sets this flag
+        self._sentinel = False
+        self._halt = False
+        self.health_halted = False
 
     # ------------------------------------------------------------------
     # Binding / init
@@ -231,6 +261,19 @@ class Module:
         model, loss_fn = self.model, self.loss_fn
         mesh = self.mesh
         replicated = mesh_lib.replicate_sharding(mesh)
+
+        # r15 training-health sentinels: when the metrics plane or the
+        # halt gate is armed the steps also return a fused device-side
+        # health vector — ONE extra scalar fetch per step host-side —
+        # and with DT_HEALTH_HALT=1 the update is conditionally SKIPPED
+        # inside the same compiled program when the gradient went
+        # non-finite (the poisoned update is never applied, not rolled
+        # back).  Off (the default) the steps compile exactly as before.
+        sentinel = obs_metrics.sentinels_enabled()
+        halt = obs_metrics.halt_enabled()
+        self._sentinel = sentinel
+        self._halt = halt
+        health_vec = sentinel_health_vec  # shared with Trainer._build
 
         def forward_loss(params, batch_stats, data, labels, dropout_rng):
             """Shared by the mesh train step and the host-sync grad step.
@@ -303,9 +346,21 @@ class Module:
             dropout_rng = jax.random.fold_in(rng, state.step)
             loss, logits, new_stats, grads = compute_grads(
                 state.params, state.batch_stats, data, labels, dropout_rng)
-            new_state = state.apply_gradients(grads)
-            new_state = new_state.replace(batch_stats=new_stats)
-            return new_state, loss, logits
+
+            def apply(_):
+                return state.apply_gradients(grads).replace(
+                    batch_stats=new_stats)
+
+            if not sentinel:
+                return apply(None), loss, logits
+            health = health_vec(jax.flatten_util.ravel_pytree(grads)[0],
+                                state.params, loss)
+            if halt:
+                new_state = jax.lax.cond(health[0] > 0,
+                                         lambda _: state, apply, None)
+            else:
+                new_state = apply(None)
+            return new_state, loss, logits, health
 
         def eval_step(state: TrainState, data):
             variables = {"params": state.params}
@@ -365,9 +420,12 @@ class Module:
                     "sharded (%.2f of %.2f MiB; rest replicated)",
                     name, mesh.shape["data"], 100 * frac, sh_b / 2**20,
                     tot_b / 2**20)
+        step_out_sh = (state_sharding, replicated,
+                       mesh_lib.data_sharding(mesh))
+        if sentinel:
+            step_out_sh = step_out_sh + (replicated,)
         self._train_step = jax.jit(train_step, donate_argnums=donate,
-                                   out_shardings=(state_sharding, replicated,
-                                                  mesh_lib.data_sharding(mesh)))
+                                   out_shardings=step_out_sh)
         self._eval_step = jax.jit(eval_step)
 
         # host-sync two-phase variant: grads AND new BN stats ride the same
@@ -389,8 +447,24 @@ class Module:
             grads = self._unravel(flat_g)
             new_stats = self._unravel_stats(flat_s) if self._unravel_stats \
                 else state.batch_stats
-            return state.apply_gradients(grads).replace(
-                batch_stats=new_stats)
+
+            def apply(_):
+                return state.apply_gradients(grads).replace(
+                    batch_stats=new_stats)
+
+            if not sentinel:
+                return apply(None)
+            # the host-sync sentinel checks the AVERAGED gradient: one
+            # worker's poisoned contribution makes the average
+            # non-finite on EVERY worker, so the whole fleet halts on
+            # the same step with identical (pre-fault) params
+            health = health_vec(flat_g, state.params, jnp.float32(0.0))
+            if halt:
+                new_state = jax.lax.cond(health[0] > 0,
+                                         lambda _: state, apply, None)
+            else:
+                new_state = apply(None)
+            return new_state, health
 
         self._grad_step = jax.jit(grad_step)
         self._apply_step = jax.jit(apply_step)
@@ -676,6 +750,8 @@ class Module:
                 # batch (device programs run async — this is the control
                 # view, not a kernel timeline; jax.profiler has those)
                 _obs_st_t0 = _obs.now()
+                _mt0 = time.monotonic() if obs_metrics.enabled() else None
+                health = None  # sentinel vector; None when not armed
                 if is_async:
                     # dist_async step: local grad -> push -> adopt the
                     # post-update master weights.  No peer barrier; the
@@ -687,13 +763,44 @@ class Module:
                     flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
                     prefetched = self._prefetch_batch(train_data)
-                    new_p = self.kv.push_flat(
-                        self.async_key, np.asarray(jax.device_get(flat_g)))
-                    self.state = self.state.replace(
-                        params=self._unravel(jnp.asarray(new_p)),
-                        batch_stats=self._unravel_stats(flat_s)
-                        if self._unravel_stats else self.state.batch_stats,
-                        step=self.state.step + 1)
+                    g_host = np.asarray(jax.device_get(flat_g))
+                    if self._sentinel:
+                        # no post-average apply step exists on this
+                        # path to fuse the check into — guard the PUSH
+                        # instead: a non-finite gradient must never
+                        # reach (and permanently poison) the
+                        # server-side master weights + optimizer slots
+                        nonfinite = int(g_host.size
+                                        - np.isfinite(g_host).sum())
+                        lv = float(np.asarray(loss))
+                        if obs_metrics.enabled():
+                            reg = obs_metrics.registry()
+                            reg.gauge("train.loss", lv)
+                            reg.gauge("train.steps",
+                                      int(self.state.step))
+                        if nonfinite > 0 or not np.isfinite(lv):
+                            step_n = int(self.state.step)
+                            obs_trace.tracer().event(
+                                "health.nonfinite",
+                                {"epoch": epoch, "step": step_n,
+                                 "nonfinite": nonfinite, "loss": lv})
+                            if self._halt:
+                                obs_trace.tracer().event(
+                                    "health.halt",
+                                    {"epoch": epoch, "step": step_n})
+                                self.health_halted = True
+                    if not self.health_halted:
+                        # halted: the push is WITHHELD but control falls
+                        # through to the common step-span/metrics tail —
+                        # the tripping step must not vanish from the
+                        # timeline (the loop breaks there)
+                        new_p = self.kv.push_flat(self.async_key, g_host)
+                        self.state = self.state.replace(
+                            params=self._unravel(jnp.asarray(new_p)),
+                            batch_stats=self._unravel_stats(flat_s)
+                            if self._unravel_stats
+                            else self.state.batch_stats,
+                            step=self.state.step + 1)
                 elif self.sync_mode == "host" and self.kv.num_workers > 1:
                     ctrl = getattr(self.kv, "_controller", None)
                     if ctrl is None:
@@ -704,6 +811,13 @@ class Module:
                     flat_g, flat_s, loss, logits = self._grad_step(
                         self.state, data, labels, rng)
                     prefetched = self._prefetch_batch(train_data)
+                    if faults_lib.nan_point("worker.grad",
+                                            host=getattr(ctrl, "host",
+                                                         None)):
+                        # seeded poison (r15 chaos --plan nan): one
+                        # non-finite entry — exactly what the fused
+                        # sentinel exists to catch before the update
+                        flat_g = flat_g.at[0].set(jnp.nan)
                     if grad_scale != 1.0:
                         # share-aware pre-weight b_i*W/B (dt_tpu/policy/
                         # rescale.py): the fleet's plain 1/W average
@@ -712,6 +826,19 @@ class Module:
                         # path) when the policy engine is off
                         flat_g = flat_g * grad_scale
                     gc = self.kv._gradient_compression
+                    if gc is not None and self._sentinel and \
+                            not bool(jnp.isfinite(flat_g).all()):
+                        # 2-bit quantization LAUNDERS non-finite values
+                        # (NaN fails both threshold comparisons and
+                        # encodes as code 0, lodging in the error-
+                        # feedback residual forever) — the averaged
+                        # gradient the fused post-sync check inspects
+                        # would stay finite and the sentinel would be
+                        # blind.  Ship THIS step raw instead: the
+                        # poisoned average then trips every worker's
+                        # compiled check on the same step, preserving
+                        # the fleet-wide halt invariant.
+                        gc = None
                     from dt_tpu.training import overlap as overlap_lib
                     if overlap_lib.enabled(ctrl):
                         # bucketed D2H -> wire -> H2D pipeline; the
@@ -724,8 +851,8 @@ class Module:
                             else None)
                         if avg_s is None:
                             avg_s = np.zeros((0,), np.float32)
-                        self.state = self._apply_step(
-                            self.state, avg_g_dev, jnp.asarray(avg_s))
+                        health = self._apply_synced(avg_g_dev,
+                                                    jnp.asarray(avg_s))
                     else:
                         if gc is not None:
                             # quantize ON DEVICE, fetch only the packed
@@ -744,14 +871,25 @@ class Module:
                                 "stats", np.asarray(jax.device_get(flat_s)))
                         else:
                             avg_s = np.zeros((0,), np.float32)
-                        self.state = self._apply_step(
-                            self.state, jnp.asarray(avg_g),
-                            jnp.asarray(avg_s))
+                        health = self._apply_synced(jnp.asarray(avg_g),
+                                                    jnp.asarray(avg_s))
                 else:
-                    self.state, loss, logits = self._train_step(
-                        self.state, data, labels, rng)
+                    if self._sentinel:
+                        self.state, loss, logits, health = \
+                            self._train_step(self.state, data, labels,
+                                             rng)
+                    else:
+                        self.state, loss, logits = self._train_step(
+                            self.state, data, labels, rng)
                     prefetched = self._prefetch_batch(train_data)
                 _obs.complete_span("step", _obs_st_t0, {"epoch": epoch})
+                if _mt0 is not None:
+                    obs_metrics.registry().observe(
+                        "step.ms", (time.monotonic() - _mt0) * 1000.0)
+                if self.health_halted or (
+                        health is not None
+                        and self._health_step(health, loss, epoch)):
+                    break
                 # flush the PREVIOUS step's metric + its callback (its
                 # logits are ready by now; this step already runs on device)
                 if pending is not None:
@@ -763,6 +901,20 @@ class Module:
             if pending is not None:  # final step's metric + callback
                 nbatch = self._flush_metric(pending, eval_metric, epoch,
                                             nbatch, batch_end_callback)
+
+            if self.health_halted:
+                # the clean stop: the compiled step already SKIPPED the
+                # poisoned update, so params/opt-state/step are exactly
+                # the pre-fault prefix on every worker (the averaged
+                # gradient is non-finite fleet-wide, so all workers
+                # halt on the same step — no straggling collectives)
+                _obs.complete_span("epoch", _obs_ep_t0,
+                                   {"epoch": epoch, "nbatch": nbatch,
+                                    "halted": True})
+                logger.warning(
+                    "Epoch[%d] training halted by the health sentinel "
+                    "(non-finite gradient; update not applied)", epoch)
+                break
 
             if eval_metric.num_inst > 0:  # empty when Speedometer auto_reset
                 for name, val in eval_metric.get_name_value():
@@ -797,6 +949,62 @@ class Module:
                     eval_end_callback(epoch, validation_metric)
 
         return eval_metric
+
+    def _apply_synced(self, avg_g, avg_s):
+        """Apply one averaged host-sync update via the compiled
+        ``_apply_step``; returns the sentinel health vector (``None``
+        when sentinels are off — the step output shape is decided at
+        ``_build_steps`` time, so the two arms never mix)."""
+        out = self._apply_step(self.state, avg_g, avg_s)
+        if self._sentinel:
+            self.state, health = out
+            return health
+        self.state = out
+        return None
+
+    def _health_step(self, health, loss, epoch) -> bool:
+        """Account one step's fused health vector: training-quality
+        gauges when the metrics plane is on, a ``health.nonfinite``
+        event when the sentinel fired, and — under ``DT_HEALTH_HALT`` —
+        the clean stop (the compiled step already SKIPPED the poisoned
+        update; returning True just ends the loops).  The single
+        ``np.asarray(health)`` here is the one-scalar-per-step device
+        sync the sentinel costs; it is gated off with the plane."""
+        h = np.asarray(health)
+        nonfinite = int(h[0])
+        lv = float(np.asarray(loss))
+        if obs_metrics.enabled():
+            reg = obs_metrics.registry()
+            reg.gauge("train.loss", lv)
+            reg.gauge("train.steps", int(self.state.step))
+            reg.gauge("health.grad_norm", float(h[1]))
+            reg.gauge("health.param_norm", float(h[2]))
+        step = int(self.state.step)
+        if nonfinite <= 0:
+            if not np.isfinite(lv):
+                # observe-only even under halt: the HALT gate keys on
+                # exactly the signal the compiled step's cond used —
+                # which is fleet-identical (the averaged gradient on the
+                # host-sync path; loss is folded in-program on the mesh
+                # path).  A non-finite LOCAL loss with a finite averaged
+                # gradient must not halt one worker alone mid-fleet:
+                # its update was applied like everyone else's, and a
+                # solo exit would strand the survivors' next collective.
+                obs_trace.tracer().event(
+                    "health.nonfinite",
+                    {"epoch": epoch, "step": step, "nonfinite": 0,
+                     "loss": lv, "local_loss_only": True})
+            return False
+        obs_trace.tracer().event(
+            "health.nonfinite",
+            {"epoch": epoch, "step": step, "nonfinite": nonfinite,
+             "loss": lv})
+        if not self._halt:
+            return False  # observe-only: the reference's silent-NaN mode
+        obs_trace.tracer().event("health.halt",
+                                 {"epoch": epoch, "step": step})
+        self.health_halted = True
+        return True
 
     def _policy_grad_scale(self, elastic_data_iterator) -> float:
         """The r14 share-aware gradient pre-weight (dt_tpu/policy):

@@ -21,8 +21,12 @@ import jax.flatten_util
 import jax.numpy as jnp
 import optax
 
+import numpy as np
+
+from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 from dt_tpu.parallel import kvstore as kvstore_lib
+from dt_tpu.training import module as module_lib
 
 
 class Trainer:
@@ -57,11 +61,36 @@ class Trainer:
 
     def _build(self):
         tx = self.tx
+        # r15 training-health sentinel (dt_tpu/obs/metrics.py): same
+        # fused check as Module's steps; with DT_HEALTH_HALT=1 the
+        # update is skipped in-program on a non-finite gradient and
+        # step() raises HealthHalt to the imperative caller
+        sentinel = obs_metrics.sentinels_enabled()
+        halt = obs_metrics.halt_enabled()
+        self._sentinel = sentinel
+        self._halt = halt
 
         def apply(params, opt_state, grads, rescale):
             grads = jax.tree_util.tree_map(lambda g: g * rescale, grads)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), new_opt
+
+            def do(_):
+                updates, new_opt = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), new_opt
+
+            if not sentinel:
+                return do(None)
+            # the ONE shared sentinel definition (module.py) — no loss
+            # in scope on this surface, so a finite constant folds in
+            health = module_lib.sentinel_health_vec(
+                jax.flatten_util.ravel_pytree(grads)[0], params,
+                jnp.float32(0.0))
+            if halt:
+                new_params, new_opt = jax.lax.cond(
+                    health[0] > 0, lambda _: (params, opt_state), do,
+                    None)
+            else:
+                new_params, new_opt = do(None)
+            return new_params, new_opt, health
 
         self._step_fn = jax.jit(apply)
 
@@ -111,8 +140,24 @@ class Trainer:
             self._unravel = unravel
         flat_g, _ = jax.flatten_util.ravel_pytree(
             jax.tree_util.tree_map(lambda g: g * rescale, grads))
-        new = self.kv.push_flat(self._async_key,
-                                np.asarray(jax.device_get(flat_g)))
+        g_host = np.asarray(jax.device_get(flat_g))
+        if obs_metrics.sentinels_enabled():
+            # same push guard as Module.fit's async branch: there is no
+            # post-average apply step to fuse the sentinel into, and a
+            # non-finite gradient must never reach (and permanently
+            # poison) the server-side master weights + optimizer slots
+            nonfinite = int(g_host.size - np.isfinite(g_host).sum())
+            if nonfinite > 0:
+                obs_trace.tracer().event(
+                    "health.nonfinite",
+                    {"nonfinite": nonfinite, "surface": "trainer"})
+                if obs_metrics.halt_enabled():
+                    obs_trace.tracer().event("health.halt",
+                                             {"surface": "trainer"})
+                    raise obs_metrics.HealthHalt(
+                        f"non-finite gradient ({nonfinite} entries); "
+                        f"dist_async push withheld (DT_HEALTH_HALT=1)")
+        new = self.kv.push_flat(self._async_key, g_host)
         self.params = self._unravel(jnp.asarray(new))
         return self.params
 
@@ -122,17 +167,53 @@ class Trainer:
         ``Trainer.step``)."""
         _obs_t0 = obs_trace.tracer().now()
         if self.kv.type == "dist_async":
-            out = self._async_step(grads, 1.0 / batch_size)
-            obs_trace.tracer().complete_span("trainer.step", _obs_t0,
-                                             {"mode": "dist_async"})
-            return out
+            try:
+                return self._async_step(grads, 1.0 / batch_size)
+            finally:
+                # finally: the step that TRIPPED the sentinel (HealthHalt
+                # propagating) is the one an operator most wants on the
+                # timeline — it must not vanish from the span record
+                obs_trace.tracer().complete_span(
+                    "trainer.step", _obs_t0, {"mode": "dist_async"})
         if self._step_fn is None:
             self._build()
         grads = self.allreduce_grads(grads)
-        self.params, self.opt_state = self._step_fn(
-            self.params, self.opt_state, grads, 1.0 / batch_size)
-        obs_trace.tracer().complete_span("trainer.step", _obs_t0)
+        try:
+            if getattr(self, "_sentinel", False):
+                self.params, self.opt_state, health = self._step_fn(
+                    self.params, self.opt_state, grads, 1.0 / batch_size)
+                self._health_check(health)
+            else:
+                self.params, self.opt_state = self._step_fn(
+                    self.params, self.opt_state, grads, 1.0 / batch_size)
+        finally:
+            obs_trace.tracer().complete_span("trainer.step", _obs_t0)
         return self.params
+
+    def _health_check(self, health) -> None:
+        """Sentinel accounting for one imperative step: gauges when the
+        metrics plane is on; on a non-finite gradient emit
+        ``health.nonfinite`` and — under ``DT_HEALTH_HALT`` — raise
+        :class:`~dt_tpu.obs.metrics.HealthHalt` (the compiled step
+        already skipped the poisoned update, so ``params``/``opt_state``
+        are the pre-fault values)."""
+        h = np.asarray(health)
+        nonfinite = int(h[0])
+        if obs_metrics.enabled():
+            reg = obs_metrics.registry()
+            reg.gauge("health.grad_norm", float(h[1]))
+            reg.gauge("health.param_norm", float(h[2]))
+        if nonfinite <= 0:
+            return
+        obs_trace.tracer().event("health.nonfinite",
+                                 {"nonfinite": nonfinite,
+                                  "surface": "trainer"})
+        if self._halt:
+            obs_trace.tracer().event("health.halt",
+                                     {"surface": "trainer"})
+            raise obs_metrics.HealthHalt(
+                f"non-finite gradient ({nonfinite} entries); update "
+                f"skipped (DT_HEALTH_HALT=1)")
 
     @property
     def learning_rate(self):

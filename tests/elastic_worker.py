@@ -221,6 +221,10 @@ def main():
         "param_hash": float(np.abs(np.asarray(flat)).sum()),
         "num_workers_at_end": kv.num_workers,
         "bootstrap_step": bootstrap_step,
+        # r15 health sentinel (chaos --plan nan): True when fit stopped
+        # cleanly before a poisoned update; final_step/param_hash are
+        # then the pre-fault prefix
+        "health_halted": bool(getattr(mod, "health_halted", False)),
         # r14 policy accounting (dt_tpu/policy; chaos --plan straggler)
         "epoch_times": epoch_times,
         "sleep_by_epoch": sleep_by_epoch,

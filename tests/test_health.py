@@ -1,0 +1,277 @@
+"""Training-health sentinels + the nan fault site (r15): the fused
+device-side non-finite check in Module/Trainer, the DT_HEALTH_HALT
+clean stop BEFORE the poisoned update, the seeded ``nan`` injection
+rules, and the live round-wait SLO blame path (reference analog: the
+reference had NO quality sentinels — a NaN silently poisoned the
+server-side weights, ``kvstore_dist_server.h:345-379``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dt_tpu.obs import metrics as obs_metrics
+from dt_tpu.obs import trace as obs_trace
+
+# record tuple indices (dt_tpu/obs/trace.py schema)
+PH, RSEQ, NAME, TS, DUR, TID, SID, PARENT, ATTRS = range(9)
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    obs_metrics.registry().clear()
+    obs_trace.tracer().drain()
+    obs_trace.tracer().reset_counters()
+    yield
+    os.environ.pop("DT_HEALTH_HALT", None)
+    obs_metrics.set_enabled(None)
+    obs_trace.set_enabled(None)
+    obs_metrics.registry().clear()
+    obs_trace.tracer().drain()
+    obs_trace.tracer().reset_counters()
+
+
+def _nan_dataset(n=32, poison_from=16):
+    x = np.random.RandomState(0).normal(
+        size=(n, 4, 4, 1)).astype(np.float32)
+    x[poison_from:] = np.nan
+    y = np.random.RandomState(1).randint(0, 2, n).astype(np.int32)
+    return x, y
+
+
+def _tiny_module(**kw):
+    import flax.linen as linen
+    from dt_tpu.training import Module
+
+    class Net(linen.Module):
+        @linen.compact
+        def __call__(self, x, training=True):
+            return linen.Dense(2)(x.reshape((x.shape[0], -1)))
+
+    return Module(Net(), optimizer="sgd",
+                  optimizer_params={"learning_rate": 0.1}, seed=0, **kw)
+
+
+def test_sentinel_halts_before_poisoned_update():
+    """A NaN batch trips the fused check; with DT_HEALTH_HALT=1 the
+    compiled step SKIPS the update (params stay the clean step-1
+    values), fit stops cleanly mid-epoch, and the nonfinite/halt events
+    carry the step."""
+    import jax
+    from dt_tpu import data
+    os.environ["DT_HEALTH_HALT"] = "1"
+    obs_metrics.set_enabled(True)
+    obs_trace.set_enabled(True)
+    x, y = _nan_dataset()
+    mod = _tiny_module()
+    mod.fit(data.NDArrayIter(x, y, batch_size=16), num_epoch=3)
+    assert mod.health_halted is True
+    assert int(mod.state.step) == 1  # clean batch applied, poison not
+    flat = jax.flatten_util.ravel_pytree(mod.state.params)[0]
+    assert bool(np.isfinite(np.asarray(flat)).all())
+    evs = {r[NAME]: r[ATTRS] for r in obs_trace.tracer().drain()
+           if r[PH] == "i" and r[NAME].startswith("health.")}
+    assert evs["health.nonfinite"]["step"] == 1
+    assert evs["health.nonfinite"]["nonfinite"] > 0
+    assert evs["health.halt"]["step"] == 1
+    # training-quality gauges landed on the metrics plane
+    g = {n: v for n, _, v in obs_metrics.registry().gauges_export()}
+    assert g["train.steps"] == 1.0
+    assert g["health.param_norm"] > 0.0
+
+
+def test_sentinel_observe_only_without_halt():
+    """Metrics plane on, halt NOT armed: the event fires but training
+    continues (the reference's silent-NaN behavior, now at least
+    visible)."""
+    from dt_tpu import data
+    obs_metrics.set_enabled(True)
+    obs_trace.set_enabled(True)
+    x, y = _nan_dataset()
+    mod = _tiny_module()
+    mod.fit(data.NDArrayIter(x, y, batch_size=16), num_epoch=1)
+    assert mod.health_halted is False
+    assert int(mod.state.step) == 2  # both updates applied
+    names = [r[NAME] for r in obs_trace.tracer().drain()
+             if r[PH] == "i"]
+    assert "health.nonfinite" in names and "health.halt" not in names
+
+
+def test_sentinel_off_keeps_legacy_step_shape():
+    """Both gates off: the compiled steps return the r14 shapes and no
+    health state is touched — the hot path is unchanged."""
+    from dt_tpu import data
+    x, y = _nan_dataset(poison_from=32)  # clean data
+    mod = _tiny_module()
+    mod.fit(data.NDArrayIter(x, y, batch_size=16), num_epoch=1)
+    assert mod._sentinel is False and mod.health_halted is False
+    assert int(mod.state.step) == 2
+    assert obs_metrics.registry().gauges_export() == []
+
+
+def test_trainer_step_raises_health_halt():
+    """The imperative surface: a non-finite gradient raises HealthHalt
+    and params/opt-state are the pre-fault values (the compiled step
+    skipped the update in-program)."""
+    import jax
+    import jax.numpy as jnp
+    from dt_tpu.training.trainer import Trainer
+    os.environ["DT_HEALTH_HALT"] = "1"
+    obs_trace.set_enabled(True)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    tr = Trainer(params, "sgd", {"learning_rate": 0.1})
+    good = {"w": jnp.ones((4,), jnp.float32)}
+    tr.step(good, batch_size=1)
+    p_before = np.asarray(tr.params["w"]).copy()
+    bad = {"w": jnp.array([1.0, jnp.nan, 1.0, 1.0], jnp.float32)}
+    with pytest.raises(obs_metrics.HealthHalt):
+        tr.step(bad, batch_size=1)
+    np.testing.assert_array_equal(np.asarray(tr.params["w"]), p_before)
+    recs = obs_trace.tracer().drain()
+    names = [r[NAME] for r in recs if r[PH] == "i"]
+    assert "health.nonfinite" in names and "health.halt" in names
+    # the halting step is still on the timeline (span completed in the
+    # finally — the one step an operator most wants must not vanish)
+    assert any(r[PH] == "X" and r[NAME] == "trainer.step" for r in recs)
+    del jax
+
+
+def test_trainer_async_push_guarded_against_nonfinite():
+    """Trainer's dist_async surface: the push guard withholds a
+    non-finite gradient from the server master weights and raises
+    HealthHalt, mirroring Module.fit's async branch."""
+    import jax.numpy as jnp
+    from dt_tpu.elastic import Scheduler, WorkerClient
+    from dt_tpu.parallel import kvstore as kvstore_lib
+    from dt_tpu.training.trainer import Trainer
+    os.environ["DT_HEALTH_HALT"] = "1"
+    obs_trace.set_enabled(True)
+    sched = Scheduler(initial_workers=["w0"])
+    ctrl = None
+    try:
+        ctrl = WorkerClient("127.0.0.1", sched.port, host="w0",
+                            heartbeat_interval_s=5)
+        kv = kvstore_lib.create("dist_async")
+        kv.set_controller(ctrl)
+        tr = Trainer({"w": jnp.ones((4,), jnp.float32)}, "sgd",
+                     {"learning_rate": 0.1}, kvstore=kv,
+                     async_key="guarded")
+        tr.step({"w": jnp.ones((4,), jnp.float32)}, batch_size=1)
+        master_before = np.asarray(sched._async_store["guarded"]).copy()
+        with pytest.raises(obs_metrics.HealthHalt):
+            tr.step({"w": jnp.array([jnp.nan, 1, 1, 1], jnp.float32)},
+                    batch_size=1)
+        np.testing.assert_array_equal(
+            np.asarray(sched._async_store["guarded"]), master_before)
+    finally:
+        if ctrl is not None:
+            ctrl.close()
+        sched.close()
+
+
+def test_async_push_guarded_against_nonfinite_gradient():
+    """The dist_async path has no post-average apply step to fuse the
+    sentinel into, so the PUSH itself is guarded: a non-finite gradient
+    must never reach (and permanently poison) the scheduler-side master
+    weights + optimizer slots."""
+    from dt_tpu import data
+    from dt_tpu.elastic import Scheduler, WorkerClient
+    from dt_tpu.parallel import kvstore as kvstore_lib
+    os.environ["DT_HEALTH_HALT"] = "1"
+    obs_trace.set_enabled(True)
+    sched = Scheduler(initial_workers=["w0"])
+    ctrl = None
+    try:
+        ctrl = WorkerClient("127.0.0.1", sched.port, host="w0",
+                            heartbeat_interval_s=5)
+        kv = kvstore_lib.create("dist_async")
+        kv.set_controller(ctrl)
+        x, y = _nan_dataset()
+        mod = _tiny_module(kvstore=kv)
+        mod.fit(data.NDArrayIter(x, y, batch_size=16), num_epoch=1)
+        assert mod.health_halted is True
+        # the server-side master weights took exactly the one clean push
+        # and stayed finite — the poisoned push never went out
+        master = sched._async_store["params"]
+        assert bool(np.isfinite(np.asarray(master)).all())
+        recs = obs_trace.tracer().drain()
+        names = [r[NAME] for r in recs if r[PH] == "i"]
+        assert "health.nonfinite" in names and "health.halt" in names
+        # the tripping step still completed its span (the halt falls
+        # through the common step-span tail instead of breaking early)
+        assert sum(1 for r in recs
+                   if r[PH] == "X" and r[NAME] == "step") == 2
+    finally:
+        if ctrl is not None:
+            ctrl.close()
+        sched.close()
+
+
+def test_nan_fault_rule_fires_at_site_scoped_step():
+    """The seeded ``nan`` rule: site-scoped like delay_point, ``after=``
+    pins the exact firing, ``times=`` bounds it, applied counts land in
+    applied_summary, and the fault.nan event rides the timeline."""
+    from dt_tpu.elastic import faults
+    from dt_tpu.elastic.faults import FaultPlan, FaultRule
+    obs_trace.set_enabled(True)
+    plan = faults.install(FaultPlan(
+        [FaultRule("nan", site="worker.grad", host="w1", after=3,
+                   times=1)], seed=0))
+    try:
+        fired = [faults.nan_point("worker.grad", host="w1")
+                 for _ in range(6)]
+        assert fired == [0, 0, 0, 1, 0, 0]  # after=3 pins, times=1 bounds
+        assert faults.nan_point("worker.grad", host="w0") == 0  # scoped
+        assert faults.nan_point("other.site", host="w1") == 0
+        assert plan.applied_summary() == [(0, "w1", 1)]
+        evs = [r for r in obs_trace.tracer().drain()
+               if r[PH] == "i" and r[NAME] == "fault.nan"]
+        assert len(evs) == 1 and evs[0][ATTRS]["host"] == "w1"
+        assert evs[0][ATTRS]["site"] == "worker.grad"
+        # nan rules never match transport traffic
+        assert plan.on_send("allreduce", "w1") is None
+        # a nan rule without a site is rejected at construction
+        with pytest.raises(ValueError):
+            FaultRule("nan")
+    finally:
+        faults.clear()
+
+
+def test_live_round_wait_breach_blames_straggler():
+    """End to end on a live scheduler: a genuinely late contributor
+    drives its round-lag EWMA over the (declaratively re-armed)
+    round_wait threshold; the next health pass records a breach blaming
+    exactly that worker, and the health RPC serves it."""
+    import threading
+    import time as _time
+    obs_metrics.set_enabled(True)
+    os.environ["DT_SLO_RULES"] = \
+        '[{"name": "round_wait", "threshold": 50.0}]'
+    from dt_tpu.elastic import Scheduler, protocol
+    try:
+        sched = Scheduler(initial_workers=["w0", "w1"])
+    finally:
+        os.environ.pop("DT_SLO_RULES", None)
+    try:
+        def late():
+            _time.sleep(0.12)
+            sched._dp.allreduce("w1", "g", np.ones(2, np.float32), 0)
+
+        t = threading.Thread(target=late)
+        t.start()
+        sched._dp.allreduce("w0", "g", np.ones(2, np.float32), 0)
+        t.join()
+        sched._health_refresh()
+        state = sched._slo.state()
+        assert state["active"]["round_wait"]["worker"] == "w1"
+        assert state["active"]["round_wait"]["value"] >= 50.0
+        resp = protocol.request("127.0.0.1", sched.port,
+                                {"cmd": "health"})
+        assert resp["health"]["slo"]["active"]["round_wait"]["worker"] \
+            == "w1"
+        # the round's wait also landed in the histogram the exposition
+        # serves
+        assert sched._metrics.hist_quantile("round.wait_ms", 0.5) \
+            is not None
+    finally:
+        sched.close()

@@ -1,0 +1,406 @@
+"""dt_tpu.obs.metrics — gauges/histograms, the bounded time-series ring,
+Prometheus text exposition, the heartbeat merge, the SLO engine, and the
+off-path overhead guards (reference analog: the plane ps-lite never had —
+its ceiling was per-node ``PS_VERBOSE`` logging, ``van.cc:563-570``)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from dt_tpu.obs import metrics as obs_metrics
+from dt_tpu.obs import trace as obs_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "fixtures",
+                      "metrics_exposition.golden")
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics_plane():
+    """Each test starts (and leaves) the process registry empty and both
+    gates at their defaults (the registry is process-shared, like the
+    tracer — same discipline as test_obs's fixture)."""
+    obs_metrics.registry().clear()
+    yield
+    obs_metrics.set_enabled(None)
+    obs_trace.set_enabled(None)
+    obs_metrics.registry().clear()
+    obs_trace.tracer().reset_counters()
+    obs_trace.tracer().drain()
+
+
+def test_ring_bounds_and_drop_accounting_under_fake_clock():
+    clock = {"t": 1_000_000_000_000}
+    reg = obs_metrics.MetricsRegistry(name="t", capacity=4,
+                                      wall_clock=lambda: clock["t"],
+                                      enabled=True)
+    reg.gauge("train.loss", 2.0)
+    samples = []
+    for i in range(7):
+        clock["t"] += 1_000_000_000  # 1 s
+        samples.append(reg.sample())
+    # seqs strictly increase; ts from the injected clock (ms)
+    assert [s["seq"] for s in samples] == list(range(1, 8))
+    assert samples[1]["ts_ms"] - samples[0]["ts_ms"] == 1000
+    assert samples[0]["gauges"] == {"train.loss": 2.0}
+    snap = reg.snapshot()
+    assert len(snap["series"]) == 4          # bounded
+    assert snap["dropped"] == 3              # oldest shed, counted
+    assert [s["seq"] for s in snap["series"]] == [4, 5, 6, 7]
+    # drain in bounded bites preserves order; labeled gauges stay OUT
+    # of the series (they are per-entity last-values, not a trajectory)
+    reg.gauge("worker.step_rate", 1.0, labels={"worker": "w0"})
+    clock["t"] += 1_000_000_000
+    s = reg.sample()
+    assert "worker.step_rate" not in s["gauges"]
+    first = reg.drain_series(max_samples=2)
+    assert [x["seq"] for x in first] == [5, 6]
+    assert [x["seq"] for x in reg.drain_series()] == [7, 8]
+    assert reg.series() == []
+
+
+def test_histogram_buckets_and_quantile():
+    reg = obs_metrics.MetricsRegistry(name="t", enabled=True)
+    for v in (0.5, 3.0, 3.0, 60.0, 9999.0, 1e9):
+        reg.observe("round.wait_ms", v, buckets=(1.0, 5.0, 100.0))
+    [[name, labels, h]] = reg.hists_export()
+    assert name == "round.wait_ms" and labels == {}
+    assert h["buckets"] == [1.0, 5.0, 100.0]
+    assert h["counts"] == [1, 2, 1, 2]  # per-bucket, +Inf last
+    assert h["count"] == 6
+    # nearest-upper-bound quantiles off the fixed buckets
+    assert reg.hist_quantile("round.wait_ms", 0.5) == 5.0
+    assert reg.hist_quantile("round.wait_ms", 0.99) == float("inf")
+    assert reg.hist_quantile("absent", 0.5) is None
+
+
+def test_prometheus_exposition_golden_and_line_format():
+    """Byte-exact against the committed golden file, plus a
+    promtool-style per-line grammar check (no external dep) and the
+    TYPE-before-samples ordering invariant."""
+    reg = obs_metrics.MetricsRegistry(name="t", capacity=8, enabled=True)
+    reg.gauge("train.loss", 1.25)
+    reg.gauge("train.steps", 40)
+    reg.gauge("worker.step_rate", 2.5, labels={"worker": "w0"})
+    reg.observe("round.wait_ms", 3.0, buckets=(1.0, 5.0, 25.0))
+    reg.observe("round.wait_ms", 60.0, buckets=(1.0, 5.0, 25.0))
+    text = obs_metrics.render_prometheus([
+        ({"role": "scheduler"}, reg.snapshot(),
+         {"transport.requests": 12}),
+        ({"worker": "w0", "inc": "7"},
+         {"gauges": [["train.loss", {}, 0.5],
+                     ["health.grad_norm", {}, 1.5]],
+          "hists": []},
+         {"heartbeat.sent": 9}),
+    ])
+    assert text == open(GOLDEN).read()
+    typed = set()
+    for line in text.strip().split("\n"):
+        assert obs_metrics.PROM_LINE_RE.match(line), line
+        m = re.match(r"# TYPE (\S+)", line)
+        if m:
+            typed.add(m.group(1))
+        elif not line.startswith("#"):
+            fam = re.match(r"([a-zA-Z0-9_:]+)", line).group(1)
+            fam = re.sub(r"_(bucket|sum|count)$", "", fam)
+            assert fam in typed or fam + "_total" in typed, line
+    # deterministic: a second render is byte-identical
+    assert text == obs_metrics.render_prometheus([
+        ({"role": "scheduler"}, reg.snapshot(),
+         {"transport.requests": 12}),
+        ({"worker": "w0", "inc": "7"},
+         {"gauges": [["train.loss", {}, 0.5],
+                     ["health.grad_norm", {}, 1.5]],
+          "hists": []},
+         {"heartbeat.sent": 9}),
+    ])
+
+
+def test_heartbeat_merge_with_seq_dedup():
+    """Worker metrics batches ride the heartbeat; an at-least-once
+    replay must not duplicate samples, and a STALE gauge snapshot
+    (lower gseq, e.g. a heartbeat delivered after the close-flush) must
+    not roll the cumulative view back."""
+    obs_metrics.set_enabled(True)
+    from dt_tpu.elastic import Scheduler, protocol
+    sched = Scheduler(initial_workers=["w0"])
+    try:
+        batch = {"inc": 7, "gseq": 2,
+                 "samples": [{"seq": 1, "ts_ms": 1000,
+                              "gauges": {"train.steps": 8.0}},
+                             {"seq": 2, "ts_ms": 2000,
+                              "gauges": {"train.steps": 16.0}}],
+                 "gauges": [["train.loss", {}, 0.5]], "hists": [],
+                 "dropped": 0}
+        protocol.request("127.0.0.1", sched.port,
+                         {"cmd": "heartbeat", "host": "w0", "pseq": 0,
+                          "hm": batch})
+        # replay (same seqs) + a stale gauge snapshot (gseq 1)
+        protocol.request("127.0.0.1", sched.port,
+                         {"cmd": "obs_push", "host": "w0",
+                          "hm": {**batch, "gseq": 1,
+                                 "gauges": [["train.loss", {}, 99.0]]}})
+        job = sched.obs_dump()
+        track = job["metrics"]["tracks"]["w0#7"]
+        assert len(track["samples"]) == 2  # deduped
+        assert track["gauges"] == [["train.loss", {}, 0.5]]  # not rolled back
+        # the scheduler derived a per-worker step rate from the series
+        # (16-8 steps over 1 s) and the health view carries it
+        health = job["health"]
+        assert health["enabled"]
+        gauges = {(n, tuple(sorted(l.items()))): v
+                  for n, l, v in health["gauges"]}
+        assert gauges[("worker.step_rate",
+                       (("worker", "w0"),))] == pytest.approx(8.0)
+        assert health["workers"]["w0#7"]["samples"] == 2
+        assert health["workers"]["w0#7"]["gauges"]["train.steps"] == 16.0
+        # the health RPC serves the same view
+        resp = protocol.request("127.0.0.1", sched.port,
+                                {"cmd": "health"})
+        assert resp["health"]["enabled"]
+        assert resp["health"]["workers"]["w0#7"]["samples"] == 2
+        # membership removal scrubs the worker's metrics state: no
+        # frozen step-rate series advertised for an evicted host
+        sched._metrics_forget({"w0"})
+        health = sched.health_view()
+        assert health["workers"] == {}
+        assert not any(l.get("worker") == "w0"
+                       for _, l, _ in health["gauges"])
+    finally:
+        sched.close()
+
+
+def test_slo_engine_breach_clear_pinned_numbers():
+    """Edge-triggered transitions, worst-violator blame, unarmed floors,
+    and the DT_SLO_RULES by-name override — pinned number by number."""
+    eng = obs_metrics.SLOEngine()
+    tr = obs_trace.Tracer(name="t", enabled=True)
+    # step_rate floor defaults UNARMED (threshold 0): no breach at 0.0
+    out = eng.evaluate({"worker.step_rate": {"w0": 0.0},
+                        "round.wait_ms": {"w0": 10.0, "w1": 700.0,
+                                          "w2": 650.0}},
+                       tracer=tr, now_ms=1000)
+    assert out == [{"rule": "round_wait", "worker": "w1",
+                    "value": 700.0, "threshold": 500.0, "ts_ms": 1000,
+                    "what": "breach"}]
+    # still breaching: no re-fire, but blame/value refresh
+    assert eng.evaluate({"round.wait_ms": {"w2": 800.0}},
+                        tracer=tr, now_ms=2000) == []
+    assert eng.state()["active"]["round_wait"]["worker"] == "w2"
+    # the refresh must NOT retroactively rewrite the recorded at-breach
+    # transition (history holds a copy, not the live active entry)
+    assert eng.state()["history"][0]["worker"] == "w1"
+    assert eng.state()["history"][0]["ts_ms"] == 1000
+    # recovery: one clear transition
+    out = eng.evaluate({"round.wait_ms": {"w1": 1.0, "w2": 2.0}},
+                       tracer=tr, now_ms=3000)
+    assert [(e["rule"], e["what"]) for e in out] == \
+        [("round_wait", "clear")]
+    assert eng.state()["active"] == {}
+    assert [e["what"] for e in eng.state()["history"]] == \
+        ["breach", "clear"]
+    # the events landed on the tracer with the blame attached
+    evs = [r for r in tr.snapshot()["records"]
+           if r[2] in ("health.breach", "health.clear")]
+    assert [r[2] for r in evs] == ["health.breach", "health.clear"]
+    assert evs[0][8]["worker"] == "w1" and evs[0][8]["value"] == 700.0
+    # scalar rule + export source: causal_orphans evaluates only on the
+    # export pass
+    assert eng.evaluate({"causal.orphan_rate": 0.5}, now_ms=0) == []
+    out = eng.evaluate({"causal.orphan_rate": 0.5}, now_ms=0,
+                       source="export")
+    assert out[0]["rule"] == "causal_orphans" and out[0]["worker"] is None
+    # DT_SLO_RULES override merges by name (threshold re-armed, the
+    # rest of the default row kept) and appends unknown names
+    os.environ["DT_SLO_RULES"] = json.dumps(
+        [{"name": "round_wait", "threshold": 50.0},
+         {"name": "custom", "metric": "x", "op": ">", "threshold": 1.0}])
+    try:
+        eng2 = obs_metrics.SLOEngine.from_env()
+        by = {r["name"]: r for r in eng2.rules}
+        assert by["round_wait"]["threshold"] == 50.0
+        assert by["round_wait"]["per_worker"] is True  # kept
+        assert by["custom"]["metric"] == "x"
+        out = eng2.evaluate({"round.wait_ms": {"w1": 60.0}}, now_ms=0)
+        assert out[0]["worker"] == "w1"
+    finally:
+        os.environ.pop("DT_SLO_RULES", None)
+    # a typo'd op must fail loudly at construction, never silently
+    # invert the comparison direction
+    with pytest.raises(ValueError, match="op"):
+        obs_metrics.SLOEngine([{"name": "x", "metric": "m",
+                                "op": ">=", "threshold": 1.0}])
+    # same for a rule missing its metric: construction-time failure,
+    # never a KeyError inside the sampler's swallowed evaluate pass
+    with pytest.raises(ValueError, match="metric"):
+        obs_metrics.SLOEngine([{"name": "x", "threshold": 1.0}])
+
+
+def test_disabled_path_allocates_nothing_measurable():
+    import tracemalloc
+    reg = obs_metrics.MetricsRegistry(name="t", enabled=False)
+    for _ in range(64):  # warm every code path first
+        reg.gauge("train.loss", 1.0)
+        reg.observe("step.ms", 1.0)
+        reg.sample()
+    tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    for _ in range(5000):
+        reg.gauge("train.loss", 1.0)
+        reg.observe("step.ms", 1.0)
+        reg.sample()
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    # filter to allocations whose COUNT scales with the loop: a real
+    # per-call leak shows thousands of retained objects; tracemalloc's
+    # own per-line bookkeeping (a couple of constant-size trace entries
+    # per source line) does not
+    retained = sum(
+        s.size_diff for s in after.compare_to(before, "lineno")
+        if s.size_diff > 0 and s.count_diff > 64 and s.traceback and
+        s.traceback[0].filename.endswith(
+            os.path.join("obs", "metrics.py")))
+    assert retained < 512, f"disabled path retained {retained} bytes"
+    snap = reg.snapshot()
+    assert snap["gauges"] == [] and snap["series"] == []
+
+
+def test_metrics_on_wall_time_overhead_bounded():
+    """The metrics plane on must not materially slow the control/data
+    plane loopback loop (< 1.5x, mirroring the r9 obs guard).  Trials
+    are interleaved off/on pairs and the best pairwise ratio is
+    asserted, so one quiet pair survives noisy shared CI."""
+    import time as _time
+    import numpy as np
+    obs_metrics.set_enabled(True)  # scheduler built WITH the plane
+    from dt_tpu.elastic import Scheduler, protocol
+    sched = Scheduler(initial_workers=["w0"])
+    try:
+        def trial(n=60):
+            t0 = _time.perf_counter()
+            for i in range(n):
+                protocol.request(
+                    "127.0.0.1", sched.port,
+                    {"cmd": "allreduce", "host": "w0", "key": "g",
+                     "seq": trial.seq + i,
+                     "value": np.ones(64, np.float32)})
+            trial.seq += n
+            return _time.perf_counter() - t0
+        trial.seq = 0
+
+        trial(20)  # warm the pooled channel + code paths
+        ratios = []
+        for _ in range(5):
+            obs_metrics.set_enabled(False)
+            off = trial()
+            obs_metrics.set_enabled(True)
+            on = trial()
+            ratios.append(on / off)
+        assert min(ratios) < 1.5, ratios
+    finally:
+        sched.close()
+
+
+def test_scheduler_prometheus_endpoint_and_worker_labels():
+    """The DT_METRICS_PORT endpoint serves valid text exposition
+    covering the scheduler AND every live worker incarnation's label
+    set; /healthz serves the health JSON."""
+    obs_metrics.set_enabled(True)
+    os.environ["DT_METRICS_PORT"] = "0"  # ephemeral (tests)
+    from dt_tpu.elastic import Scheduler, protocol
+    try:
+        sched = Scheduler(initial_workers=["w0"])
+    finally:
+        os.environ.pop("DT_METRICS_PORT", None)
+    try:
+        assert sched.metrics_port
+        for inc in (7, 8):  # two incarnations of w0 (quick restart)
+            protocol.request(
+                "127.0.0.1", sched.port,
+                {"cmd": "heartbeat", "host": "w0", "pseq": 0,
+                 "hm": {"inc": inc, "gseq": 1,
+                        "samples": [{"seq": 1, "ts_ms": 1000,
+                                     "gauges": {"train.loss": 0.25}}],
+                        "gauges": [["train.loss", {}, 0.25]],
+                        "hists": [], "dropped": 0}})
+        url = f"http://127.0.0.1:{sched.metrics_port}"
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+        for line in text.strip().split("\n"):
+            assert obs_metrics.PROM_LINE_RE.match(line), line
+        assert 'dt_train_loss{inc="7",worker="w0"} 0.25' in text
+        assert 'dt_train_loss{inc="8",worker="w0"} 0.25' in text
+        assert 'role="scheduler"' in text
+        assert "dt_transport_requests_total" in text
+        health = json.loads(
+            urllib.request.urlopen(url + "/healthz").read())
+        assert health["enabled"] and "slo" in health
+        # the same text is exposed programmatically (chaos/tests hook)
+        assert "dt_train_loss" in sched.metrics_text()
+        # a second scheduler pointed at the SAME (taken) port must still
+        # come up — the endpoint is best-effort, never fatal (the
+        # same-host HA-pair topology reads one DT_METRICS_PORT)
+        os.environ["DT_METRICS_PORT"] = str(sched.metrics_port)
+        try:
+            from dt_tpu.elastic import Scheduler
+            sched2 = Scheduler(initial_workers=["w1"])
+        finally:
+            os.environ.pop("DT_METRICS_PORT", None)
+        try:
+            assert sched2.metrics_port is None
+        finally:
+            sched2.close()
+    finally:
+        sched.close()
+
+
+def test_export_and_dtop_render_health_board(tmp_path):
+    """The health/metrics sections survive the export round-trip
+    (byte-deterministic .metrics.json) and dtop renders the health
+    board from the dump file — the acceptance path for rendering from
+    a file; test_heartbeat_merge covers the live obs_dump source."""
+    from dt_tpu.obs import export as obs_export
+    job = {"tracks": {}, "straggler": {},
+           "health": {
+               "enabled": True, "interval_s": 2.0,
+               "slo": {"rules": list(obs_metrics.DEFAULT_SLO_RULES),
+                       "active": {"round_wait": {
+                           "rule": "round_wait", "worker": "w1",
+                           "value": 700.0, "threshold": 500.0,
+                           "ts_ms": 1000, "what": "breach"}},
+                       "history": [{"rule": "round_wait",
+                                    "worker": "w1", "value": 700.0,
+                                    "threshold": 500.0, "ts_ms": 1000,
+                                    "what": "breach"}]},
+               "gauges": [["obs.ring_dropped", {}, 0.0]],
+               "hists": [],
+               "workers": {"w1#5": {"samples": 3, "dropped": 0,
+                                    "gauges": {"train.loss": 0.125}}}},
+           "metrics": {"tracks": {"w1#5": {
+               "samples": [{"seq": 1, "ts_ms": 1000,
+                            "gauges": {"train.loss": 0.125}}],
+               "gauges": [["train.loss", {}, 0.125]], "dropped": 0}}}}
+    path = str(tmp_path / "trace.json")
+    summary = obs_export.write(path, job)
+    assert summary["health"]["slo"]["active"]["round_wait"]["worker"] \
+        == "w1"
+    # no client spans -> orphan rate 0, no export breach
+    assert summary["health"]["derived"]["causal.orphan_rate"] == 0.0
+    assert summary["health"]["export_breaches"] == []
+    assert summary["metrics"]["tracks"]["w1#5"]["samples"][0]["seq"] == 1
+    # byte-deterministic write
+    path2 = str(tmp_path / "b.json")
+    obs_export.write(path2, job)
+    assert open(obs_export.metrics_path(path), "rb").read() == \
+        open(obs_export.metrics_path(path2), "rb").read()
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "dtop.py"), path],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "health board" in r.stdout
+    assert "BREACH round_wait: worker=w1" in r.stdout
+    assert "train.loss=0.125" in r.stdout

@@ -33,12 +33,18 @@ class FakeClock:
 @pytest.fixture(autouse=True)
 def _clean_process_tracer():
     """Each test starts (and leaves) the process tracer empty and the
-    process gate at its default."""
+    process gate at its default.  Counters are reset too (r15): they are
+    cumulative on the shared process tracer, so the exact-count asserts
+    below (``allreduce.rounds == 1`` etc.) failed whenever overlap/ha
+    tests ran earlier in the same pytest process — tier-1 must pass in
+    ANY test order, not just the canonical one."""
     obs_trace.tracer().drain()
+    obs_trace.tracer().reset_counters()
     yield
     obs_trace.set_enabled(None)
     obs_trace.set_origin(None)  # a WorkerClient names the process track
     obs_trace.tracer().drain()
+    obs_trace.tracer().reset_counters()
 
 
 def _mk(capacity=64):

@@ -54,12 +54,33 @@ run's rate via ``--expect-step-rate`` for an external baseline).  The
 decision log's sha256 is printed — two runs at the same seed must
 print the same hash (bit-reproducible decisions).
 
+**Nan plan (r15 health sentinel, docs/observability.md):** ``--plan
+nan`` arms the training-health sentinel (``DT_METRICS=1`` +
+``DT_HEALTH_HALT=1``) and poisons exactly ONE gradient: a site-scoped
+``nan`` rule fires at w1's ``worker.grad`` hook on its 21st step
+(``after=20, times=1``).  The poisoned contribution makes the allreduce
+average non-finite on EVERY worker, so the fused device-side check
+trips fleet-wide on the same step and the compiled step SKIPS the
+update.  Success: all workers exit 0 with ``health_halted``, every
+worker's ``final_step`` equals the pre-fault prefix (20), params
+bit-identical across the fleet, loss finite — deterministic across two
+runs at one seed.  With ``--trace``, the ``fault.nan`` event must land
+on w1's track.
+
+**Health-plane cross-check (r15):** every ``--trace`` run (and the
+straggler plan) also arms the metrics plane with the ``round_wait`` SLO
+threshold lowered to 50 ms via the declarative ``DT_SLO_RULES``
+override; the seeded w1 delay must surface as an SLO breach blaming w1
+— in agreement with the PR 8 critical-path blame and the PR 9 policy
+decision log.
+
 Usage::
 
     python tools/chaos_run.py --seed 0 --plan default
     python tools/chaos_run.py --plan none          # fault-free baseline
     python tools/chaos_run.py --plan scheduler_kill   # HA failover drill
     python tools/chaos_run.py --plan straggler     # policy-engine drill
+    python tools/chaos_run.py --plan nan           # health-sentinel drill
 
 Prints one JSON summary line and exits non-zero on any failed check.
 """
@@ -92,6 +113,17 @@ STRAGGLE_DELAY_S = 0.15
 POLICY_DELAY_S = 0.5
 POLICY_ENV = {"DT_POLICY": "1", "DT_POLICY_STRAGGLER_MS": "50",
               "DT_POLICY_EVICT_AFTER": "3"}
+#: r15 nan plan: w1's 21st gradient is poisoned (after=20), so every
+#: worker's sentinel must trip on global step 20 and the halted fleet's
+#: final_step is exactly this pre-fault prefix
+NAN_AFTER = 20
+#: r15 health plane: metrics on, with the round_wait SLO threshold
+#: lowered to the straggler probe's scale through the declarative
+#: DT_SLO_RULES override (docs/observability.md)
+HEALTH_ENV = {"DT_METRICS": "1",
+              "DT_SLO_RULES":
+              '[{"name": "round_wait", "threshold": 50.0}]'}
+NAN_ENV = {**HEALTH_ENV, "DT_HEALTH_HALT": "1"}
 
 #: scheduler-kill sites per HA plan (rule kwargs for the one crash rule
 #: the PRIMARY scheduler process loads via DT_FAULT_PLAN).  The `after`
@@ -156,6 +188,13 @@ def _plans(num_epoch):
         "straggler": ([FaultRule("delay", site="worker.step",
                                  host=STRAGGLE_HOST,
                                  delay_s=POLICY_DELAY_S)], []),
+        # the r15 health-sentinel drill: ONE poisoned gradient on w1;
+        # the fused non-finite check must halt the whole fleet before
+        # the update (clean worker transport otherwise — the fault
+        # under test is the training-quality excursion)
+        "nan": ([FaultRule("nan", site="worker.grad",
+                           host=STRAGGLE_HOST, after=NAN_AFTER,
+                           times=1)], []),
     }
     # scheduler-kill plans: clean worker transport (the fault under test
     # is the CONTROL PLANE dying, and bit-identity vs --plan none is an
@@ -196,7 +235,8 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--plan", default="default",
                     choices=["default", "noise", "crash-only", "none",
-                             "straggler"] + sorted(SCHED_KILL_SITES))
+                             "straggler", "nan"]
+                    + sorted(SCHED_KILL_SITES))
     ap.add_argument("--num-epoch", type=int, default=8)
     ap.add_argument("--timeout-s", type=float, default=1200.0)
     ap.add_argument("--trace", default="",
@@ -224,10 +264,20 @@ def main():
 
     ha_plan = args.plan in SCHED_KILL_SITES
     policy_plan = args.plan == "straggler"
+    nan_plan = args.plan == "nan"
     if policy_plan:
         # arm the policy engine BEFORE the in-process scheduler is built;
         # workers inherit through _spawn's env copy
         os.environ.update(POLICY_ENV)
+    if nan_plan:
+        # sentinel + clean-halt gates, before any dt_tpu.obs use
+        os.environ.update(NAN_ENV)
+    elif args.trace or policy_plan:
+        # r15: every traced run (and the policy drill) also exercises
+        # the metrics/health plane so the SLO breach cross-checks below
+        # have data; the declarative round_wait override matches the
+        # seeded delay's scale
+        os.environ.update(HEALTH_ENV)
     if args.trace or ha_plan:
         # before any dt_tpu.obs use: the scheduler reads it in-process,
         # workers inherit it through _spawn's env copy.  The HA plans
@@ -482,6 +532,19 @@ def main():
                     round(rate_base, 3) if rate_base else None,
                 "straggler_scores": sched._dp.straggler_scores()}
 
+        if nan_plan and len(results) == len(HOSTS):
+            # the sentinel caught the poisoned gradient and the fleet
+            # halted cleanly BEFORE the update: every worker reports the
+            # halt, and every worker's step count is exactly the
+            # pre-fault prefix (the generic params_identical /
+            # loss_finite checks above pin the rest; two runs at one
+            # seed print the same param_hash — bit-reproducible)
+            checks["halted_all"] = all(
+                r.get("health_halted") for r in results.values())
+            checks["halt_step_pre_fault"] = all(
+                r.get("final_step") == NAN_AFTER
+                for r in results.values())
+
         failover_ms = None
         if ha_plan:
             # the primary really died by the injected exit, nobody was
@@ -607,6 +670,32 @@ def main():
                     and (blame_top is None
                          or blame_top == STRAGGLE_HOST))
 
+            if nan_plan:
+                # the injected poison is on the timeline, on the right
+                # worker's track (the generic faults_applied check
+                # already pins the count)
+                checks["trace_nan_event"] = \
+                    ev.get((STRAGGLE_HOST, "nan"), 0) >= 1
+
+            # r15 health-plane agreement: the seeded w1 delay must ALSO
+            # surface as a round_wait SLO breach blaming w1 — the same
+            # verdict the critical-path blame (PR 8) and the policy
+            # decision log (PR 9) reach, three subsystems agreeing on
+            # one straggler
+            if has_probe or policy_plan:
+                # the gate is "the seeded straggler WAS detected", not
+                # "no other worker ever lagged past the lowered 50 ms
+                # threshold" — on a loaded box a transient breach can
+                # legitimately blame someone else between w1's
+                # excursions (the board/critical-path checks above
+                # already pin w1 as the DOMINANT straggler)
+                hist = ((summary.get("health") or {}).get("slo") or {}) \
+                    .get("history", [])
+                checks["health_breach_blames_straggler"] = any(
+                    e.get("rule") == "round_wait"
+                    and e.get("what") == "breach"
+                    and e.get("worker") == STRAGGLE_HOST for e in hist)
+
         ok = bool(checks) and all(checks.values())
         print(json.dumps({
             "ok": ok, "plan": args.plan, "seed": args.seed,
@@ -619,6 +708,8 @@ def main():
             "causal": summary.get("causal") if summary else None,
             "straggler": summary.get("straggler") if summary else None,
             "policy": policy_summary,
+            "health_slo": (summary.get("health") or {}).get("slo")
+            if summary else None,
             "transport": tstats,
             "final_loss": {h: r.get("final_loss")
                            for h, r in results.items()},

@@ -5,7 +5,9 @@ Renders step-time percentiles, stall attribution, the r13 critical-path
 split (compute / d2h / send / server queue / straggler-wait / reply /
 h2d), the straggler board, the r14 policy-decisions section (current
 batch shares, breach streaks, decision timeline — ``docs/policy.md``),
-per-worker retry/fault counts, and the
+the r15 health board (active SLO breaches with the blamed worker,
+breach/clear timeline, per-worker training-health gauges —
+``dt_tpu/obs/metrics.py``), per-worker retry/fault counts, and the
 membership/leadership timeline from either a merged chrome trace
 written by ``dt_tpu.obs.export`` (e.g. ``tools/chaos_run.py --trace
 out.json``) or a LIVE scheduler (the ``obs_dump`` control command — the
@@ -184,6 +186,38 @@ def render(summary) -> str:
                 str(sh[h]) for h in sorted(sh)))
             lines.append(f"  #{d.get('seq')} epoch {d.get('epoch')}: "
                          + "  ".join(what))
+    # r15 health board (dt_tpu/obs/metrics.py): active SLO breaches,
+    # the recent breach/clear timeline (with the blamed worker), the
+    # post-hoc export breaches, and each worker's latest shipped
+    # training-health gauges — same section from a dump file or a live
+    # scheduler's obs_dump
+    health = summary.get("health", {})
+    if health.get("enabled"):
+        slo = health.get("slo", {})
+        active = slo.get("active", {})
+        lines.append("")
+        lines.append(f"health board ({len(slo.get('rules', []))} SLO "
+                     f"rules, {len(active)} active breach(es)):")
+        for name, b in sorted(active.items()):
+            lines.append(
+                f"  BREACH {name}: worker={b.get('worker') or '-'}  "
+                f"value={b.get('value')}  "
+                f"threshold={b.get('threshold')}")
+        for e in slo.get("history", [])[-8:]:
+            lines.append(
+                f"  {e.get('what', ''):<7}{e.get('rule')}  "
+                f"worker={e.get('worker') or '-'}  "
+                f"value={e.get('value')}")
+        for e in health.get("export_breaches", []):
+            lines.append(
+                f"  breach* {e.get('rule')} (post-hoc, export): "
+                f"value={e.get('value')}  "
+                f"threshold={e.get('threshold')}")
+        for track, w in sorted(health.get("workers", {}).items()):
+            g = w.get("gauges", {})
+            parts = "  ".join(f"{k}={g[k]:.4g}" for k in sorted(g))
+            lines.append(f"  {track:<20}samples={w.get('samples', 0)}"
+                         f"  {parts}")
     causal = summary.get("causal", {})
     if causal.get("client_spans"):
         lines.append("")
