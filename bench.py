@@ -196,18 +196,45 @@ def guarded_main():
     return 0
 
 
+def _arm_blackbox(tag):
+    """r16 flight recorder: register the crash-bundle hooks + a hang
+    watchdog in this (child) process, so a wedged attempt leaves a
+    bundle with the blocking frame instead of a bare rc (no-op unless
+    ``DT_BLACKBOX=1``; ``bench_watchdog.sh`` arms it).  Returns the
+    watchdog (or None) — beat it at stage boundaries."""
+    try:
+        from dt_tpu.obs import blackbox
+    except Exception:  # noqa: BLE001 — forensics must not break a bench
+        return None
+    if not blackbox.enabled():
+        return None
+    blackbox.install(host=tag)
+    blackbox.note("bench.stage", tag=tag, stage="start")
+    # beats land only at tier boundaries and a HEALTHY tier runs many
+    # minutes (compile + measurement) — floor the deadman well above
+    # the training-loop default or every clean run dumps phantom hang
+    # bundles; a real wedge still leaves one long before the 90-min
+    # DT_BENCH_TIMEOUT_S rc
+    return blackbox.Watchdog(host=tag,
+                             hang_seconds=max(blackbox.hang_s(), 1800.0))
+
+
 def preflight():
     """Tiny end-to-end op on the default backend: proves device init,
     compile, and execute all work before the expensive model run."""
     from dt_tpu.config import maybe_force_cpu, enable_compilation_cache
     maybe_force_cpu()
     enable_compilation_cache()
+    dog = _arm_blackbox("bench-preflight")
     import jax
     import jax.numpy as jnp
     v = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.bfloat16))
     jax.block_until_ready(v)
     print(f"# preflight ok: backend={jax.default_backend()} "
           f"devices={len(jax.devices())} v={float(v):.1f}", file=sys.stderr)
+    if dog is not None:
+        dog.beat()
+        dog.stop()
     return 0
 
 
@@ -215,6 +242,7 @@ def main():
     from dt_tpu.config import maybe_force_cpu, enable_compilation_cache
     maybe_force_cpu()  # DT_FORCE_CPU=1 only; default backend otherwise
     enable_compilation_cache()
+    _bb_dog = _arm_blackbox("bench")
 
     # overridables exist so the measurement path can be smoke-tested on
     # CPU; the driver runs the default TIERS: a fast ResNet-18 point
@@ -239,6 +267,8 @@ def main():
     line = None
     last_err = None
     for net in tiers:
+        if _bb_dog is not None:
+            _bb_dog.beat()  # tier boundary: progress reached the deadman
         try:
             if net == "transformer_lm":
                 result = measure_tier_lm()
@@ -290,6 +320,8 @@ def main():
                 f.flush()
                 os.fsync(f.fileno())
         print(f"# tier {net} done: {line}", file=sys.stderr, flush=True)
+    if _bb_dog is not None:
+        _bb_dog.stop()
     if line is None:
         # EVERY tier failed: a bare "None" on stdout with rc 0 would read
         # as a bogus result to direct --run callers (the extra-tier calls

@@ -89,6 +89,14 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "DT_METRICS_PORT": ("", "scheduler Prometheus/health HTTP port (empty = no endpoint; 0 = ephemeral for tests)"),
     "DT_HEALTH_HALT": ("", "1 = training-health sentinel stops cleanly BEFORE a non-finite update is applied"),
     "DT_SLO_RULES": ("", "JSON list (or @/path) overriding the default SLO rule set by rule name (dt_tpu.obs.metrics.DEFAULT_SLO_RULES)"),
+    # flight recorder / hang forensics (dt_tpu/obs/blackbox.py, r16 —
+    # docs/observability.md)
+    "DT_BLACKBOX": ("", "1 = arm the flight-recorder plane: crash bundles, hang watchdog, manifest (chaos/bench_watchdog arm it; works with DT_OBS=0)"),
+    "DT_BLACKBOX_DIR": (".blackbox", "bundle + manifest.jsonl output directory"),
+    "DT_BLACKBOX_RING": ("512", "flight-note ring capacity (last-N lifecycle notes per process; overflow drops oldest)"),
+    "DT_BLACKBOX_MAX_MB": ("8", "per-bundle size cap (MiB), best-effort: ring tails trimmed first, thread stacks truncated last"),
+    "DT_BLACKBOX_MAX_BUNDLES": ("64", "per-directory bundle retention cap: oldest bundles pruned on write (manifest rows are kept)"),
+    "DT_HANG_S": ("120", "step/fleet-progress stall threshold (seconds) before the hang watchdog dumps a live bundle"),
     # policy engine (dt_tpu/policy — straggler-adaptive dynamic mini-batch
     # + autoscaling; docs/policy.md)
     "DT_POLICY": ("", "1 = enable the scheduler-side policy engine (batch-share rebalancing, auto-eviction, scale proposals)"),

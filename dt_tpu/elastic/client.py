@@ -23,6 +23,7 @@ import numpy as np
 
 from dt_tpu import config
 from dt_tpu.elastic import faults, protocol
+from dt_tpu.obs import blackbox as obs_blackbox
 from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 
@@ -206,6 +207,26 @@ class WorkerClient:
         self._hm_gseq = 0  # gauge/hist snapshot ordering; guarded-by: _hm_lock
         self._hm_sampler = obs_metrics.Sampler(obs_metrics.registry()) \
             if self._hm_export else None
+        # r16 flight recorder (dt_tpu/obs/blackbox.py): arm the process
+        # crash hooks (SIGTERM/excepthook/faulthandler — idempotent,
+        # no-op when DT_BLACKBOX is off) and stamp every bundle this
+        # process writes with the live membership/identity state.  Weak
+        # reference, like the obs flush hook above: an abandoned client
+        # must stay collectable.
+        self._bb_state_name = None
+        if obs_blackbox.enabled():
+            obs_blackbox.install(host=self.host)
+            import weakref
+            _wm_state = weakref.WeakMethod(self._bb_state)
+            self._bb_state_name = f"client:{self.host}"
+
+            def _bb_provider(_wm=_wm_state):
+                fn = _wm()
+                return fn() if fn is not None else {"gone": True}
+            # keep the exact callable: close() unregisters identity-
+            # guarded so it can't strip a same-name successor's provider
+            self._bb_provider = _bb_provider
+            obs_blackbox.register_state(self._bb_state_name, _bb_provider)
         self._stop = threading.Event()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval_s,),
@@ -569,6 +590,34 @@ class WorkerClient:
             self._hm_pending = [s for s in self._hm_pending
                                 if s["seq"] > last]
 
+    def _bb_state(self) -> dict:
+        """Blackbox state provider: this worker's identity/membership
+        view, stamped into every bundle the process writes (bounded
+        lock waits — a bundle from a signal handler must not deadlock
+        on a lock the dying thread holds)."""
+        out = {"role": "worker", "host": self.host,
+               "incarnation": self._obs_inc,
+               "recovery_pending": self.recovery_pending}
+        # bounded acquires, not `with`: a bundle written from a signal
+        # handler must not deadlock on a lock the dying thread holds —
+        # each lock IS held inside its branch (DT006 can't see the
+        # timeout-acquire form)
+        if self._addr_lock.acquire(timeout=0.5):
+            try:
+                out["fence"] = self.fence  # dtlint: ignore[DT006]
+                out["leader"] = list(self.addrs[self._leader])  # dtlint: ignore[DT006]
+            finally:
+                self._addr_lock.release()
+        if self._prof_lock.acquire(timeout=0.5):
+            try:
+                out["rank"] = self.rank  # dtlint: ignore[DT006]
+                out["workers"] = list(self.workers)
+                out["policy_seq"] = self.policy_seq  # dtlint: ignore[DT006]
+                out["policy_shares"] = dict(self.policy_shares)  # dtlint: ignore[DT006]
+            finally:
+                self._prof_lock.release()
+        return out
+
     def _apply_profile_cmd(self, c: dict) -> None:
         """Apply one remote profiler command locally (rank-prefixed output),
         the worker side of the reference's server-profiler protocol
@@ -618,9 +667,15 @@ class WorkerClient:
         # the epoch-boundary window: a crash HERE (before the scheduler
         # sees our arrival) is the quick-restart re-admission race's trigger
         faults.crash_point("client.mc_barrier", host=self.host, epoch=epoch)
-        t0 = obs_trace.tracer().now()
-        resp = self._req({"cmd": "mc_barrier", "host": self.host,
-                          "epoch": epoch, "info": info})
+        # named begin: a barrier this process dies inside shows up in
+        # the blackbox bundle's open-span snapshot (r16)
+        t0 = obs_trace.tracer().begin("mc_barrier", {"epoch": epoch})
+        try:
+            resp = self._req({"cmd": "mc_barrier", "host": self.host,
+                              "epoch": epoch, "info": info})
+        except BaseException:
+            obs_trace.tracer().abandon(t0)  # failed attempt: no span,
+            raise                           # no open-table phantom
         obs_trace.tracer().complete_span(
             "mc_barrier", t0,
             {"epoch": epoch, "removed": bool(resp.get("you_are_removed"))})
@@ -660,32 +715,40 @@ class WorkerClient:
         epoch in lockstep.  The scheduler bumps our stale ``resume_epoch``
         to its live barrier, so re-sending is safe."""
         deadline = time.time() + timeout_s
-        t0 = obs_trace.tracer().now()
-        while self.recovery_pending:
-            if time.time() > deadline:
-                raise TimeoutError("recovery re-admission timed out")
-            try:
-                resp = self._req({"cmd": "mc_barrier", "host": self.host,
-                                  "epoch": self.resume_epoch,
-                                  "info": {"RECOVERY": 1}})
-            except RuntimeError:
-                # barrier window timed out server-side (survivors mid-
-                # epoch): park again at the next one
-                continue
-            if resp.get("you_are_removed"):
-                raise WorkerRemoved(self.host)
-            if resp.get("rank", -1) >= 0:
-                with self._prof_lock:
-                    self.workers = resp["workers"]
-                    self.rank = resp["rank"]
-                    self._adopt_policy_locked(resp)
-                    self.recovery_pending = False
-                obs_trace.tracer().complete_span(
-                    "recovery.rejoin", t0,
-                    {"epoch": int(resp["epoch"]),
-                     "rank": int(resp["rank"])})
-                return int(resp["epoch"])
-            # a removal won this barrier; recovery stays queued
+        t0 = obs_trace.tracer().begin("recovery.rejoin")
+        try:
+            while self.recovery_pending:
+                if time.time() > deadline:
+                    raise TimeoutError("recovery re-admission timed out")
+                try:
+                    resp = self._req({"cmd": "mc_barrier",
+                                      "host": self.host,
+                                      "epoch": self.resume_epoch,
+                                      "info": {"RECOVERY": 1}})
+                except RuntimeError:
+                    # barrier window timed out server-side (survivors
+                    # mid-epoch): park again at the next one
+                    continue
+                if resp.get("you_are_removed"):
+                    raise WorkerRemoved(self.host)
+                if resp.get("rank", -1) >= 0:
+                    with self._prof_lock:
+                        self.workers = resp["workers"]
+                        self.rank = resp["rank"]
+                        self._adopt_policy_locked(resp)
+                        self.recovery_pending = False
+                    obs_trace.tracer().complete_span(
+                        "recovery.rejoin", t0,
+                        {"epoch": int(resp["epoch"]),
+                         "rank": int(resp["rank"])})
+                    return int(resp["epoch"])
+                # a removal won this barrier; recovery stays queued
+        except BaseException:
+            # a rejoin that raised records no span — drop its
+            # open-table entry (r16 abandon contract)
+            obs_trace.tracer().abandon(t0)
+            raise
+        obs_trace.tracer().abandon(t0)  # nothing was pending: no span
         return self.resume_epoch
 
     def barrier(self) -> None:
@@ -801,13 +864,20 @@ class WorkerClient:
         This wrapper only adds the obs span: one ``allreduce`` record per
         TOP-LEVEL round (chunk sub-rounds ride inside it; their transport
         shows up as ``wire.request`` spans)."""
-        if _route is None and obs_trace.enabled():
+        # the blackbox plane arms this too: a hang bundle must name the
+        # round even with DT_OBS=0 (begin() is open-table-only then)
+        if _route is None and (obs_trace.enabled()
+                               or obs_blackbox.enabled()):
             tr = obs_trace.tracer()
-            t0 = tr.now()
+            t0 = tr.begin("allreduce", {"key": key})
             try:
                 return self._allreduce(key, value, _route)
             finally:
-                tr.counter("allreduce.rounds")
+                if obs_trace.enabled():
+                    # counter discipline (r10): training-plane counters
+                    # ride the TRACE gate only, or bb-armed runs leak
+                    # counts into exact-count obs asserts
+                    tr.counter("allreduce.rounds")
                 tr.complete_span("allreduce", t0, {"key": key})
         return self._allreduce(key, value, _route)
 
@@ -911,50 +981,57 @@ class WorkerClient:
         everywhere (a warning is logged)."""
         from dt_tpu.ops.sparse import RowSparse
         import jax.numpy as jnp
-        _obs_t0 = obs_trace.tracer().now()
-        nsrv = len(self.servers)
-        if nsrv > 1:
-            # partition the touched rows by the contiguous row-range →
-            # server map; each server merges its range concurrently and
-            # every worker contributes to EVERY server each round (empty
-            # partitions included) so rounds complete
-            ids, vals, bounds, part = self._partition_rows(
-                rs.num_rows, rs.indices, rs.values)
+        _obs_t0 = obs_trace.tracer().begin("allreduce_sparse",
+                                           {"key": key})
+        try:
+            nsrv = len(self.servers)
+            if nsrv > 1:
+                # partition the touched rows by the contiguous row-range
+                # → server map; each server merges its range concurrently
+                # and every worker contributes to EVERY server each round
+                # (empty partitions included) so rounds complete
+                ids, vals, bounds, part = self._partition_rows(
+                    rs.num_rows, rs.indices, rs.values)
 
-            def one(j):
-                sel = part == j
-                seq = self._ar_seq.get(f"{key}@s{j}", 0)
-                self._ar_seq[f"{key}@s{j}"] = seq + 1
-                return self._req_addr(
-                    self.servers[j],
+                def one(j):
+                    sel = part == j
+                    seq = self._ar_seq.get(f"{key}@s{j}", 0)
+                    self._ar_seq[f"{key}@s{j}"] = seq + 1
+                    return self._req_addr(
+                        self.servers[j],
+                        {"cmd": "allreduce", "host": self.host,
+                         "key": key, "seq": seq,
+                         "value": {"ids": ids[sel], "vals": vals[sel],
+                                   "num_rows": rs.num_rows}})["value"]
+
+                outs = list(self._fanout_pool().map(one, range(nsrv)))
+                for o in outs:
+                    if isinstance(o, dict) and "__error__" in o:
+                        raise RuntimeError(
+                            f"allreduce_sparse {key}: {o['__error__']}")
+                # ranges are disjoint and ascending: concatenation is
+                # the globally-sorted unique merge
+                out = {"ids": np.concatenate([o["ids"] for o in outs]),
+                       "vals": np.concatenate([o["vals"] for o in outs],
+                                              axis=0)}
+            else:
+                seq = self._ar_seq.get(key, 0)
+                self._ar_seq[key] = seq + 1
+                out = self._req_addr(
+                    self._data_addr(key),
                     {"cmd": "allreduce", "host": self.host, "key": key,
                      "seq": seq,
-                     "value": {"ids": ids[sel], "vals": vals[sel],
+                     "value": {"ids": np.asarray(rs.indices),
+                               "vals": np.asarray(rs.values),
                                "num_rows": rs.num_rows}})["value"]
-
-            outs = list(self._fanout_pool().map(one, range(nsrv)))
-            for o in outs:
-                if isinstance(o, dict) and "__error__" in o:
-                    raise RuntimeError(
-                        f"allreduce_sparse {key}: {o['__error__']}")
-            # ranges are disjoint and ascending: concatenation is the
-            # globally-sorted unique merge
-            out = {"ids": np.concatenate([o["ids"] for o in outs]),
-                   "vals": np.concatenate([o["vals"] for o in outs],
-                                          axis=0)}
-        else:
-            seq = self._ar_seq.get(key, 0)
-            self._ar_seq[key] = seq + 1
-            out = self._req_addr(
-                self._data_addr(key),
-                {"cmd": "allreduce", "host": self.host, "key": key,
-                 "seq": seq,
-                 "value": {"ids": np.asarray(rs.indices),
-                           "vals": np.asarray(rs.values),
-                           "num_rows": rs.num_rows}})["value"]
             if isinstance(out, dict) and "__error__" in out:
                 raise RuntimeError(
                     f"allreduce_sparse {key}: {out['__error__']}")
+        except BaseException:
+            # a failed round records no span — drop the open-table
+            # entry (r16 abandon contract)
+            obs_trace.tracer().abandon(_obs_t0)
+            raise
         merged = len(out["ids"])
         if capacity is None:
             capacity = 1 << max(merged - 1, 0).bit_length()
@@ -1159,6 +1236,9 @@ class WorkerClient:
         # otherwise never reach the scheduler's job timeline
         if self._obs_hook is not None:
             obs_trace.unregister_flush(self._obs_hook)
+        if self._bb_state_name is not None:
+            obs_blackbox.unregister_state(self._bb_state_name,
+                                          fn=self._bb_provider)
         if self._hm_sampler is not None:
             self._hm_sampler.stop()
         self.obs_flush()

@@ -23,6 +23,7 @@ from typing import Callable, Dict, Optional, Set
 import numpy as np
 
 from dt_tpu import config
+from dt_tpu.obs import blackbox as obs_blackbox
 from dt_tpu.obs import metrics as obs_metrics
 
 #: EWMA smoothing for the per-worker straggler score (round-contribution
@@ -238,12 +239,15 @@ class DataPlane:
             if seq >= 0 and served is not None and served[0] == seq:
                 return {"value": served[1]}  # retry of a completed round
             gen = slot["gen"]
-            # lag stamps ride the obs gate, the policy flag, OR the r15
+            # lag stamps ride the obs gate, the policy flag, the r15
             # metrics plane (the round.wait_ms histogram + round_wait SLO
-            # rule need the signal whether or not a timeline is exported)
+            # rule need the signal whether or not a timeline is exported),
+            # OR the r16 flight recorder (the fleet-hang detector ages
+            # pending rounds off these stamps)
             lag_ns = tnow[1] if tnow is not None else \
                 (time.monotonic_ns()
-                 if self._track_lag or obs_metrics.enabled() else None)
+                 if self._track_lag or obs_metrics.enabled()
+                 or obs_blackbox.enabled() else None)
             if lag_ns is not None:
                 # round span bookkeeping: the FIRST contribution opens
                 # the round's window; every host's FIRST arrival is
@@ -403,6 +407,32 @@ class DataPlane:
                                      "score_ms": round(score, 3)})
             else:
                 self._straggler_over.discard(h)
+
+    def pending_rounds(self) -> list:
+        """Incomplete allreduce rounds and who the fleet is waiting on —
+        the r16 fleet-hang detector's input (``dt_tpu/obs/blackbox.py``;
+        the scheduler blames the missing contributor when a round ages
+        past ``DT_HANG_S``).  ``age_s`` is measured from the round's
+        first contribution (``None`` when lag stamping is off — no
+        obs/policy/metrics/blackbox plane armed)."""
+        now = time.monotonic_ns()
+        out = []
+        with self._cv:
+            expected = set(self.expected_fn())
+            for key, slot in self._reduce.items():
+                if not slot["vals"]:
+                    continue
+                waiting = sorted(expected - set(slot["vals"]))
+                if not waiting:
+                    continue  # completing right now
+                lag0 = slot.get("lag0")
+                out.append({
+                    "key": key,
+                    "age_s": round(max(now - lag0, 0) / 1e9, 3)
+                    if lag0 is not None else None,
+                    "waiting": waiting,
+                    "contributed": sorted(slot["vals"])})
+        return out
 
     def straggler_scores(self) -> Dict[str, float]:
         """Per-worker round-contribution-lag EWMA (ms) — the straggler

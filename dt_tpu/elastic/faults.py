@@ -73,6 +73,15 @@ fused non-finite check must fire on that step and, under
 ``DT_HEALTH_HALT=1``, stop BEFORE the poisoned update is applied
 (``tools/chaos_run.py --plan nan``).
 
+Site-scoped **stall** rules (r16): a ``stall`` rule fires at a named
+:func:`stall_point` and blocks that thread FOREVER — the injected hang
+the flight-recorder watchdog (``dt_tpu/obs/blackbox.py``) exists to
+catch.  ``Module.fit`` hooks ``site="worker.step"``; ``after=`` pins
+the step.  The stalled process never resumes — the chaos harness's
+``--plan hang`` gates that the watchdog dumps a live bundle naming the
+stalled frame and that the scheduler blames the right worker, then
+reaps the fleet.
+
 Determinism
 -----------
 
@@ -107,7 +116,7 @@ from dt_tpu import config
 from dt_tpu.obs import trace as obs_trace
 
 KINDS = ("drop", "dup", "delay", "reorder", "reset", "partition", "crash",
-         "nan")
+         "nan", "stall")
 
 
 def _obs_fault(kind: str, op: str, idx: int, cmd: Optional[str] = None,
@@ -160,11 +169,11 @@ class FaultRule:
             raise ValueError(f"unknown fault op {op!r}")
         if action not in ("raise", "exit"):
             raise ValueError(f"unknown crash action {action!r}")
-        if kind in ("crash", "nan") and not site:
+        if kind in ("crash", "nan", "stall") and not site:
             raise ValueError(f"{kind} rules need a site=")
-        if site and kind not in ("crash", "delay", "nan"):
-            raise ValueError(f"site= applies to crash/delay/nan rules, "
-                             f"not {kind!r}")
+        if site and kind not in ("crash", "delay", "nan", "stall"):
+            raise ValueError(f"site= applies to crash/delay/nan/stall "
+                             f"rules, not {kind!r}")
         self.kind = kind
         self.op = op
         self.cmd = (cmd,) if isinstance(cmd, str) else \
@@ -367,6 +376,26 @@ class FaultPlan:
             fired += 1
         return fired
 
+    def stall_at(self, site: str, host: Optional[str] = None) -> None:
+        """Apply any matching site-scoped ``stall`` rules (r16): block
+        this thread INDEFINITELY — the injected hang the blackbox
+        watchdog exists to catch (``chaos_run --plan hang``).  The
+        stalled frame sits in THIS function, so a hang bundle's
+        all-thread stacks name ``stall_at`` / the site; the process
+        never resumes (the chaos harness reaps it)."""
+        from dt_tpu.obs import blackbox as obs_blackbox
+        for idx, r in enumerate(self.rules):
+            if r.kind != "stall" or r.site != site:
+                continue
+            if r.host is not None and host not in r.host:
+                continue
+            if not self._fire(idx, r, host):
+                continue
+            _obs_fault("stall", "site", idx, host=host, site=site)
+            obs_blackbox.note("fault.stall", site=site, host=host)
+            while True:  # deliberate: an injected hang does not end
+                time.sleep(1.0)
+
     def crash(self, site: str, host: Optional[str] = None,
               **ctx: Any) -> None:
         for idx, r in enumerate(self.rules):
@@ -386,6 +415,16 @@ class FaultPlan:
                 # best-effort and obs-gated, so the exit stays
                 # SIGKILL-equivalent for everything but the trace
                 obs_trace.flush()
+                # r16 flight recorder: the dying process serializes its
+                # black-box bundle (all-thread stacks, open spans, ring
+                # tails) BEFORE the exit — the one capture window no
+                # heartbeat-shipped plane can reach (never raises)
+                from dt_tpu.obs import blackbox as obs_blackbox
+                obs_blackbox.write_bundle(
+                    f"crash.{site}", host=host, fatal=True,
+                    extra={"site": site, "action": "exit",
+                           **{k: v for k, v in ctx.items()
+                              if k in ("epoch", "step")}})
                 os._exit(137)  # SIGKILL-equivalent: no cleanup, no goodbye
             raise CrashInjected(
                 f"fault injection: crash at {site} (host={host}, {ctx})")
@@ -468,6 +507,18 @@ def delay_point(site: str, host: Optional[str] = None,
     if plan is None:
         return 0.0
     return plan.delay_at(site, host=host, scale=scale)
+
+
+def stall_point(site: str, host: Optional[str] = None) -> None:
+    """Named stall hook (site-scoped ``stall`` rules, r16): a no-op
+    unless an active plan has a matching rule — in which case this call
+    NEVER RETURNS (the thread blocks in :meth:`FaultPlan.stall_at`
+    forever).  The fit loop hooks ``worker.step`` so the blackbox hang
+    watchdog's detection/blame path can be *caused* deterministically
+    (``chaos_run --plan hang``)."""
+    plan = active_plan()
+    if plan is not None:
+        plan.stall_at(site, host=host)
 
 
 def nan_point(site: str, host: Optional[str] = None, **ctx: Any) -> int:

@@ -481,47 +481,59 @@ def _request_once(host: str, port: int, msg: Dict[str, Any],
     # the server opens a handler span linked to this exact wire.request
     # record (the export joins the two with chrome flow events).  The
     # disabled path builds neither: begin() returns None without
-    # allocating, and the message ships byte-identical to r9.
-    t0 = obs_trace.tracer().begin() \
+    # allocating, and the message ships byte-identical to r9.  (With
+    # only the r16 blackbox open-span hook armed, begin() returns an
+    # open-table-only token — the attempt shows in a crash bundle — but
+    # no trace context rides the wire: the message still ships
+    # byte-identical.)
+    tr = obs_trace.tracer()
+    t0 = tr.begin("wire.request", {"cmd": msg.get("cmd")}) \
         if msg.get("cmd") != "obs_push" else None
-    if t0 is not None:
+    if t0 is not None and tr.on():
         msg = dict(msg)
         msg["_tc"] = (obs_trace.origin(), t0[2])
-    addr = (host, port)
-    sock, reused = _POOL.acquire(addr, timeout)
     try:
-        sock.settimeout(timeout)
-        send_msg(sock, msg)
-    except Exception as e:
-        _POOL.discard(sock)
-        if not (reused and isinstance(e, OSError)):
-            raise
-        # the pooled channel died under the SEND: the request cannot
-        # have been dispatched, so one transparent retry on a fresh
-        # connection is safe (no replay window opens)
-        sock, reused = _POOL.acquire(addr, timeout, fresh=True)
+        addr = (host, port)
+        sock, reused = _POOL.acquire(addr, timeout)
         try:
             sock.settimeout(timeout)
             send_msg(sock, msg)
+        except Exception as e:
+            _POOL.discard(sock)
+            if not (reused and isinstance(e, OSError)):
+                raise
+            # the pooled channel died under the SEND: the request cannot
+            # have been dispatched, so one transparent retry on a fresh
+            # connection is safe (no replay window opens)
+            sock, reused = _POOL.acquire(addr, timeout, fresh=True)
+            try:
+                sock.settimeout(timeout)
+                send_msg(sock, msg)
+            except Exception:
+                _POOL.discard(sock)
+                raise
+        if reset:
+            # injected fault: the request was DELIVERED but the
+            # connection dies before the response — the replay window
+            # only idempotency closes.  The channel is destroyed, NOT
+            # returned to the pool (the server's pending response would
+            # desync the next request on it).
+            _POOL.discard(sock)
+            raise ConnectionResetError(
+                "fault injection: connection reset after send")
+        try:
+            resp = recv_msg(sock)
         except Exception:
+            # response-phase failure: the server may have acted — never
+            # transparently retried; the reliable-mode loop + idempotency
+            # tokens own this window
             _POOL.discard(sock)
             raise
-    if reset:
-        # injected fault: the request was DELIVERED but the
-        # connection dies before the response — the replay window
-        # only idempotency closes.  The channel is destroyed, NOT
-        # returned to the pool (the server's pending response would
-        # desync the next request on it).
-        _POOL.discard(sock)
-        raise ConnectionResetError(
-            "fault injection: connection reset after send")
-    try:
-        resp = recv_msg(sock)
-    except Exception:
-        # response-phase failure: the server may have acted — never
-        # transparently retried; the reliable-mode loop + idempotency
-        # tokens own this window
-        _POOL.discard(sock)
+    except BaseException:
+        # no span is recorded for a failed attempt (the r13 symmetry the
+        # causal check counts on) — but the open-table entry must go, or
+        # a later blackbox bundle would show phantom in-flight requests
+        obs_trace.tracer().abandon(t0)
         raise
     _POOL.release(addr, sock)
     obs_trace.tracer().complete_span(
@@ -544,10 +556,17 @@ def traced_handle(tracer, msg: Dict[str, Any], inner):
     ``dataplane.allreduce``) folds into the span's attrs and is
     stripped from the wire response."""
     tc = msg.get("_tc") if tracer.on() else None
-    t0 = tracer.begin() if tc is not None else None
-    resp = inner(msg)
+    t0 = tracer.begin(f"rpc.{msg.get('cmd')}") if tc is not None else None
+    try:
+        resp = inner(msg)
+    except BaseException:
+        # a raising handler records no span — drop the open-table entry
+        # so a later blackbox bundle doesn't show phantom in-flight work
+        tracer.abandon(t0)
+        raise
     srv = resp.pop("_srv", None) if isinstance(resp, dict) else None
     if resp is None or t0 is None:
+        tracer.abandon(t0)  # dropped response: no span, no open entry
         return resp
     attrs = {"cmd": msg.get("cmd"), "link": list(tc)}
     if isinstance(srv, dict):

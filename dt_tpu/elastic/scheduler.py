@@ -63,6 +63,7 @@ from dt_tpu import config
 from dt_tpu import policy as policy_lib
 from dt_tpu.elastic import faults, journal, protocol
 from dt_tpu.elastic.dataplane import DataPlane
+from dt_tpu.obs import blackbox as obs_blackbox
 from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 
@@ -77,14 +78,14 @@ _TOKEN_EXEMPT = frozenset({"fetch_snapshot", "allreduce", "async_init",
                            "async_push", "async_pull_rows", "async_stats",
                            "heartbeat", "num_dead", "membership",
                            "servers", "obs_push", "obs_dump", "ha_round",
-                           "status", "health"})
+                           "status", "health", "blackbox_index"})
 
 #: commands a PASSIVE instance (warm standby / fenced ex-leader) still
 #: serves: round replication from the live primary, obs ingest/export,
 #: health introspection, and shutdown — everything else is refused with
 #: ``not_leader`` so clients rotate to the real leader
 _PASSIVE_CMDS = frozenset({"ha_round", "obs_push", "obs_dump", "status",
-                           "health", "shutdown"})
+                           "health", "blackbox_index", "shutdown"})
 
 #: bound on retained (host, incarnation) obs tracks — LRU-evicted so a
 #: job with heavy restart churn can't grow scheduler memory unboundedly
@@ -321,6 +322,19 @@ class Scheduler:
         self._evict_thread: Optional[threading.Thread] = None
         self._lease_thread: Optional[threading.Thread] = None
         self._monitor_thread: Optional[threading.Thread] = None
+        # r16 flight recorder (dt_tpu/obs/blackbox.py): the fleet-hang
+        # detector ages pending allreduce rounds and cross-blames the
+        # worker everyone is waiting on; blackbox_index serves the
+        # bundle manifest.  The state provider stamps every bundle this
+        # process writes with the live control state.
+        self._bb_lock = threading.Lock()
+        self._bb_suspect: Optional[dict] = None  # guarded-by: _bb_lock
+        self._bb_thread: Optional[threading.Thread] = None
+        # the ACTIVE instance owns the "scheduler" provider slot — a
+        # same-process warm standby must not clobber the live primary's
+        # state in its bundles; a standby registers at takeover
+        if obs_blackbox.enabled() and not standby:
+            obs_blackbox.register_state("scheduler", self._bb_state)
         if standby:
             self._monitor_thread = threading.Thread(
                 target=self._monitor_loop, daemon=True)
@@ -336,6 +350,7 @@ class Scheduler:
                 self._start_lease_thread()
             if auto_evict_dead_s:
                 self._start_evict_thread()
+            self._start_hang_thread()
             logger.info("scheduler listening on :%d (incarnation %d), "
                         "base workers %s", self.port, self._incarnation,
                         self._state.workers)
@@ -419,6 +434,109 @@ class Scheduler:
             target=self._lease_loop, daemon=True)
         self._lease_thread.start()
 
+    # ------------------------------------------------------------------
+    # r16 fleet-hang detector (dt_tpu/obs/blackbox.py)
+    # ------------------------------------------------------------------
+
+    def _start_hang_thread(self) -> None:
+        if not obs_blackbox.enabled() or self._bb_thread is not None:
+            return
+        self._bb_thread = threading.Thread(target=self._hang_loop,
+                                           daemon=True,
+                                           name="dt-sched-hang")
+        self._bb_thread.start()
+
+    def _hang_loop(self) -> None:
+        period = max(min(obs_blackbox.hang_s() / 4.0, 5.0), 0.05)
+        while not self._stop.wait(period):
+            if not self._active.is_set():
+                continue
+            try:
+                self._hang_tick()
+            except Exception:  # noqa: BLE001 — the detector must not die
+                logger.exception("fleet-hang detector pass failed")
+
+    def _hang_tick(self, hang_seconds: Optional[float] = None
+                   ) -> Optional[dict]:
+        """One fleet-progress check: when the oldest pending allreduce
+        round has aged past ``DT_HANG_S``, cross-blame the worker the
+        fleet is waiting on (worst straggler EWMA among the missing
+        contributors — the workers that DID contribute all look hung
+        too, but they are victims) and edge-trigger ``hang.suspect`` +
+        one live scheduler-side bundle.  Round completion (or the next
+        stall-free pass) edge-triggers ``hang.clear``.  Returns the
+        current suspect view (tests drive this directly)."""
+        threshold = float(hang_seconds if hang_seconds is not None
+                          else obs_blackbox.hang_s())
+        stalled = [p for p in self._dp.pending_rounds()
+                   if p["age_s"] is not None and p["age_s"] > threshold
+                   and p["waiting"]]
+        fired = None
+        cleared = False
+        with self._bb_lock:
+            was = self._bb_suspect
+            if stalled:
+                oldest = max(stalled, key=lambda p: p["age_s"])
+                scores = self._dp.straggler_scores()
+                blamed = max(oldest["waiting"],
+                             key=lambda h: scores.get(h, 0.0))
+                cur = {"round": oldest["key"],
+                       "age_s": oldest["age_s"],
+                       "waiting": oldest["waiting"],
+                       "contributed": oldest["contributed"],
+                       "blamed": blamed,
+                       "straggler_ms": round(scores.get(blamed, 0.0), 3)}
+                if was is None:
+                    self._bb_suspect = cur
+                    fired = cur
+                else:
+                    was.update(cur)  # refresh age/blame, no re-fire
+            elif was is not None:
+                self._bb_suspect = None
+                cleared = True
+        if fired is not None:
+            self._obs.event("hang.suspect", dict(fired))
+            obs_blackbox.note("hang.suspect", role="scheduler", **fired)
+            obs_blackbox.write_bundle("hang", host="scheduler",
+                                      fatal=False, extra=dict(fired),
+                                      tracer=self._obs)
+        if cleared:
+            self._obs.event("hang.clear", {"role": "scheduler"})
+            obs_blackbox.note("hang.clear", role="scheduler")
+        with self._bb_lock:
+            return dict(self._bb_suspect) if self._bb_suspect else None
+
+    def _bb_state(self) -> dict:
+        """Blackbox state provider: the control state every bundle this
+        process writes should carry (forensics must not need the
+        journal to say who was in the job)."""
+        out = {"role": "scheduler", "incarnation": self._incarnation,
+               "active": self._active.is_set(), "port": self.port}
+        # bounded acquire, not `with`: a bundle written from a signal
+        # handler must not deadlock on a lock the dying thread holds —
+        # the lock IS held inside the branch (DT006 can't see the
+        # timeout-acquire form)
+        if self._lock.acquire(timeout=0.5):
+            try:
+                out["workers"] = list(self._state.workers)  # dtlint: ignore[DT006]
+                out["last_completed_epoch"] = \
+                    self._state.last_completed_epoch  # dtlint: ignore[DT006]
+                out["pending_recovery"] = \
+                    sorted(self._state.pending_recovery)  # dtlint: ignore[DT006]
+            finally:
+                self._lock.release()
+        if self._slo is not None:
+            try:
+                slo = self._slo.state()
+                out["slo_active"] = slo["active"]
+                out["slo_history"] = slo["history"][-8:]
+            except Exception:  # noqa: BLE001 — best-effort forensics
+                pass
+        with self._bb_lock:
+            if self._bb_suspect:
+                out["hang_suspect"] = dict(self._bb_suspect)
+        return out
+
     def _lease_loop(self):
         """Leader-side lease heartbeat; losing the lease to a newer
         incarnation demotes this instance (it stops serving writes —
@@ -499,6 +617,12 @@ class Scheduler:
                 self._start_evict_thread()
             if self._lease is not None:
                 self._start_lease_thread()
+            self._start_hang_thread()
+            if obs_blackbox.enabled():
+                # the new leader takes the provider slot: its bundles
+                # (and any other process state dump) now stamp the LIVE
+                # control state, not the deposed primary's
+                obs_blackbox.register_state("scheduler", self._bb_state)
             self._obs.complete_span(
                 "scheduler.failover", t0,
                 {"incarnation": inc, "reason": reason,
@@ -937,9 +1061,12 @@ class Scheduler:
                 pass
         me = threading.current_thread()
         for t in (self._evict_thread, self._monitor_thread,
-                  self._lease_thread, self._thread):
+                  self._lease_thread, self._bb_thread, self._thread):
             if t is not None and t is not me and t.is_alive():
                 t.join(timeout=5.0)
+        # identity-guarded: closing a deposed/standby instance must not
+        # strip the still-running leader's provider (same-process HA pair)
+        obs_blackbox.unregister_state("scheduler", fn=self._bb_state)
         if self._hm_sampler is not None:
             self._hm_sampler.stop()
         if self._http is not None:
@@ -993,6 +1120,17 @@ class Scheduler:
             return {"health": self.health_view()}
         if cmd == "ha_round":
             return self._ha_round(msg)
+        if cmd == "blackbox_index":
+            # r16 flight recorder: the collected bundle manifest + the
+            # fleet-hang suspect view (dtop and the chaos harness read
+            # blame from here; the bundles themselves stay on disk)
+            with self._bb_lock:
+                suspect = dict(self._bb_suspect) \
+                    if self._bb_suspect else None
+            return {"enabled": obs_blackbox.enabled(),
+                    "dir": obs_blackbox.bundle_dir(),
+                    "bundles": obs_blackbox.read_manifest(),
+                    "suspect": suspect}
         if cmd == "status":
             with self._lock:
                 out = {"active": self._active.is_set(),

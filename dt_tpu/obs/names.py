@@ -131,6 +131,19 @@ NAME_REGISTRY: Mapping[str, Tuple[str, str]] = {
                                "carry rule, blamed worker, value, "
                                "threshold)"),
     "health.clear": ("event", "a breaching SLO rule recovered"),
+    # -- flight recorder / hang forensics (obs/blackbox.py, r16) -----------
+    "blackbox.bundle": ("event", "a crash/hang bundle was written to "
+                                 "DT_BLACKBOX_DIR (attrs: trigger, file, "
+                                 "fatal)"),
+    "blackbox.bundles": ("counter", "flight-recorder bundles written by "
+                                    "this process"),
+    "hang.suspect": ("event", "edge-triggered: step/fleet progress "
+                              "stalled past DT_HANG_S (worker watchdog "
+                              "or scheduler fleet detector; attrs carry "
+                              "the stall age and — scheduler-side — the "
+                              "blamed worker)"),
+    "hang.clear": ("event", "a suspected hang recovered (progress "
+                            "resumed / the stalled round completed)"),
     # -- fault injection (elastic/faults.py) -------------------------------
     "fault.*": ("event", "every APPLIED fault (fault.<kind>); the chaos "
                          "harness cross-checks these against "

@@ -80,6 +80,22 @@ def set_enabled(on: Optional[bool]) -> None:
         _ENV_ENABLED = None
 
 
+# The r16 flight recorder (dt_tpu/obs/blackbox.py) arms the OPEN-SPAN
+# table alone even when tracing is off — a crash bundle's "died 40 s
+# into allreduce" evidence must not require DT_OBS.  blackbox registers
+# its (cached-bool) enabled() here at import; the hook indirection keeps
+# this module free of the circular import.  With the hook armed, spans
+# enter/leave the open table but record NOTHING in the ring.
+_ARM_OPEN_HOOK: Callable[[], bool] = lambda: False
+
+
+def set_open_span_arm(fn: Optional[Callable[[], bool]]) -> None:
+    """Arm the open-span table independently of the trace gate (the
+    blackbox plane's hook; ``None`` disarms)."""
+    global _ARM_OPEN_HOOK
+    _ARM_OPEN_HOOK = fn or (lambda: False)
+
+
 # ---------------------------------------------------------------------------
 # trace origin (r13 causal tracing): the track name this process's records
 # will appear under in the merged job dump.  WorkerClient sets it to its
@@ -154,6 +170,9 @@ class _NoopSpan:
 
 _NOOP_SPAN = _NoopSpan()
 
+#: bound on the open-span table (leaked begin() tokens shed oldest-first)
+_OPEN_MAX = 256
+
 
 class _Span:
     """A live span; created only when the tracer is enabled."""
@@ -173,14 +192,19 @@ class _Span:
         self._parent = tr._ctx.get()
         self._sid = tr._next_seq()
         self._tok = tr._ctx.set(self._sid)
+        tr._open_add(self._sid, self.name, self._t0w, self._t0m,
+                     self._parent, self.attrs)
         return self
 
     def __exit__(self, *exc):
         tr = self._tr
         tr._ctx.reset(self._tok)
+        tr._open_pop(self._sid)
+        if not tr.on():
+            return False  # open-table-only mode (blackbox armed, DT_OBS=0)
         dur_us = max(tr._mono() - self._t0m, 0) // 1000
         tr._push(("X", None, self.name, self._t0w // 1000, dur_us,
-                  threading.get_ident(), self._sid, self._parent,
+                  tr._ident(), self._sid, self._parent,
                   self.attrs))
         return False
 
@@ -198,21 +222,32 @@ class Tracer:
                  capacity: Optional[int] = None,
                  wall_clock: Optional[Callable[[], int]] = None,
                  mono_clock: Optional[Callable[[], int]] = None,
-                 enabled: Optional[bool] = None):
+                 enabled: Optional[bool] = None,
+                 ident: Optional[Callable[[], int]] = None):
         """``enabled``: ``True``/``False`` pins this instance regardless of
         the process gate; ``None`` follows :func:`enabled`.  Clocks return
-        integer nanoseconds (injectable for deterministic tests)."""
+        integer nanoseconds; ``ident`` returns the recording thread's id
+        (both injectable for deterministic tests — r16 blackbox bundles
+        and their digest-named files must serialize byte-identically
+        under pinned inputs)."""
         self.name = name
         self._cap = max(1, int(capacity if capacity is not None
                                else int(config.env("DT_OBS_RING"))))
         self._wall = wall_clock or time.time_ns
         self._mono = mono_clock or time.monotonic_ns
+        self._ident = ident or threading.get_ident
         self._enabled = enabled
         self._lock = threading.Lock()
         self._records: deque = deque()  # guarded-by: _lock
         self._dropped = 0  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
         self._counters: Dict[str, int] = {}  # guarded-by: _lock
+        # live (entered-but-not-exited) spans, keyed by span id — the
+        # r16 flight-recorder snapshot (blackbox bundles capture "what
+        # was this process in the middle of" at death).  Bounded: a
+        # begin() whose complete_span never runs (exception paths) must
+        # not leak entries forever.
+        self._open: Dict[int, dict] = {}  # guarded-by: _lock
         self._ctx: contextvars.ContextVar = contextvars.ContextVar(
             f"dt_obs_span_{id(self)}", default=None)
 
@@ -242,8 +277,10 @@ class Tracer:
 
     def span(self, name: str, attrs: Optional[dict] = None):
         """Context manager recording a complete ("X") span on exit; the
-        disabled path returns a shared no-op singleton."""
-        if not self.on():
+        disabled path returns a shared no-op singleton.  With only the
+        blackbox open-span hook armed, the span enters/leaves the open
+        table (crash evidence) but records nothing."""
+        if not self.on() and not _ARM_OPEN_HOOK():
             return _NOOP_SPAN
         return _Span(self, name, attrs)
 
@@ -255,15 +292,34 @@ class Tracer:
             return None
         return (self._wall(), self._mono())
 
-    def begin(self) -> Optional[Tuple[int, int, int]]:
+    def begin(self, name: Optional[str] = None,
+              attrs: Optional[dict] = None) -> Optional[Tuple[int, int,
+                                                              int]]:
         """Like :meth:`now`, but also pre-allocates the span's id —
         ``(wall_ns, mono_ns, span_id)`` — so the id can be propagated
         (e.g. over the wire as trace context) BEFORE the span completes.
         ``None`` when tracing is off: the disabled path allocates
-        nothing, exactly like :meth:`now`."""
+        nothing, exactly like :meth:`now`.
+
+        With ``name``, the in-flight span is additionally registered in
+        the open-span table until its :meth:`complete_span` — the r16
+        flight-recorder snapshot (:meth:`open_spans`): a crash bundle
+        can then say "this process died 40 s into ``allreduce``", which
+        the completed-record ring by definition cannot.
+
+        With tracing off but the blackbox open-span hook armed, a NAMED
+        begin still registers (and returns a token so its
+        :meth:`complete_span` pops it) — open-table only, no record;
+        callers gating extra work on the token (e.g. the wire trace
+        context) must also check :meth:`on`."""
         if not self.on():
-            return None
-        return (self._wall(), self._mono(), self._next_seq())
+            if name is None or not _ARM_OPEN_HOOK():
+                return None
+        t0w, t0m = self._wall(), self._mono()
+        sid = self._next_seq()
+        if name is not None:
+            self._open_add(sid, name, t0w, t0m, self._ctx.get(), attrs)
+        return (t0w, t0m, sid)
 
     def complete_span(self, name: str,
                       t0: Optional[Tuple[int, ...]],
@@ -273,20 +329,70 @@ class Tracer:
         would have started).  A :meth:`begin` token's pre-allocated id
         becomes the record's ``span_id`` — the export's cross-process
         flow-join key."""
-        if t0 is None or not self.on():
+        if t0 is None:
             return
+        if len(t0) > 2:
+            self._open_pop(t0[2])
+        if not self.on():
+            return  # open-table-only token (blackbox armed, DT_OBS=0)
         dur_us = max(self._mono() - t0[1], 0) // 1000
         self._push(("X", None, name, t0[0] // 1000, dur_us,
-                    threading.get_ident(),
+                    self._ident(),
                     t0[2] if len(t0) > 2 else None,
                     self._ctx.get(), attrs))
+
+    # -- open-span table (r16 flight recorder, dt_tpu/obs/blackbox.py) ----
+
+    def _open_add(self, sid: int, name: str, t0w: int, t0m: int,
+                  parent: Optional[int],
+                  attrs: Optional[dict]) -> None:
+        with self._lock:
+            if len(self._open) >= _OPEN_MAX:
+                # a leaked begin() (its complete_span skipped by an
+                # exception path) must not grow this forever; shed the
+                # OLDEST entry — the newest opens are the death evidence
+                self._open.pop(next(iter(self._open)))
+            self._open[sid] = {"name": name, "ts_us": t0w // 1000,
+                               "mono_ns": t0m,
+                               "tid": self._ident(),
+                               "parent": parent, "attrs": attrs}
+
+    def _open_pop(self, sid: int) -> None:
+        with self._lock:
+            self._open.pop(sid, None)
+
+    def abandon(self, t0: Optional[Tuple[int, ...]]) -> None:
+        """Discard a named :meth:`begin` token without recording a span
+        — failure paths that will never reach :meth:`complete_span`
+        (e.g. a wire attempt that raised) drop their open-table entry
+        here so a later bundle doesn't show phantom in-flight work."""
+        if t0 is not None and len(t0) > 2:
+            self._open_pop(t0[2])
+
+    def open_spans(self) -> List[dict]:
+        """Snapshot of the spans currently in flight — context-manager
+        spans between ``__enter__``/``__exit__`` and named :meth:`begin`
+        tokens whose :meth:`complete_span` has not run — ordered oldest
+        first, each with its age on the monotonic clock.  This is the
+        blackbox bundle's "open-span stack at death": nested spans
+        reconstruct via ``parent``/``sid``, cross-thread ones via
+        ``tid``."""
+        now_m = self._mono()
+        with self._lock:
+            items = sorted(self._open.items(),
+                           key=lambda kv: (kv[1]["mono_ns"], kv[0]))
+        return [{"sid": sid, "name": e["name"], "ts_us": e["ts_us"],
+                 "age_ms": round(max(now_m - e["mono_ns"], 0) / 1e6, 3),
+                 "tid": e["tid"], "parent": e["parent"],
+                 "attrs": e["attrs"]}
+                for sid, e in items]
 
     def event(self, name: str, attrs: Optional[dict] = None) -> None:
         """Instant ("i") event, attached to the enclosing span if any."""
         if not self.on():
             return
         self._push(("i", None, name, self._wall() // 1000, 0,
-                    threading.get_ident(), None, self._ctx.get(), attrs))
+                    self._ident(), None, self._ctx.get(), attrs))
 
     # -- counters (live even when tracing is off) -------------------------
 

@@ -658,296 +658,330 @@ class Module:
                 params=self._unravel(jnp.asarray(cur)))
 
         from dt_tpu.elastic import faults as faults_lib
+        from dt_tpu.obs import blackbox as bb_lib
         _obs = obs_trace.tracer()  # epoch/step spans (off unless DT_OBS)
-        for epoch in range(begin_epoch, num_epoch):
-            _obs_ep_t0 = _obs.now()
-            # chaos-harness hook: a crash rule pinned to this epoch dies
-            # HERE — exactly the epoch-boundary window the quick-restart
-            # recovery path must survive (elastic/faults.py)
-            faults_lib.crash_point(
-                "module.epoch_begin",
-                host=getattr(getattr(self.kv, "_controller", None),
-                             "host", None),
-                epoch=epoch)
-            # --- membership-change barrier (base_module.py:540-543) ---
-            if elastic_enabled or \
-                    getattr(self.kv, "_controller", None) is not None:
-                from dt_tpu.elastic.client import WorkerRemoved
-                try:
-                    self.kv._membership_change_barrier({"EPOCH_BEGIN": epoch})
-                except WorkerRemoved:
-                    # the reference terminates removed instances
-                    # (launch.py:196-199); exit the fit loop cleanly.
-                    # With a multi-process world the survivors' rebuild
-                    # gathers cross-process ZeRO/FSDP shards — a
-                    # collective this (still-member-of-the-old-world)
-                    # process must attend before leaving, or they hang.
-                    # Matching is guaranteed by the scheduler's
-                    # removals-beat-adds rule (_apply_membership_change
-                    # applies removals and additions in SEPARATE
-                    # barriers), so any removal also changes num_workers
-                    # and survivors take the rebuild branch below.
-                    if self.mesh_manager is not None:
-                        self.mesh_manager.depart(self.state)
-                    logger.info("Epoch[%d] this worker was removed from the "
-                                "job; stopping", epoch)
-                    return eval_metric
-                new_sig = membership_sig()
-                if new_sig != members:
-                    logger.info(
-                        "Epoch[%d] membership changed: %s -> %s",
-                        epoch, members, new_sig)
-                    # the mesh rebuild keys on members/rank only — a
-                    # share-only rebalance (policy seq bump, last slot)
-                    # rebuilds iterators and the grad weight, not the
-                    # distributed world
-                    core_changed = new_sig[:-1] != members[:-1]
-                    members = new_sig
-                    num_workers = self.kv.num_workers
-                    if core_changed and self.mesh_manager is not None:
-                        # rebuild the distributed world + mesh, reshard the
-                        # live state, recompile the steps for the new mesh
-                        self._mesh, self.state = self.mesh_manager.rebuild(
-                            self.state, num_workers, self.kv.rank)
-                        self._build_steps()
-                        self._unravel = None
-                        self._unravel_stats = None
-                    if elastic_data_iterator is not None:
-                        train_data, new_eval = \
-                            elastic_data_iterator.get_data_iterator(self.kv)
-                        if new_eval is not None:
-                            eval_data = new_eval
-                    grad_scale = self._policy_grad_scale(
-                        elastic_data_iterator)
-
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            train_data.reset()
-            # Metric updates run ONE STEP BEHIND: step N+1 is dispatched
-            # before step N's logits are fetched to host, so the device
-            # pipeline never drains for metrics (the async-dispatch analog
-            # of the reference engine's compute/update overlap, SURVEY §3.4).
-            pending = None  # (label_np, n_real, logits_device)
-            # double-buffered input: () = nothing prefetched yet, None =
-            # iterator exhausted, tuple = batch k+1 already placed on
-            # device while step k's sync phase ran (_prefetch_batch)
-            prefetched = ()
-            while True:
-                if prefetched:
-                    batch, data, labels = prefetched
-                elif prefetched is None:
-                    break
-                else:
+        # r16 flight recorder: the per-worker hang watchdog (deadman on
+        # step progress, DT_HANG_S) runs for the whole fit and is torn
+        # down on EVERY exit path; no-op unless DT_BLACKBOX=1
+        _bb_host = getattr(getattr(self.kv, "_controller", None),
+                           "host", None)
+        _bb_dog = bb_lib.Watchdog(host=_bb_host, tracer=_obs) \
+            if bb_lib.enabled() else None
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                # named begin: an epoch the process dies inside shows in
+                # the blackbox bundle's open-span snapshot (r16)
+                _obs_ep_t0 = _obs.begin("epoch")
+                # chaos-harness hook: a crash rule pinned to this epoch dies
+                # HERE — exactly the epoch-boundary window the quick-restart
+                # recovery path must survive (elastic/faults.py)
+                faults_lib.crash_point(
+                    "module.epoch_begin",
+                    host=getattr(getattr(self.kv, "_controller", None),
+                                 "host", None),
+                    epoch=epoch)
+                # --- membership-change barrier (base_module.py:540-543) ---
+                if elastic_enabled or \
+                        getattr(self.kv, "_controller", None) is not None:
+                    from dt_tpu.elastic.client import WorkerRemoved
                     try:
-                        batch = train_data.next()
-                    except StopIteration:
-                        break
-                    data = self._place(batch.data)
-                    labels = self._place(batch.label)
+                        self.kv._membership_change_barrier({"EPOCH_BEGIN": epoch})
+                    except WorkerRemoved:
+                        # the reference terminates removed instances
+                        # (launch.py:196-199); exit the fit loop cleanly.
+                        # With a multi-process world the survivors' rebuild
+                        # gathers cross-process ZeRO/FSDP shards — a
+                        # collective this (still-member-of-the-old-world)
+                        # process must attend before leaving, or they hang.
+                        # Matching is guaranteed by the scheduler's
+                        # removals-beat-adds rule (_apply_membership_change
+                        # applies removals and additions in SEPARATE
+                        # barriers), so any removal also changes num_workers
+                        # and survivors take the rebuild branch below.
+                        if self.mesh_manager is not None:
+                            self.mesh_manager.depart(self.state)
+                        logger.info("Epoch[%d] this worker was removed from the "
+                                    "job; stopping", epoch)
+                        # an epoch we leave without finishing records no
+                        # span — drop its open-table entry so later
+                        # blackbox bundles don't show a phantom forever-
+                        # ageing epoch (r16 abandon contract)
+                        _obs.abandon(_obs_ep_t0)
+                        return eval_metric
+                    new_sig = membership_sig()
+                    if new_sig != members:
+                        logger.info(
+                            "Epoch[%d] membership changed: %s -> %s",
+                            epoch, members, new_sig)
+                        # the mesh rebuild keys on members/rank only — a
+                        # share-only rebalance (policy seq bump, last slot)
+                        # rebuilds iterators and the grad weight, not the
+                        # distributed world
+                        core_changed = new_sig[:-1] != members[:-1]
+                        members = new_sig
+                        num_workers = self.kv.num_workers
+                        if core_changed and self.mesh_manager is not None:
+                            # rebuild the distributed world + mesh, reshard the
+                            # live state, recompile the steps for the new mesh
+                            self._mesh, self.state = self.mesh_manager.rebuild(
+                                self.state, num_workers, self.kv.rank)
+                            self._build_steps()
+                            self._unravel = None
+                            self._unravel_stats = None
+                        if elastic_data_iterator is not None:
+                            train_data, new_eval = \
+                                elastic_data_iterator.get_data_iterator(self.kv)
+                            if new_eval is not None:
+                                eval_data = new_eval
+                        grad_scale = self._policy_grad_scale(
+                            elastic_data_iterator)
+
+                tic = time.time()
+                eval_metric.reset()
+                nbatch = 0
+                train_data.reset()
+                # Metric updates run ONE STEP BEHIND: step N+1 is dispatched
+                # before step N's logits are fetched to host, so the device
+                # pipeline never drains for metrics (the async-dispatch analog
+                # of the reference engine's compute/update overlap, SURVEY §3.4).
+                pending = None  # (label_np, n_real, logits_device)
+                # double-buffered input: () = nothing prefetched yet, None =
+                # iterator exhausted, tuple = batch k+1 already placed on
+                # device while step k's sync phase ran (_prefetch_batch)
                 prefetched = ()
-                # step span: dispatch + host-side sync points of one
-                # batch (device programs run async — this is the control
-                # view, not a kernel timeline; jax.profiler has those)
-                _obs_st_t0 = _obs.now()
-                _mt0 = time.monotonic() if obs_metrics.enabled() else None
-                health = None  # sentinel vector; None when not armed
-                if is_async:
-                    # dist_async step: local grad -> push -> adopt the
-                    # post-update master weights.  No peer barrier; the
-                    # optimizer (and its momentum) runs on the scheduler
-                    # (kvstore_dist_server.h:347 !sync_mode_).  BN stats
-                    # stay worker-local between epoch-end snapshot
-                    # averages, as in the reference's aux-key flow.
-                    self._ensure_unravel()  # None after elastic rebuilds
-                    flat_g, flat_s, loss, logits = self._grad_step(
-                        self.state, data, labels, rng)
-                    prefetched = self._prefetch_batch(train_data)
-                    g_host = np.asarray(jax.device_get(flat_g))
-                    if self._sentinel:
-                        # no post-average apply step exists on this
-                        # path to fuse the check into — guard the PUSH
-                        # instead: a non-finite gradient must never
-                        # reach (and permanently poison) the
-                        # server-side master weights + optimizer slots
-                        nonfinite = int(g_host.size
-                                        - np.isfinite(g_host).sum())
-                        lv = float(np.asarray(loss))
-                        if obs_metrics.enabled():
-                            reg = obs_metrics.registry()
-                            reg.gauge("train.loss", lv)
-                            reg.gauge("train.steps",
-                                      int(self.state.step))
-                        if nonfinite > 0 or not np.isfinite(lv):
-                            step_n = int(self.state.step)
-                            obs_trace.tracer().event(
-                                "health.nonfinite",
-                                {"epoch": epoch, "step": step_n,
-                                 "nonfinite": nonfinite, "loss": lv})
-                            if self._halt:
-                                obs_trace.tracer().event(
-                                    "health.halt",
-                                    {"epoch": epoch, "step": step_n})
-                                self.health_halted = True
-                    if not self.health_halted:
-                        # halted: the push is WITHHELD but control falls
-                        # through to the common step-span/metrics tail —
-                        # the tripping step must not vanish from the
-                        # timeline (the loop breaks there)
-                        new_p = self.kv.push_flat(self.async_key, g_host)
-                        self.state = self.state.replace(
-                            params=self._unravel(jnp.asarray(new_p)),
-                            batch_stats=self._unravel_stats(flat_s)
-                            if self._unravel_stats
-                            else self.state.batch_stats,
-                            step=self.state.step + 1)
-                elif self.sync_mode == "host" and self.kv.num_workers > 1:
-                    ctrl = getattr(self.kv, "_controller", None)
-                    if ctrl is None:
-                        raise RuntimeError(
-                            "sync_mode='host' needs an elastic controller "
-                            "(kv.set_controller) to carry the allreduce")
-                    self._ensure_unravel()
-                    flat_g, flat_s, loss, logits = self._grad_step(
-                        self.state, data, labels, rng)
-                    prefetched = self._prefetch_batch(train_data)
-                    if faults_lib.nan_point("worker.grad",
-                                            host=getattr(ctrl, "host",
-                                                         None)):
-                        # seeded poison (r15 chaos --plan nan): one
-                        # non-finite entry — exactly what the fused
-                        # sentinel exists to catch before the update
-                        flat_g = flat_g.at[0].set(jnp.nan)
-                    if grad_scale != 1.0:
-                        # share-aware pre-weight b_i*W/B (dt_tpu/policy/
-                        # rescale.py): the fleet's plain 1/W average
-                        # becomes the exact fixed-global-batch gradient
-                        # under unequal shares; skipped (bit-identical
-                        # path) when the policy engine is off
-                        flat_g = flat_g * grad_scale
-                    gc = self.kv._gradient_compression
-                    if gc is not None and self._sentinel and \
-                            not bool(jnp.isfinite(flat_g).all()):
-                        # 2-bit quantization LAUNDERS non-finite values
-                        # (NaN fails both threshold comparisons and
-                        # encodes as code 0, lodging in the error-
-                        # feedback residual forever) — the averaged
-                        # gradient the fused post-sync check inspects
-                        # would stay finite and the sentinel would be
-                        # blind.  Ship THIS step raw instead: the
-                        # poisoned average then trips every worker's
-                        # compiled check on the same step, preserving
-                        # the fleet-wide halt invariant.
-                        gc = None
-                    from dt_tpu.training import overlap as overlap_lib
-                    if overlap_lib.enabled(ctrl):
-                        # bucketed D2H -> wire -> H2D pipeline; the
-                        # stats round rides concurrently.  Bit-identical
-                        # to the serial branch below (overlap.py); the
-                        # DT_AR_OVERLAP=0 escape hatch restores it.
-                        avg_g_dev, avg_s = self._overlap_engine().sync(
-                            ctrl, gc, flat_g,
-                            flat_s if self._unravel_stats is not None
-                            else None)
-                        if avg_s is None:
-                            avg_s = np.zeros((0,), np.float32)
-                        health = self._apply_synced(avg_g_dev,
-                                                    jnp.asarray(avg_s))
+                while True:
+                    if prefetched:
+                        batch, data, labels = prefetched
+                    elif prefetched is None:
+                        break
                     else:
-                        if gc is not None:
-                            # quantize ON DEVICE, fetch only the packed
-                            # words (16x fewer boundary bytes; residual
-                            # stays in HBM)
-                            packed = gc.compress_on_device(flat_g)
-                            payload = {"packed":
-                                       np.asarray(jax.device_get(packed)),
-                                       "n": int(flat_g.size),
-                                       "threshold": gc.threshold}
-                        else:
-                            payload = np.asarray(jax.device_get(flat_g))
-                        avg_g = ctrl.allreduce("grads", payload)
-                        if self._unravel_stats is not None:
-                            avg_s = ctrl.allreduce(
-                                "stats", np.asarray(jax.device_get(flat_s)))
-                        else:
-                            avg_s = np.zeros((0,), np.float32)
-                        health = self._apply_synced(jnp.asarray(avg_g),
-                                                    jnp.asarray(avg_s))
-                else:
-                    if self._sentinel:
-                        self.state, loss, logits, health = \
-                            self._train_step(self.state, data, labels,
-                                             rng)
-                    else:
-                        self.state, loss, logits = self._train_step(
+                        try:
+                            batch = train_data.next()
+                        except StopIteration:
+                            break
+                        data = self._place(batch.data)
+                        labels = self._place(batch.label)
+                    prefetched = ()
+                    # r16 chaos hook: a site-scoped stall rule blocks HERE
+                    # forever (--plan hang) — the hang the watchdog below
+                    # must catch; no-op without a matching fault rule
+                    faults_lib.stall_point("worker.step", host=_bb_host)
+                    # step span: dispatch + host-side sync points of one
+                    # batch (device programs run async — this is the control
+                    # view, not a kernel timeline; jax.profiler has those)
+                    _obs_st_t0 = _obs.begin("step")
+                    _mt0 = time.monotonic() if obs_metrics.enabled() else None
+                    health = None  # sentinel vector; None when not armed
+                    if is_async:
+                        # dist_async step: local grad -> push -> adopt the
+                        # post-update master weights.  No peer barrier; the
+                        # optimizer (and its momentum) runs on the scheduler
+                        # (kvstore_dist_server.h:347 !sync_mode_).  BN stats
+                        # stay worker-local between epoch-end snapshot
+                        # averages, as in the reference's aux-key flow.
+                        self._ensure_unravel()  # None after elastic rebuilds
+                        flat_g, flat_s, loss, logits = self._grad_step(
                             self.state, data, labels, rng)
-                    prefetched = self._prefetch_batch(train_data)
-                _obs.complete_span("step", _obs_st_t0, {"epoch": epoch})
-                if _mt0 is not None:
-                    obs_metrics.registry().observe(
-                        "step.ms", (time.monotonic() - _mt0) * 1000.0)
-                if self.health_halted or (
-                        health is not None
-                        and self._health_step(health, loss, epoch)):
-                    break
-                # flush the PREVIOUS step's metric + its callback (its
-                # logits are ready by now; this step already runs on device)
-                if pending is not None:
+                        prefetched = self._prefetch_batch(train_data)
+                        g_host = np.asarray(jax.device_get(flat_g))
+                        if self._sentinel:
+                            # no post-average apply step exists on this
+                            # path to fuse the check into — guard the PUSH
+                            # instead: a non-finite gradient must never
+                            # reach (and permanently poison) the
+                            # server-side master weights + optimizer slots
+                            nonfinite = int(g_host.size
+                                            - np.isfinite(g_host).sum())
+                            lv = float(np.asarray(loss))
+                            if obs_metrics.enabled():
+                                reg = obs_metrics.registry()
+                                reg.gauge("train.loss", lv)
+                                reg.gauge("train.steps",
+                                          int(self.state.step))
+                            if nonfinite > 0 or not np.isfinite(lv):
+                                step_n = int(self.state.step)
+                                obs_trace.tracer().event(
+                                    "health.nonfinite",
+                                    {"epoch": epoch, "step": step_n,
+                                     "nonfinite": nonfinite, "loss": lv})
+                                if self._halt:
+                                    obs_trace.tracer().event(
+                                        "health.halt",
+                                        {"epoch": epoch, "step": step_n})
+                                    self.health_halted = True
+                        if not self.health_halted:
+                            # halted: the push is WITHHELD but control falls
+                            # through to the common step-span/metrics tail —
+                            # the tripping step must not vanish from the
+                            # timeline (the loop breaks there)
+                            new_p = self.kv.push_flat(self.async_key, g_host)
+                            self.state = self.state.replace(
+                                params=self._unravel(jnp.asarray(new_p)),
+                                batch_stats=self._unravel_stats(flat_s)
+                                if self._unravel_stats
+                                else self.state.batch_stats,
+                                step=self.state.step + 1)
+                    elif self.sync_mode == "host" and self.kv.num_workers > 1:
+                        ctrl = getattr(self.kv, "_controller", None)
+                        if ctrl is None:
+                            raise RuntimeError(
+                                "sync_mode='host' needs an elastic controller "
+                                "(kv.set_controller) to carry the allreduce")
+                        self._ensure_unravel()
+                        flat_g, flat_s, loss, logits = self._grad_step(
+                            self.state, data, labels, rng)
+                        prefetched = self._prefetch_batch(train_data)
+                        if faults_lib.nan_point("worker.grad",
+                                                host=getattr(ctrl, "host",
+                                                             None)):
+                            # seeded poison (r15 chaos --plan nan): one
+                            # non-finite entry — exactly what the fused
+                            # sentinel exists to catch before the update
+                            flat_g = flat_g.at[0].set(jnp.nan)
+                        if grad_scale != 1.0:
+                            # share-aware pre-weight b_i*W/B (dt_tpu/policy/
+                            # rescale.py): the fleet's plain 1/W average
+                            # becomes the exact fixed-global-batch gradient
+                            # under unequal shares; skipped (bit-identical
+                            # path) when the policy engine is off
+                            flat_g = flat_g * grad_scale
+                        gc = self.kv._gradient_compression
+                        if gc is not None and self._sentinel and \
+                                not bool(jnp.isfinite(flat_g).all()):
+                            # 2-bit quantization LAUNDERS non-finite values
+                            # (NaN fails both threshold comparisons and
+                            # encodes as code 0, lodging in the error-
+                            # feedback residual forever) — the averaged
+                            # gradient the fused post-sync check inspects
+                            # would stay finite and the sentinel would be
+                            # blind.  Ship THIS step raw instead: the
+                            # poisoned average then trips every worker's
+                            # compiled check on the same step, preserving
+                            # the fleet-wide halt invariant.
+                            gc = None
+                        from dt_tpu.training import overlap as overlap_lib
+                        if overlap_lib.enabled(ctrl):
+                            # bucketed D2H -> wire -> H2D pipeline; the
+                            # stats round rides concurrently.  Bit-identical
+                            # to the serial branch below (overlap.py); the
+                            # DT_AR_OVERLAP=0 escape hatch restores it.
+                            avg_g_dev, avg_s = self._overlap_engine().sync(
+                                ctrl, gc, flat_g,
+                                flat_s if self._unravel_stats is not None
+                                else None)
+                            if avg_s is None:
+                                avg_s = np.zeros((0,), np.float32)
+                            health = self._apply_synced(avg_g_dev,
+                                                        jnp.asarray(avg_s))
+                        else:
+                            if gc is not None:
+                                # quantize ON DEVICE, fetch only the packed
+                                # words (16x fewer boundary bytes; residual
+                                # stays in HBM)
+                                packed = gc.compress_on_device(flat_g)
+                                payload = {"packed":
+                                           np.asarray(jax.device_get(packed)),
+                                           "n": int(flat_g.size),
+                                           "threshold": gc.threshold}
+                            else:
+                                payload = np.asarray(jax.device_get(flat_g))
+                            avg_g = ctrl.allreduce("grads", payload)
+                            if self._unravel_stats is not None:
+                                avg_s = ctrl.allreduce(
+                                    "stats", np.asarray(jax.device_get(flat_s)))
+                            else:
+                                avg_s = np.zeros((0,), np.float32)
+                            health = self._apply_synced(jnp.asarray(avg_g),
+                                                        jnp.asarray(avg_s))
+                    else:
+                        if self._sentinel:
+                            self.state, loss, logits, health = \
+                                self._train_step(self.state, data, labels,
+                                                 rng)
+                        else:
+                            self.state, loss, logits = self._train_step(
+                                self.state, data, labels, rng)
+                        prefetched = self._prefetch_batch(train_data)
+                    _obs.complete_span("step", _obs_st_t0, {"epoch": epoch})
+                    if _bb_dog is not None:
+                        # step progress reached the deadman; nbatch is
+                        # the bundle's "last step seen alive" evidence
+                        _bb_dog.beat(step=nbatch)
+                    if _mt0 is not None:
+                        obs_metrics.registry().observe(
+                            "step.ms", (time.monotonic() - _mt0) * 1000.0)
+                    if self.health_halted or (
+                            health is not None
+                            and self._health_step(health, loss, epoch)):
+                        break
+                    # flush the PREVIOUS step's metric + its callback (its
+                    # logits are ready by now; this step already runs on device)
+                    if pending is not None:
+                        nbatch = self._flush_metric(pending, eval_metric, epoch,
+                                                    nbatch, batch_end_callback)
+                    # pad examples excluded (reference DataBatch.pad semantics)
+                    pending = (np.asarray(batch.label),
+                               batch.data.shape[0] - batch.pad, logits)
+                if pending is not None:  # final step's metric + callback
                     nbatch = self._flush_metric(pending, eval_metric, epoch,
                                                 nbatch, batch_end_callback)
-                # pad examples excluded (reference DataBatch.pad semantics)
-                pending = (np.asarray(batch.label),
-                           batch.data.shape[0] - batch.pad, logits)
-            if pending is not None:  # final step's metric + callback
-                nbatch = self._flush_metric(pending, eval_metric, epoch,
-                                            nbatch, batch_end_callback)
 
-            if self.health_halted:
-                # the clean stop: the compiled step already SKIPPED the
-                # poisoned update, so params/opt-state/step are exactly
-                # the pre-fault prefix on every worker (the averaged
-                # gradient is non-finite fleet-wide, so all workers
-                # halt on the same step — no straggling collectives)
+                if self.health_halted:
+                    # the clean stop: the compiled step already SKIPPED the
+                    # poisoned update, so params/opt-state/step are exactly
+                    # the pre-fault prefix on every worker (the averaged
+                    # gradient is non-finite fleet-wide, so all workers
+                    # halt on the same step — no straggling collectives)
+                    _obs.complete_span("epoch", _obs_ep_t0,
+                                       {"epoch": epoch, "nbatch": nbatch,
+                                        "halted": True})
+                    # r16 flight recorder: a health halt is a crash site —
+                    # the stopping step's rings/stacks are the post-mortem
+                    # evidence (no-op unless DT_BLACKBOX=1)
+                    bb_lib.write_bundle(
+                        "health.halt", host=_bb_host, fatal=False,
+                        extra={"epoch": epoch,
+                               "step": int(self.state.step)})
+                    logger.warning(
+                        "Epoch[%d] training halted by the health sentinel "
+                        "(non-finite gradient; update not applied)", epoch)
+                    break
+
+                if eval_metric.num_inst > 0:  # empty when Speedometer auto_reset
+                    for name, val in eval_metric.get_name_value():
+                        logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
                 _obs.complete_span("epoch", _obs_ep_t0,
-                                   {"epoch": epoch, "nbatch": nbatch,
-                                    "halted": True})
-                logger.warning(
-                    "Epoch[%d] training halted by the health sentinel "
-                    "(non-finite gradient; update not applied)", epoch)
-                break
+                                   {"epoch": epoch, "nbatch": nbatch})
+                logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
 
-            if eval_metric.num_inst > 0:  # empty when Speedometer auto_reset
-                for name, val in eval_metric.get_name_value():
-                    logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            _obs.complete_span("epoch", _obs_ep_t0,
-                               {"epoch": epoch, "nbatch": nbatch})
-            logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+                # --- epoch end: publish snapshot (store_aux_params analog,
+                # base_module.py:601-605) ---
+                self._publish_snapshot()
+                if is_async and self.kv.rank == 0:
+                    try:
+                        st = self.kv.staleness_stats()
+                        logger.info(
+                            "Epoch[%d] dist_async staleness: max %d mean "
+                            "%.2f over %d pushes", epoch,
+                            st["max_staleness"], st["mean_staleness"],
+                            st["measured_pushes"])
+                    except (RuntimeError, OSError, KeyError):
+                        pass  # stats are observability, never fatal
 
-            # --- epoch end: publish snapshot (store_aux_params analog,
-            # base_module.py:601-605) ---
-            self._publish_snapshot()
-            if is_async and self.kv.rank == 0:
-                try:
-                    st = self.kv.staleness_stats()
-                    logger.info(
-                        "Epoch[%d] dist_async staleness: max %d mean "
-                        "%.2f over %d pushes", epoch,
-                        st["max_staleness"], st["mean_staleness"],
-                        st["measured_pushes"])
-                except (RuntimeError, OSError, KeyError):
-                    pass  # stats are observability, never fatal
+                if epoch_end_callback is not None:
+                    for cb in epoch_end_callback:
+                        cb(epoch, self.state, eval_metric)
 
-            if epoch_end_callback is not None:
-                for cb in epoch_end_callback:
-                    cb(epoch, self.state, eval_metric)
+                if eval_data is not None:
+                    res = self.score(eval_data, validation_metric)
+                    for name, val in res:
+                        logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                    if eval_end_callback is not None:
+                        eval_end_callback(epoch, validation_metric)
 
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric)
-                for name, val in res:
-                    logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-                if eval_end_callback is not None:
-                    eval_end_callback(epoch, validation_metric)
-
+        finally:
+            if _bb_dog is not None:
+                _bb_dog.stop()
         return eval_metric
 
     def _apply_synced(self, avg_g, avg_s):

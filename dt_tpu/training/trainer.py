@@ -23,6 +23,7 @@ import optax
 
 import numpy as np
 
+from dt_tpu.obs import blackbox as obs_blackbox
 from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 from dt_tpu.parallel import kvstore as kvstore_lib
@@ -154,6 +155,12 @@ class Trainer:
                 if obs_metrics.halt_enabled():
                     obs_trace.tracer().event("health.halt",
                                              {"surface": "trainer"})
+                    # r16: the halt is a crash site — leave a bundle
+                    # before the exception unwinds (no-op unless armed)
+                    obs_blackbox.write_bundle(
+                        "health.halt", fatal=False,
+                        extra={"surface": "trainer",
+                               "nonfinite": nonfinite})
                     raise obs_metrics.HealthHalt(
                         f"non-finite gradient ({nonfinite} entries); "
                         f"dist_async push withheld (DT_HEALTH_HALT=1)")
@@ -165,7 +172,7 @@ class Trainer:
              ignore_stale_grad: bool = False):
         """Rescale by 1/batch_size, sync, update (reference
         ``Trainer.step``)."""
-        _obs_t0 = obs_trace.tracer().now()
+        _obs_t0 = obs_trace.tracer().begin("trainer.step")
         if self.kv.type == "dist_async":
             try:
                 return self._async_step(grads, 1.0 / batch_size)
@@ -175,9 +182,17 @@ class Trainer:
                 # timeline — it must not vanish from the span record
                 obs_trace.tracer().complete_span(
                     "trainer.step", _obs_t0, {"mode": "dist_async"})
-        if self._step_fn is None:
-            self._build()
-        grads = self.allreduce_grads(grads)
+        try:
+            if self._step_fn is None:
+                self._build()
+            grads = self.allreduce_grads(grads)
+        except BaseException:
+            # an attempt that never reached the update records no span
+            # (pre-existing) — and must drop its open-table entry, or a
+            # retried transport error trails phantom in-flight
+            # trainer.step spans into later blackbox bundles
+            obs_trace.tracer().abandon(_obs_t0)
+            raise
         try:
             if getattr(self, "_sentinel", False):
                 self.params, self.opt_state, health = self._step_fn(
@@ -211,6 +226,10 @@ class Trainer:
         if self._halt:
             obs_trace.tracer().event("health.halt",
                                      {"surface": "trainer"})
+            # r16: bundle before the HealthHalt unwinds to the caller
+            obs_blackbox.write_bundle(
+                "health.halt", fatal=False,
+                extra={"surface": "trainer", "nonfinite": nonfinite})
             raise obs_metrics.HealthHalt(
                 f"non-finite gradient ({nonfinite} entries); update "
                 f"skipped (DT_HEALTH_HALT=1)")

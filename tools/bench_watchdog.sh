@@ -16,6 +16,19 @@
 # round end just snapshots whatever landed.
 cd /root/repo
 export DT_COMPILE_CACHE=/root/repo/.xla_cache
+# r16 flight recorder: every probe/bench/profile attempt runs with the
+# black box armed — a wedge leaves a bundle (thread stacks + rings)
+# under .blackbox/ instead of a bare rc; surface the newest bundle on
+# any failure so the evidence is one copy-paste away
+export DT_BLACKBOX=1
+export DT_BLACKBOX_DIR=/root/repo/.blackbox
+newest_bundle() {
+  b=$(ls -t "$DT_BLACKBOX_DIR"/bb-*.json 2>/dev/null | head -1)
+  if [ -n "$b" ]; then
+    echo "[watchdog $(date +%T)] newest blackbox bundle: $b" >&2
+    echo "[watchdog $(date +%T)] render: python tools/dtop.py --postmortem $b" >&2
+  fi
+}
 n=0
 while true; do
   n=$((n+1))
@@ -25,15 +38,18 @@ while true; do
     break
   fi
   echo "[watchdog $(date +%T)] probe failed cleanly; retry in 300s" >&2
+  newest_bundle
   sleep 300
 done
-DT_BENCH_TIMEOUT_S=${DT_BENCH_TIMEOUT_S:-5400} python bench.py
+DT_BENCH_TIMEOUT_S=${DT_BENCH_TIMEOUT_S:-5400} python bench.py \
+  || newest_bundle
 echo "[watchdog $(date +%T)] main bench done; extra tiers" >&2
 DT_BENCH_MODEL=inception_v3 DT_BENCH_IMAGE=299 DT_BENCH_BATCH=32 \
-  python bench.py --run || true
-DT_BENCH_MODEL=alexnet DT_BENCH_BATCH=512 python bench.py --run || true
+  python bench.py --run || newest_bundle
+DT_BENCH_MODEL=alexnet DT_BENCH_BATCH=512 python bench.py --run \
+  || newest_bundle
 echo "[watchdog $(date +%T)] profiling resnet152 step" >&2
-python tools/profile_step.py || true
+python tools/profile_step.py || newest_bundle
 echo "[watchdog $(date +%T)] memcost on TPU (remat rows need the chip)" >&2
 python tools/memcost.py || true
 echo "[watchdog $(date +%T)] pallas kernel re-timing" >&2

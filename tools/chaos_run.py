@@ -74,6 +74,21 @@ override; the seeded w1 delay must surface as an SLO breach blaming w1
 — in agreement with the PR 8 critical-path blame and the PR 9 policy
 decision log.
 
+**Hang plan (r16 flight recorder, dt_tpu/obs/blackbox.py):** every plan
+now runs with the black box armed (``DT_BLACKBOX=1``, bundles under
+``<workdir>/blackbox``), and ``--plan hang`` injects the failure mode
+the recorder exists for: a site-scoped ``stall`` rule blocks w1's step
+loop FOREVER at its 9th step (``worker.step`` hook).  Nobody exits —
+the gates are entirely on captured evidence: w1's per-worker watchdog
+dumps a live bundle within ``DT_HANG_S`` (+slack) whose thread stacks
+name the stalled frame (``stall_at``), the scheduler's fleet-progress
+detector cross-blames w1 (the worker the pending allreduce round is
+waiting on — the workers that contributed look equally hung but are
+victims) through the ``blackbox_index`` RPC, and ``dtop --postmortem``
+renders the report from the bundle dir alone.  The crash-bearing plans
+(``default``, ``scheduler_kill*``, ``nan``) additionally assert a
+schema-complete bundle per killed/halted process.
+
 Usage::
 
     python tools/chaos_run.py --seed 0 --plan default
@@ -81,6 +96,7 @@ Usage::
     python tools/chaos_run.py --plan scheduler_kill   # HA failover drill
     python tools/chaos_run.py --plan straggler     # policy-engine drill
     python tools/chaos_run.py --plan nan           # health-sentinel drill
+    python tools/chaos_run.py --plan hang          # flight-recorder drill
 
 Prints one JSON summary line and exits non-zero on any failed check.
 """
@@ -117,6 +133,16 @@ POLICY_ENV = {"DT_POLICY": "1", "DT_POLICY_STRAGGLER_MS": "50",
 #: worker's sentinel must trip on global step 20 and the halted fleet's
 #: final_step is exactly this pre-fault prefix
 NAN_AFTER = 20
+#: r16 hang plan: w1's 9th step-loop entry blocks FOREVER at the
+#: worker.step stall site (elastic/faults.py stall_at); the per-worker
+#: watchdog must dump a live bundle within DT_HANG_S (+slack) and the
+#: scheduler's fleet detector must cross-blame w1 — the worker the
+#: pending allreduce round is actually waiting on
+HANG_AFTER = 8
+HANG_S = 2.0
+#: slack on the watchdog's reported stall age: poll period (hang_s/4)
+#: plus CPU scheduling noise on a loaded box
+HANG_SLACK_S = 3.0
 #: r15 health plane: metrics on, with the round_wait SLO threshold
 #: lowered to the straggler probe's scale through the declarative
 #: DT_SLO_RULES override (docs/observability.md)
@@ -195,6 +221,13 @@ def _plans(num_epoch):
         "nan": ([FaultRule("nan", site="worker.grad",
                            host=STRAGGLE_HOST, after=NAN_AFTER,
                            times=1)], []),
+        # the r16 flight-recorder drill: w1 blocks FOREVER mid-epoch;
+        # nobody exits — the gates are on the bundles the watchdog
+        # writes and the blame the scheduler's fleet detector serves
+        # (clean transport otherwise: the fault under test is the hang)
+        "hang": ([FaultRule("stall", site="worker.step",
+                            host=STRAGGLE_HOST, after=HANG_AFTER,
+                            times=1)], []),
     }
     # scheduler-kill plans: clean worker transport (the fault under test
     # is the CONTROL PLANE dying, and bit-identity vs --plan none is an
@@ -230,12 +263,103 @@ def _spawn(port, host, out, num_epoch, plan_json, recovery=False,
             env=env, stdout=log, stderr=subprocess.STDOUT)
 
 
+def _hang_checks(args, sched, procs, bb_dir, checks):
+    """The ``--plan hang`` gate set: nobody exits (the stalled worker
+    blocks forever) — the evidence is the bundles.  Polls until (a) the
+    stalled worker's OWN watchdog bundle landed, (b) the scheduler's
+    fleet detector blamed the right worker through ``blackbox_index``,
+    and (c) the scheduler-side hang bundle landed; then verifies bundle
+    schema, watchdog latency, and that the thread stacks name the
+    stalled site.  The caller's ``finally`` reaps the fleet."""
+    del procs  # reaped by the caller's finally; nobody exits by design
+    from dt_tpu.elastic import protocol
+    from dt_tpu.obs import blackbox as obs_blackbox
+
+    def _names_site(b):
+        # stall_at sits in the captured thread stacks AND the flight
+        # ring recorded the fault.stall note with the site
+        frames = [f for t in b.get("threads", [])
+                  for f in t.get("frames", [])]
+        return (any(f[2] == "stall_at" for f in frames)
+                and any(kind == "fault.stall"
+                        and a.get("site") == "worker.step"
+                        for _, kind, a in b.get("flight_ring", [])))
+
+    deadline = time.time() + min(args.timeout_s, 240.0)
+    bundle_row = bundle = suspect = sched_row = None
+    while time.time() < deadline:
+        rows = [r for r in obs_blackbox.read_manifest(bb_dir)
+                if r.get("kind") == "bundle"
+                and r.get("trigger") == "hang"]
+        if bundle_row is None:
+            # the stalled worker's FIRST hang bundle may predate the
+            # injected stall (JIT compile alone can out-stall DT_HANG_S
+            # — a genuine detection, the wedged-init case); the gate
+            # wants the bundle that captured the injected site
+            for r in rows:
+                if r.get("host") != STRAGGLE_HOST:
+                    continue
+                try:
+                    b = json.load(open(os.path.join(bb_dir, r["file"])))
+                except (OSError, ValueError):
+                    continue
+                if _names_site(b):
+                    bundle_row, bundle = r, b
+                    break
+        if sched_row is None:
+            sched_row = next((r for r in rows
+                              if r.get("pid") == os.getpid()), None)
+        if suspect is None:
+            resp = protocol.request("127.0.0.1", sched.port,
+                                    {"cmd": "blackbox_index"},
+                                    timeout=10)
+            suspect = resp.get("suspect") or None
+        if bundle_row and suspect and sched_row:
+            break
+        time.sleep(0.25)
+    checks["hang_bundle_written"] = bundle_row is not None
+    checks["sched_hang_bundle_written"] = sched_row is not None
+    # the fleet detector blames the worker the round is WAITING on —
+    # not the victims that contributed and look equally hung
+    checks["sched_blames_straggler"] = bool(suspect) and \
+        suspect.get("blamed") == STRAGGLE_HOST and \
+        STRAGGLE_HOST in (suspect.get("waiting") or [])
+    if bundle is not None:
+        checks["hang_bundle_schema"] = \
+            obs_blackbox.validate_bundle(bundle) == []
+        # the watchdog fired within DT_HANG_S + poll/sched slack of the
+        # last beat, not after some unbounded delay
+        checks["hang_watchdog_latency"] = (
+            float(bundle.get("extra", {}).get("stalled_s", 1e9))
+            <= HANG_S + HANG_SLACK_S)
+        checks["hang_bundle_names_site"] = _names_site(bundle)
+    else:
+        checks["hang_bundle_schema"] = False
+        checks["hang_watchdog_latency"] = False
+        checks["hang_bundle_names_site"] = False
+    r = subprocess.run([sys.executable, os.path.join(HERE, "dtop.py"),
+                        "--postmortem", bb_dir],
+                       capture_output=True, text=True, timeout=120)
+    checks["postmortem_renders"] = r.returncode == 0 and \
+        "post-mortem" in r.stdout
+    ok = bool(checks) and all(checks.values())
+    print(json.dumps({
+        "ok": ok, "plan": "hang", "seed": args.seed, "checks": checks,
+        "suspect": suspect,
+        "hang_bundle": bundle_row.get("file") if bundle_row else None,
+        "watchdog_stalled_s":
+            bundle.get("extra", {}).get("stalled_s") if bundle else None,
+        "blackbox_dir": bb_dir,
+        "workdir": os.path.dirname(bb_dir)}))
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--plan", default="default",
                     choices=["default", "noise", "crash-only", "none",
-                             "straggler", "nan"]
+                             "straggler", "nan", "hang"]
                     + sorted(SCHED_KILL_SITES))
     ap.add_argument("--num-epoch", type=int, default=8)
     ap.add_argument("--timeout-s", type=float, default=1200.0)
@@ -265,6 +389,20 @@ def main():
     ha_plan = args.plan in SCHED_KILL_SITES
     policy_plan = args.plan == "straggler"
     nan_plan = args.plan == "nan"
+    hang_plan = args.plan == "hang"
+    # r16 flight recorder: EVERY plan runs with the black box armed
+    # (default-on in chaos, per docs/observability.md) — crash-bearing
+    # plans then gate that each killed/halted process left a complete
+    # bundle.  Armed BEFORE any dt_tpu import so in-process gates and
+    # worker env (inherited via _spawn) agree.
+    tmp = tempfile.mkdtemp(prefix="chaos_run_")
+    bb_dir = os.path.join(tmp, "blackbox")
+    os.environ["DT_BLACKBOX"] = "1"
+    os.environ["DT_BLACKBOX_DIR"] = bb_dir
+    if hang_plan:
+        # the watchdog threshold the gates are measured against; the
+        # in-process scheduler's fleet detector reads the same knob
+        os.environ["DT_HANG_S"] = str(HANG_S)
     if policy_plan:
         # arm the policy engine BEFORE the in-process scheduler is built;
         # workers inherit through _spawn's env copy
@@ -287,6 +425,7 @@ def main():
 
     from dt_tpu.elastic import Scheduler, faults
     from dt_tpu.elastic.faults import FaultPlan, FaultRule
+    from dt_tpu.obs import blackbox as obs_blackbox
 
     worker_rules, sched_rules = _plans(args.num_epoch)[args.plan]
     worker_plan = FaultPlan(worker_rules, seed=args.seed)
@@ -298,7 +437,6 @@ def main():
     sched_plan = faults.install(FaultPlan(sched_rules, seed=args.seed)) \
         if sched_rules else None
 
-    tmp = tempfile.mkdtemp(prefix="chaos_run_")
     hw = os.path.join(tmp, "host_worker")
     # straggler plan: the probe host joins as an ELASTIC worker (not in
     # the base line-set) so the policy engine may evict it — base
@@ -376,6 +514,10 @@ def main():
     deadline = time.time() + args.timeout_s
     checks = {}
     try:
+        if hang_plan:
+            # nobody exits on this plan (w1 blocks forever mid-epoch);
+            # the gates are on bundles + blame — then finally reaps
+            return _hang_checks(args, sched, procs, bb_dir, checks)
         # reap, playing the restart wrapper for the injected crash
         pending = dict(procs)
         while pending and time.time() < deadline:
@@ -696,6 +838,51 @@ def main():
                     and e.get("what") == "breach"
                     and e.get("worker") == STRAGGLE_HOST for e in hist)
 
+        # r16 flight recorder: every crash-bearing plan asserts the
+        # killed/halted processes left COMPLETE bundles (the capture
+        # discipline the wedged-bench zeros never had) and that the
+        # post-mortem renderer works on them with no scheduler
+        bb_rows = [r for r in obs_blackbox.read_manifest(bb_dir)
+                   if r.get("kind") == "bundle"]
+
+        def _bundle_ok(pred):
+            for r in bb_rows:
+                if not pred(r):
+                    continue
+                try:
+                    b = json.load(open(os.path.join(bb_dir, r["file"])))
+                except (OSError, ValueError):
+                    continue
+                if obs_blackbox.validate_bundle(b) == []:
+                    return True
+            return False
+
+        if expect_crash:
+            # the os._exit(137) worker serialized its black box first
+            checks["crash_bundle"] = _bundle_ok(
+                lambda r: str(r.get("trigger", "")).startswith("crash.")
+                and r.get("host") == CRASH_HOST and r.get("fatal"))
+        if ha_plan:
+            # the killed PRIMARY scheduler process left one too
+            checks["sched_crash_bundle"] = _bundle_ok(
+                lambda r: str(r.get("trigger", ""))
+                .startswith("crash.sched")
+                and r.get("pid") == primary_proc.pid)
+        if nan_plan:
+            # every cleanly-halted worker left a health.halt bundle
+            checks["halt_bundles"] = all(
+                _bundle_ok(lambda r, h=h:
+                           r.get("trigger") == "health.halt"
+                           and r.get("host") == h)
+                for h in HOSTS)
+        if bb_rows:
+            pm = subprocess.run(
+                [sys.executable, os.path.join(HERE, "dtop.py"),
+                 "--postmortem", bb_dir],
+                capture_output=True, text=True, timeout=120)
+            checks["postmortem_renders"] = pm.returncode == 0 and \
+                "post-mortem" in pm.stdout
+
         ok = bool(checks) and all(checks.values())
         print(json.dumps({
             "ok": ok, "plan": args.plan, "seed": args.seed,
@@ -722,6 +909,8 @@ def main():
                 len(summary["membership_changes"]) if summary else None,
             "trace_fault_events":
                 summary["total_fault_events"] if summary else None,
+            "blackbox_bundles": len(bb_rows),
+            "blackbox_dir": bb_dir,
             "workdir": tmp,
         }))
         return 0 if ok else 1

@@ -21,6 +21,8 @@ Usage::
     python tools/dtop.py --scheduler 127.0.0.1:9091 --follow   # live
     python tools/dtop.py /tmp/trace.json --critical-path 3     # one step
     python tools/dtop.py /tmp/trace.json --json   # machine-readable
+    python tools/dtop.py --postmortem .blackbox   # r16 crash report
+    python tools/dtop.py --postmortem .blackbox/bb-...json     # one bundle
 
 ``--follow`` polls ``obs_dump`` every ``--interval`` seconds and
 re-renders a compact live board (step rate since the previous poll,
@@ -281,6 +283,164 @@ def render_critical_step(summary, step: int) -> str:
     return "\n".join(lines)
 
 
+def _iso(ts_ms) -> str:
+    import datetime
+    dt = datetime.datetime.fromtimestamp(int(ts_ms) / 1000.0,
+                                         tz=datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{int(ts_ms) % 1000:03d}Z"
+
+
+def _blamed_frame(frames):
+    """The frame a stalled/dead thread is 'blamed' on: the innermost
+    frame inside this project (``dt_tpu``/``tools``), else the innermost
+    frame outright — the one-line answer to 'where was it stuck'."""
+    for fs in reversed(frames or []):
+        fn = str(fs[0]).replace("\\", "/")
+        if "dt_tpu/" in fn or "/tools/" in fn or fn.startswith("tools/"):
+            return fs
+    return frames[-1] if frames else None
+
+
+def _short_path(fn: str) -> str:
+    fn = str(fn).replace("\\", "/")
+    for anchor in ("dt_tpu/", "tools/", "tests/"):
+        i = fn.find(anchor)
+        if i >= 0:
+            return fn[i:]
+    return fn.rsplit("/", 1)[-1]
+
+
+def load_postmortem(path):
+    """(bundle, manifest_rows, bundle_path) from a bundle file or a
+    ``DT_BLACKBOX_DIR`` (dir: the newest bundle + the full manifest
+    timeline).  jax-free — bundles are the whole input, no scheduler."""
+    from dt_tpu.obs import blackbox
+    if os.path.isdir(path):
+        rows = blackbox.read_manifest(path)
+        brows = [r for r in rows if r.get("kind") == "bundle"
+                 and r.get("file")]
+        if not brows:
+            raise SystemExit(f"no bundle rows in "
+                             f"{blackbox.manifest_path(path)}")
+        newest = max(brows, key=lambda r: r.get("ts_ms", 0))
+        bpath = os.path.join(path, newest["file"])
+        with open(bpath) as f:
+            return json.load(f), rows, bpath
+    with open(path) as f:
+        bundle = json.load(f)
+    rows = blackbox.read_manifest(os.path.dirname(path) or ".")
+    return bundle, rows, path
+
+
+def render_postmortem(bundle, manifest_rows=None, path="") -> str:
+    """The crash report: death timeline, open spans at death, per-thread
+    stacks collapsed to the blamed frame, last SLO breaches, ring-drop
+    accounting — from the bundle alone (the post-mortem the reference
+    never had; its ceiling was scrolling PS_VERBOSE logs)."""
+    lines = []
+    lines.append(f"== dt_tpu post-mortem: {os.path.basename(path)} ==")
+    lines.append(
+        f"trigger={bundle.get('trigger')}  "
+        f"fatal={'yes' if bundle.get('fatal') else 'no'}  "
+        f"host={bundle.get('host') or '-'}  pid={bundle.get('pid')}  "
+        f"at {_iso(bundle.get('ts_ms', 0))}")
+    extra = bundle.get("extra") or {}
+    if extra:
+        lines.append("  " + "  ".join(f"{k}={extra[k]}"
+                                      for k in sorted(extra)))
+    rows = manifest_rows or []
+    if rows:
+        lines.append("")
+        lines.append(f"death timeline (manifest, {len(rows)} row(s)):")
+        for r in sorted(rows, key=lambda r: r.get("ts_ms", 0)):
+            what = r.get("trigger") or r.get("outcome") or r.get("kind")
+            mark = " FATAL" if r.get("fatal") else ""
+            tail = f"  {r.get('file')}" if r.get("file") else ""
+            lines.append(f"  {_iso(r.get('ts_ms', 0))}  "
+                         f"{r.get('host') or '-':<12}pid "
+                         f"{r.get('pid')}  {r.get('kind')}:{what}"
+                         f"{mark}{tail}")
+    spans = bundle.get("open_spans") or []
+    lines.append("")
+    lines.append(f"open spans at death ({len(spans)}):")
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        at = ("  " + "  ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+              ) if attrs else ""
+        lines.append(f"  {s.get('name'):<20}age={s.get('age_ms'):.1f}ms"
+                     f"  tid={s.get('tid')}  sid={s.get('sid')}{at}")
+    threads = bundle.get("threads") or []
+    lines.append("")
+    lines.append(f"threads ({len(threads)}; collapsed to the blamed "
+                 "frame):")
+    for t in threads:
+        blamed = _blamed_frame(t.get("frames"))
+        where = (f"{_short_path(blamed[0])}:{blamed[1]} {blamed[2]}"
+                 if blamed else "(no frames)")
+        d = " daemon" if t.get("daemon") else ""
+        lines.append(f"  {t.get('name'):<28}tid={t.get('tid')}{d}: "
+                     f"{where}")
+        for fs in (t.get("frames") or [])[-4:]:
+            lines.append(f"      {_short_path(fs[0])}:{fs[1]} {fs[2]}")
+    ring = bundle.get("flight_ring") or []
+    if ring:
+        lines.append("")
+        lines.append(f"flight ring (last {min(len(ring), 16)} of "
+                     f"{len(ring)}):")
+        for ts, kind, attrs in ring[-16:]:
+            at = ("  " + "  ".join(f"{k}={attrs[k]}"
+                                   for k in sorted(attrs))) if attrs \
+                else ""
+            lines.append(f"  {_iso(ts)}  {kind}{at}")
+    # last SLO breaches: scheduler-side bundles carry slo_history in
+    # their state; any bundle may hold health.* events in the span ring
+    breaches = []
+    for name, st in sorted((bundle.get("state") or {}).items()):
+        for e in (st or {}).get("slo_history", []):
+            breaches.append((e.get("ts_ms", 0),
+                             f"{e.get('what')} {e.get('rule')} "
+                             f"worker={e.get('worker') or '-'} "
+                             f"value={e.get('value')}"))
+    for rec in (bundle.get("span_ring") or {}).get("records", []):
+        if len(rec) > 8 and rec[2] in ("health.breach", "health.clear"):
+            a = rec[8] or {}
+            breaches.append((rec[3] // 1000,
+                             f"{rec[2].split('.')[1]} {a.get('rule')} "
+                             f"worker={a.get('worker') or '-'} "
+                             f"value={a.get('value')}"))
+    if breaches:
+        lines.append("")
+        lines.append("last SLO breaches:")
+        for ts, desc in sorted(breaches)[-8:]:
+            lines.append(f"  {_iso(ts)}  {desc}")
+    sr = bundle.get("span_ring") or {}
+    mr = bundle.get("metrics_ring") or {}
+    lines.append("")
+    lines.append(
+        f"ring drops: spans={sr.get('dropped', 0)}  "
+        f"metrics={mr.get('dropped', 0)}  "
+        f"span_tail={len(sr.get('records') or [])}  "
+        f"series_tail={len(mr.get('series') or [])}"
+        + ("  TRUNCATED" if bundle.get("truncated") else ""))
+    faults = bundle.get("faults_applied") or []
+    if faults:
+        lines.append("faults applied: " + "  ".join(
+            f"{k}@{h or '-'}x{n}" for k, h, n in faults))
+    # non-default env knobs (the resolved view rides the bundle; the
+    # registry defaults come from config — jax-free)
+    try:
+        from dt_tpu import config as dt_config
+        defaults = {k: v for k, (v, _) in dt_config.ENV_REGISTRY.items()}
+    except Exception:
+        defaults = {}
+    diff = {k: v for k, v in (bundle.get("env") or {}).items()
+            if v != defaults.get(k, "")}
+    if diff:
+        lines.append("env (non-default): " + "  ".join(
+            f"{k}={diff[k]}" for k in sorted(diff)))
+    return "\n".join(lines)
+
+
 def _follow(args) -> int:
     """Live mode: poll the scheduler's ``obs_dump`` and re-render a
     compact board each cycle.  The step RATE is computed from the delta
@@ -335,6 +495,11 @@ def main(argv=None):
                     help="--follow poll period in seconds (default 2)")
     ap.add_argument("--iterations", type=int, default=0,
                     help="stop --follow after N polls (0 = forever)")
+    ap.add_argument("--postmortem", default="", metavar="BUNDLE|DIR",
+                    help="render a crash report from a blackbox bundle "
+                         "file (or the newest bundle in a "
+                         "DT_BLACKBOX_DIR, with the manifest death "
+                         "timeline) — no scheduler needed")
     ap.add_argument("--critical-path", type=int, default=None,
                     metavar="STEP",
                     help="drill into step STEP's critical-path "
@@ -342,6 +507,15 @@ def main(argv=None):
                          "indexes each track's own recorded steps; a "
                          "restarted incarnation recounts from 0)")
     args = ap.parse_args(argv)
+
+    if args.postmortem:
+        bundle, rows, bpath = load_postmortem(args.postmortem)
+        if args.json:
+            print(json.dumps({"bundle": bundle, "manifest": rows},
+                             indent=2, sort_keys=True, default=repr))
+        else:
+            print(render_postmortem(bundle, rows, bpath))
+        return 0
 
     if args.follow:
         if not args.scheduler:

@@ -109,9 +109,21 @@ def main():
     from dt_tpu.config import maybe_force_cpu, enable_compilation_cache
     maybe_force_cpu()
     enable_compilation_cache()
+    # r16 flight recorder: a wedged profile attempt leaves a bundle
+    # (thread stacks pin the blocking call) instead of a bare rc —
+    # no-op unless DT_BLACKBOX=1 (bench_watchdog.sh arms it)
+    from dt_tpu.obs import blackbox
+    blackbox.install(host="profile_step")
+    # beats are per-stage and a healthy resnet152 compile alone runs
+    # minutes: floor the deadman above the training-loop default
+    dog = blackbox.Watchdog(host="profile_step",
+                            hang_seconds=max(blackbox.hang_s(), 1800.0)) \
+        if blackbox.enabled() else None
     import jax
 
     step, state, x, y = build_step(args.model, args.batch, args.size)
+    if dog is not None:
+        dog.beat()  # build+trace armed; compile is next
     state, loss = step(state, x, y)  # compile + warm
     jax.block_until_ready((state, loss))
 
@@ -137,6 +149,8 @@ def main():
     with open(os.path.join(REPO, "PROFILE_r04.json"), "w") as f:
         json.dump(summary, f, indent=1)
     print(json.dumps(summary))
+    if dog is not None:
+        dog.stop()
 
 
 if __name__ == "__main__":

@@ -9,6 +9,15 @@ timestamp so a hang is attributable to the exact blocking call, runs a tiny
 matmul once the backend is up, appends a success marker, and exits 0
 (clean exits release the TPU without wedging).
 
+r16 flight recorder: every attempt now also writes append-only manifest
+rows (start/end, outcome, UNAVAILABLE vs success, stage reached,
+duration) under ``DT_BLACKBOX_DIR`` via ``dt_tpu.obs.blackbox`` — so
+wedge forensics ACCUMULATE across probe attempts (ROADMAP item 5
+capture discipline: the r01-r05 bench zeros left no captured evidence
+at all), and an unhandled probe death leaves a full bundle with thread
+stacks via the installed crash hooks.  ``dtop --postmortem
+$DT_BLACKBOX_DIR`` renders the attempt timeline.
+
 Usage: nohup python tools/tpu_probe.py >> tpu_probe.log 2>&1 &
 NEVER kill this process.
 """
@@ -17,6 +26,21 @@ import faulthandler
 import os
 import sys
 import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# Import dt_tpu.obs WITHOUT executing dt_tpu/__init__.py (which pulls the
+# ops surface and therefore jax — the probe must log BEFORE the jax import
+# that may hang): path-only shim, same trick as tools/dtop.py.
+if "dt_tpu" not in sys.modules:
+    import types
+    _shim = types.ModuleType("dt_tpu")
+    _shim.__path__ = [os.path.join(_ROOT, "dt_tpu")]
+    sys.modules["dt_tpu"] = _shim
+
+from dt_tpu.obs import blackbox  # noqa: E402  (jax-free)
 
 LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir,
                    "tpu_probe.log")
@@ -27,29 +51,63 @@ def log(msg):
     print(line, flush=True)
 
 
+def _row(**kw):
+    """One append-only manifest row (kind="probe"); never raises."""
+    blackbox.manifest_append({"kind": "probe", "ts_ms":
+                              int(time.time() * 1000),
+                              "pid": os.getpid(), "host": "tpu_probe",
+                              **kw})
+
+
 def main():
     # If we DO hang forever, a SIGABRT-free stack dump every 30 min
     # documents the blocking frame for the judge without killing anything.
     faulthandler.dump_traceback_later(1800, repeat=True, file=sys.stderr)
-    log("start pid=%d" % os.getpid())
-    log("importing jax")
-    t0 = time.time()
-    import jax  # noqa: E402
-    import jax.numpy as jnp  # noqa: E402
-    log("jax %s imported in %.1fs" % (jax.__version__, time.time() - t0))
-    log("calling jax.devices() (backend init; this is where a wedged "
-        "tunnel hangs)")
-    t0 = time.time()
-    devs = jax.devices()
-    log("devices in %.1fs: %s" % (time.time() - t0, devs))
-    log("running 1024x1024 bf16 matmul")
-    t0 = time.time()
-    x = jnp.ones((1024, 1024), jnp.bfloat16)
-    y = (x @ x).block_until_ready()
-    log("matmul ok in %.1fs (sum=%s)" % (time.time() - t0,
-                                         float(jnp.sum(y))))
-    log("PROBE OK platform=%s" % devs[0].platform)
-    faulthandler.cancel_dump_traceback_later()
+    # probe deaths leave a full black-box bundle (thread stacks pin the
+    # wedged call), not just a bare rc — arm regardless of the env gate
+    blackbox.set_enabled(True)
+    blackbox.install(host="tpu_probe")
+    t_start = time.time()
+    stage = "start"
+    _row(phase="start", trigger="probe.start")
+    log("start pid=%d (manifest: %s)" % (os.getpid(),
+                                         blackbox.manifest_path()))
+    try:
+        stage = "import"
+        log("importing jax")
+        t0 = time.time()
+        import jax  # noqa: E402
+        import jax.numpy as jnp  # noqa: E402
+        log("jax %s imported in %.1fs" % (jax.__version__,
+                                          time.time() - t0))
+        stage = "backend_init"
+        log("calling jax.devices() (backend init; this is where a wedged "
+            "tunnel hangs)")
+        blackbox.note("probe.stage", stage=stage)
+        t0 = time.time()
+        devs = jax.devices()
+        log("devices in %.1fs: %s" % (time.time() - t0, devs))
+        stage = "matmul"
+        log("running 1024x1024 bf16 matmul")
+        t0 = time.time()
+        x = jnp.ones((1024, 1024), jnp.bfloat16)
+        y = (x @ x).block_until_ready()
+        log("matmul ok in %.1fs (sum=%s)" % (time.time() - t0,
+                                             float(jnp.sum(y))))
+        log("PROBE OK platform=%s" % devs[0].platform)
+        faulthandler.cancel_dump_traceback_later()
+        _row(phase="end", trigger="probe.ok", outcome="success",
+             stage=stage, platform=str(devs[0].platform),
+             duration_s=round(time.time() - t_start, 1))
+    except BaseException as e:  # noqa: BLE001 — classify, record, re-raise
+        # the r4/r5 lesson machine-recorded: a wedged tunnel fails
+        # CLEANLY with UNAVAILABLE after ~25 min — that outcome (vs a
+        # real error) decides whether a retry is safe
+        outcome = "unavailable" if "UNAVAILABLE" in repr(e) else "error"
+        _row(phase="end", trigger="probe.fail", outcome=outcome,
+             stage=stage, error=repr(e)[:300],
+             duration_s=round(time.time() - t_start, 1))
+        raise
 
 
 if __name__ == "__main__":
