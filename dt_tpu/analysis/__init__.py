@@ -15,6 +15,9 @@ are TPU-shaped, so they get a bespoke rule engine instead:
 - DT009 lock-order       — acquisition-graph cycles, blocking under lock
 - DT010 journal-discipline — ControlState mutations ride the WAL path
 - DT011 obs-name-registry — span/event/counter names vs obs.names catalog
+- DT012 wire-contract    — send sites vs handler arms vs PROTOCOL_REGISTRY
+- DT013 retry-discipline — idempotency class vs _TOKEN_EXEMPT vs handlers
+- DT014 replay-determinism — clocks/RNG/set-order on deterministic surfaces
 
 DT008-DT010 (``rules_flow`` over the ``flow`` substrate) are
 flow-sensitive: they track held-lock sets through ``with`` blocks and
@@ -36,13 +39,16 @@ from dt_tpu.analysis.engine import (Baseline, FileContext, Finding,
 
 def all_rules() -> List[Rule]:
     """One fresh instance of every registered rule, id order."""
-    from dt_tpu.analysis import rules_flow, rules_project, rules_tpu
+    from dt_tpu.analysis import (rules_flow, rules_project, rules_proto,
+                                 rules_tpu)
     rules = [rules_tpu.PallasTiling(), rules_tpu.Bf16Downcast(),
              rules_tpu.CpuDonate(), rules_tpu.PartialBlock(),
              rules_project.EnvRegistry(), rules_project.LockDiscipline(),
              rules_project.ParityCitation(),
              rules_project.ObsNameRegistry(), rules_flow.RaceInference(),
-             rules_flow.LockOrder(), rules_flow.JournalDiscipline()]
+             rules_flow.LockOrder(), rules_flow.JournalDiscipline(),
+             rules_proto.WireContract(), rules_proto.RetryDiscipline(),
+             rules_proto.ReplayDeterminism()]
     return sorted(rules, key=lambda r: r.id)
 
 

@@ -1,0 +1,1272 @@
+"""Wire-contract & replay-determinism rules (DT012-DT014, r17).
+
+The reference's control vocabulary was an unchecked C++ enum
+(``ps-lite/include/ps/internal/message.h:123`` ``Control::Command``;
+the elastic fork grew more values in ``elastic_training.cc`` with
+nothing auditing senders against handlers), and its at-least-once
+resender (``ps-lite/src/resender.h``) trusted every handler to be
+replay-safe by convention.  dt_tpu's equivalents — 25+ stringly-typed
+``{"cmd": ...}`` dicts dispatched through ``if cmd == "X"`` chains, and
+byte-determinism contracts (policy ``decision_log_sha256``, export /
+bundle byte-identity, journal replay == live) checked only dynamically
+by the chaos drills — are exactly the drift classes a linter can pin:
+
+- **DT012 wire-contract**: a :class:`ProtocolModel` extracted from every
+  linted file (literal send sites with their field sets and response-key
+  reads; dispatcher arms with their ``msg`` field reads, required vs
+  defaulted, and response dict keys) is cross-checked in both directions
+  against itself, against ``dt_tpu.elastic.commands.PROTOCOL_REGISTRY``,
+  against the ``rpc.<cmd>`` family row in the obs name catalog, and
+  against the generated ``docs/protocol_commands.md`` table.
+- **DT013 retry/idempotency discipline**: the statically-inferred
+  handler behavior (mutates control state? journals via ``_apply``?)
+  must agree with the registry's declared idempotency class and with the
+  ``_TOKEN_EXEMPT`` sets — a mutating no-dedup command slipped into the
+  exemption list is the PR-6 "re-applied async_push gradient" bug,
+  caught before it ships this time.
+- **DT014 replay/byte-determinism discipline**: the declared
+  deterministic surfaces (``ControlState._op_*`` structurally; functions
+  carrying a ``# deterministic: replay|bytes`` marker; the arguments of
+  every journaled ``_apply`` call) must not read wall clocks, draw
+  unseeded RNG/uuid values, iterate sets into ordered output, or
+  ``json.dump`` without ``sort_keys=True``.
+
+Pure stdlib ``ast``, like the rest of the engine; the per-file
+:class:`FileProto` extraction is cached in ``project.data`` the same way
+DT008-DT010 share their ClassModel scan.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from dt_tpu.analysis import flow
+from dt_tpu.analysis.engine import (DEFAULT_PATHS, FileContext, Finding,
+                                    ProjectContext, Rule)
+from dt_tpu.analysis.rules_project import _load_obs_registry
+
+_COMMANDS_RELPATH = "dt_tpu/elastic/commands.py"
+_CATALOG_RELPATH = "docs/protocol_commands.md"
+
+#: message keys owned by the transport, not by any one command's schema:
+#: the envelope cmd itself, the at-least-once idempotency token
+#: (``protocol.request`` reliable mode), and the r13 trace context
+_TRANSPORT_FIELDS = frozenset({"cmd", "token", "_tc"})
+
+#: response keys owned by the dispatch plumbing (error frames,
+#: leadership refusals, the data-plane's span-timing sidecar)
+_TRANSPORT_RESP = frozenset({"error", "incarnation", "_srv"})
+
+#: cross-object method names treated as control/data-state mutations
+#: (the DataPlane hooks the servers call; beyond same-class reach)
+_CROSS_MUTATORS = frozenset({"install_round", "host_registered",
+                             "hosts_removed", "complete_with", "close",
+                             "shutdown", "set", "stop", "put", "clear",
+                             "dispatch"})
+
+_DET_MARKER_RE = re.compile(r"#\s*deterministic:\s*(replay|bytes)\b")
+
+#: callees whose return value is a wire RESPONSE when a message dict is
+#: passed by name (`msg = {...}; resp = self._req(msg)`) — the
+#: reliable-request family plus the generic test/fixture shape
+_REQUEST_CALLEES = frozenset({"request", "_req", "_req_addr",
+                              "_req_failover", "_sched_request", "send",
+                              "send_msg", "call", "rpc"})
+
+
+def _callee_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+_CLOCK_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.strftime",
+    "time.localtime", "time.gmtime", "datetime.now", "datetime.utcnow",
+    "datetime.today", "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today"})
+
+_RNG_ROOTS = frozenset({"random", "uuid", "secrets"})
+
+#: deterministic surfaces the repo PROMISES (chaos gates rest on them);
+#: the named function must carry the marker — deleting the marker (and
+#: with it the checks) is itself a finding
+_EXPECTED_MARKED = {
+    ("dt_tpu/policy/engine.py", "decide", "replay"),
+    ("dt_tpu/obs/export.py", "write", "bytes"),
+    ("dt_tpu/obs/blackbox.py", "_dump", "bytes"),
+    ("dt_tpu/obs/metrics.py", "render_prometheus", "bytes"),
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    """``time.time`` / ``np.random.default_rng`` as a dotted string for
+    Name/Attribute chains; '' when the chain roots elsewhere."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {c: p for p in ast.walk(tree) for c in ast.iter_child_nodes(p)}
+
+
+def _enclosing(node: ast.AST, parents: Dict[ast.AST, ast.AST],
+               kinds) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None and not isinstance(cur, kinds):
+        cur = parents.get(cur)
+    return cur
+
+
+def _self_rooted(node: ast.AST, aliases: Set[str]) -> bool:
+    """True when an Attribute/Subscript chain bottoms out at ``self`` or
+    at a local alias of ``self``-rooted state."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return (isinstance(node, ast.Name) and
+            (node.id == "self" or node.id in aliases))
+
+
+# ---------------------------------------------------------------------------
+# per-file protocol extraction
+# ---------------------------------------------------------------------------
+
+
+class FileProto:
+    """Everything DT012/DT013 need from one source file."""
+
+    def __init__(self) -> None:
+        #: [{cmd, line, fields, open, reads: {key: line}}]
+        self.sends: List[dict] = []
+        #: [{cmd, line, required, optional, resp_keys, resp_open,
+        #:   mutates, calls_apply, delegated}]
+        self.arms: List[dict] = []
+        #: class name -> tuple of cmd strings (``CMDS = (...)`` consts)
+        self.cmds_consts: Dict[str, Tuple[str, ...]] = {}
+        #: _TOKEN_EXEMPT binding: ("literal", set, line) or
+        #: ("derived", role, line); None when the file declares none
+        self.exempt: Optional[tuple] = None
+        #: _PASSIVE_CMDS binding, same shape (role is None for derived)
+        self.passive: Optional[tuple] = None
+
+
+def file_proto(ctx: FileContext, project: ProjectContext) -> FileProto:
+    """The cached per-file model (built once, shared by DT012/DT013 —
+    the ClassModel-cache pattern of ``rules_flow._models_for``)."""
+    cache = project.data.setdefault("proto_files", {})
+    if ctx.path not in cache:
+        fast = ('"cmd"' in ctx.source or "'cmd'" in ctx.source or
+                "_TOKEN_EXEMPT" in ctx.source or "CMDS" in ctx.source)
+        cache[ctx.path] = _extract(ctx) if fast else FileProto()
+    return cache[ctx.path]
+
+
+def _extract(ctx: FileContext) -> FileProto:
+    out = FileProto()
+    tree = ctx.tree
+    parents = _parent_map(tree)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "CMDS"
+                        for t in stmt.targets) and \
+                        isinstance(stmt.value, (ast.Tuple, ast.List)):
+                    cmds = tuple(c for c in map(_const_str,
+                                                stmt.value.elts)
+                                 if c is not None)
+                    if cmds:
+                        out.cmds_consts[node.name] = cmds
+        elif isinstance(node, ast.Assign) and \
+                isinstance(parents.get(node), ast.Module):
+            for t in node.targets:
+                if not isinstance(t, ast.Name) or t.id not in (
+                        "_TOKEN_EXEMPT", "_PASSIVE_CMDS"):
+                    continue
+                binding = _set_binding(node.value)
+                if t.id == "_TOKEN_EXEMPT":
+                    out.exempt = binding
+                else:
+                    out.passive = binding
+
+    _extract_sends(ctx, tree, parents, out)
+    _extract_arms(ctx, tree, parents, out)
+    return out
+
+
+def _set_binding(value: ast.AST) -> Optional[tuple]:
+    """Parse ``frozenset({...})`` literals and the
+    ``commands.token_exempt("role")`` / ``commands.passive_cmds()``
+    derived views."""
+    if isinstance(value, ast.Call):
+        fn = value.func
+        if isinstance(fn, ast.Name) and fn.id in ("frozenset", "set") \
+                and value.args and isinstance(
+                    value.args[0], (ast.Set, ast.List, ast.Tuple)):
+            items = {c for c in map(_const_str, value.args[0].elts)
+                     if c is not None}
+            return ("literal", items, value.lineno)
+        if isinstance(fn, ast.Attribute) and fn.attr == "token_exempt" \
+                and value.args:
+            return ("derived", _const_str(value.args[0]), value.lineno)
+        if isinstance(fn, ast.Attribute) and fn.attr == "passive_cmds":
+            return ("derived", None, value.lineno)
+    if isinstance(value, (ast.Set,)):
+        items = {c for c in map(_const_str, value.elts) if c is not None}
+        return ("literal", items, value.lineno)
+    return None
+
+
+# -- send sites --------------------------------------------------------------
+
+
+def _extract_sends(ctx: FileContext, tree: ast.AST,
+                   parents: Dict[ast.AST, ast.AST],
+                   out: FileProto) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        cmd = None
+        fields: Set[str] = set()
+        open_fields = False
+        for k, v in zip(node.keys, node.values):
+            key = _const_str(k) if k is not None else None
+            if key is None:
+                open_fields = True  # **spread / computed key
+                continue
+            fields.add(key)
+            if key == "cmd":
+                cmd = _const_str(v)
+        if cmd is None:
+            continue  # no literal "cmd" key -> not a wire send site
+        site = {"cmd": cmd, "line": node.lineno,
+                "fields": fields - {"cmd"}, "open": open_fields,
+                "reads": {}}
+        _collect_resp_reads(node, parents, site)
+        out.sends.append(site)
+
+
+def _collect_resp_reads(dict_node: ast.Dict,
+                        parents: Dict[ast.AST, ast.AST],
+                        site: dict) -> None:
+    """Response keys read from this send's result: the direct
+    ``request(... {...})["k"]`` subscript, and the ``resp = request(...)``
+    / ``msg = {...}; resp = req(msg)`` name-tracking patterns within the
+    innermost enclosing function."""
+    call = parents.get(dict_node)
+    if not isinstance(call, ast.Call):
+        # maybe `msg = {...}` then `resp = self._req(msg)` — handled by
+        # the scope scan below (dict assigned to a name)
+        call = None
+    scope = _enclosing(dict_node, parents,
+                       (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda, ast.Module))
+    if scope is None:
+        return
+    #: name -> lineno of the assignment binding it to THIS send's
+    #: response (reads are windowed to [that line, the name's next
+    #: reassignment) — a reused `resp` must not conflate two commands)
+    resp_names: Dict[str, int] = {}
+    if call is not None:
+        p = parents.get(call)
+        if isinstance(p, ast.Subscript):
+            key = _const_str(p.slice)
+            if key is not None:
+                site["reads"].setdefault(key, p.lineno)
+        if isinstance(p, ast.Assign):
+            for t in p.targets:
+                if isinstance(t, ast.Name):
+                    resp_names[t.id] = p.lineno
+    # `msg = {...}` -> names holding this dict; then `resp = req(msg)`
+    # — only request-shaped callees AFTER the dict's construction bind
+    # a response name (a validator/log helper taking msg is not a wire
+    # round trip)
+    dict_names: Set[str] = set()
+    p = parents.get(dict_node)
+    if isinstance(p, ast.Assign):
+        for t in p.targets:
+            if isinstance(t, ast.Name):
+                dict_names.add(t.id)
+    if dict_names:
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Call) and \
+                    n.lineno >= dict_node.lineno and \
+                    _callee_name(n.value.func) in _REQUEST_CALLEES and \
+                    any(isinstance(a, ast.Name) and a.id in dict_names
+                        for a in n.value.args):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        resp_names[t.id] = n.lineno
+    if not resp_names:
+        return
+    # each tracked name's read window closes at its next reassignment
+    windows: Dict[str, Tuple[int, float]] = {}
+    for name, start in resp_names.items():
+        nxt = min((n.lineno for n in ast.walk(scope)
+                   if isinstance(n, ast.Assign) and n.lineno > start
+                   and any(isinstance(t, ast.Name) and t.id == name
+                           for t in n.targets)), default=float("inf"))
+        windows[name] = (start, nxt)
+
+    def in_window(name: str, lineno: int) -> bool:
+        start, end = windows[name]
+        return start <= lineno < end or lineno == start
+
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Subscript) and \
+                isinstance(n.value, ast.Name) and \
+                n.value.id in windows and \
+                isinstance(n.ctx, ast.Load) and \
+                in_window(n.value.id, n.lineno):
+            key = _const_str(n.slice)
+            if key is not None:
+                site["reads"].setdefault(key, n.lineno)
+        elif isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "get" and \
+                isinstance(n.func.value, ast.Name) and \
+                n.func.value.id in windows and n.args and \
+                in_window(n.func.value.id, n.lineno):
+            key = _const_str(n.args[0])
+            if key is not None:
+                site["reads"].setdefault(key, n.lineno)
+
+
+# -- handler arms ------------------------------------------------------------
+
+
+class _ClassSummary:
+    """Per-class method behavior closure: does calling ``self.m(...)``
+    (transitively) mutate state / journal via ``_apply`` / return which
+    response-dict keys."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {
+            m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self._beh: Dict[str, Tuple[bool, bool]] = {}
+        self._returns: Dict[str, Tuple[Set[str], bool]] = {}
+        self._compute_behavior()
+
+    # behavior: (mutates, calls_apply), closed over same-class calls
+    def _compute_behavior(self) -> None:
+        local: Dict[str, Tuple[bool, bool, Set[str]]] = {}
+        for name, meth in self.methods.items():
+            local[name] = _body_behavior(list(meth.body))
+        # fixpoint over the same-class call graph
+        beh = {n: (m, a) for n, (m, a, _c) in local.items()}
+        changed = True
+        while changed:
+            changed = False
+            for n, (_m, _a, callees) in local.items():
+                m, a = beh[n]
+                for c in callees:
+                    cm, ca = beh.get(c, (False, False))
+                    m, a = m or cm, a or ca
+                if (m, a) != beh[n]:
+                    beh[n] = (m, a)
+                    changed = True
+        self._beh = beh
+
+    def behavior_of_body(self, body: Sequence[ast.stmt]
+                         ) -> Tuple[bool, bool]:
+        m, a, callees = _body_behavior(body)
+        for c in callees:
+            cm, ca = self._beh.get(c, (False, False))
+            m, a = m or cm, a or ca
+        return m, a
+
+    def returns_of(self, name: str,
+                   seen: Optional[Set[str]] = None
+                   ) -> Tuple[Set[str], bool]:
+        """(response keys, open?) for method ``name``, following
+        same-class return-call chains."""
+        if name in self._returns:
+            return self._returns[name]
+        seen = seen or set()
+        if name in seen or name not in self.methods:
+            return set(), True
+        seen.add(name)
+        keys, opn = _returns_in(list(self.methods[name].body), self, seen)
+        self._returns[name] = (keys, opn)
+        return keys, opn
+
+
+def _body_behavior(body: Sequence[ast.stmt]
+                   ) -> Tuple[bool, bool, Set[str]]:
+    """(mutates, calls_apply, same-class callees) for a statement list.
+    Mutation = a store/del/augassign or mutator-method call on state
+    rooted at ``self`` (or a local alias of it), a cross-object
+    DataPlane-style hook, or a host_worker-style file write."""
+    mutates = False
+    calls_apply = False
+    callees: Set[str] = set()
+    aliases: Set[str] = set()
+    nodes = [n for stmt in body for n in ast.walk(stmt)]
+    # alias pass, to a fixpoint so CHAINS resolve (st = self._state;
+    # tbl = st.index): st enters the set on pass one, tbl on pass two
+    while True:
+        before = len(aliases)
+        for n in nodes:
+            if isinstance(n, ast.Assign) and \
+                    _self_rooted(n.value, aliases):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+            elif isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Call) and \
+                    _self_rooted(n.value.func, aliases):
+                # slot = self._reduce.setdefault(...) — call on state
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+        if len(aliases) == before:
+            break
+    for n in nodes:
+        if isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                        _self_rooted(t, aliases):
+                    mutates = True
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                        _self_rooted(t, aliases):
+                    mutates = True
+        elif isinstance(n, ast.Call):
+            fn = n.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            owner = fn.value
+            if isinstance(owner, ast.Name) and owner.id == "self":
+                if fn.attr == "_apply":
+                    calls_apply = True
+                    mutates = True
+                else:
+                    callees.add(fn.attr)
+                continue
+            if _self_rooted(owner, aliases) or (
+                    isinstance(owner, ast.Name) and owner.id in aliases):
+                if fn.attr in flow._MUTATORS or \
+                        fn.attr in _CROSS_MUTATORS:
+                    mutates = True
+            if fn.attr == "replace" and _dotted(fn.value) == "os":
+                mutates = True  # atomic host_worker rewrite
+    return mutates, calls_apply, callees
+
+
+def _returns_in(body: Sequence[ast.stmt], summary: _ClassSummary,
+                seen: Set[str]) -> Tuple[Set[str], bool]:
+    keys: Set[str] = set()
+    opn = False
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Return) or n.value is None:
+                continue
+            for k, o in _expr_resp(n.value, summary, seen):
+                keys |= k
+                opn = opn or o
+    return keys, opn
+
+
+def _expr_resp(expr: ast.AST, summary: _ClassSummary,
+               seen: Set[str]) -> List[Tuple[Set[str], bool]]:
+    """Response keys of one returned expression; open when any part is
+    not a literal dict (or a same-class call we can resolve)."""
+    if isinstance(expr, ast.Dict):
+        keys: Set[str] = set()
+        opn = False
+        for k in expr.keys:
+            c = _const_str(k) if k is not None else None
+            if c is None:
+                opn = True
+            else:
+                keys.add(c)
+        return [(keys, opn)]
+    if isinstance(expr, ast.IfExp):
+        return (_expr_resp(expr.body, summary, seen)
+                + _expr_resp(expr.orelse, summary, seen))
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Attribute) and \
+            isinstance(expr.func.value, ast.Name) and \
+            expr.func.value.id == "self":
+        return [summary.returns_of(expr.func.attr, seen)]
+    if isinstance(expr, ast.Constant) and expr.value is None:
+        return [(set(), False)]  # `return None` drops the connection
+    return [(set(), True)]
+
+
+def _extract_arms(ctx: FileContext, tree: ast.AST,
+                  parents: Dict[ast.AST, ast.AST],
+                  out: FileProto) -> None:
+    summaries: Dict[ast.ClassDef, _ClassSummary] = {}
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        binding = _dispatch_vars(fn)
+        if binding is None:
+            continue
+        cmdvar, msgvar = binding
+        cls = _enclosing(fn, parents, (ast.ClassDef,))
+        summary = None
+        if isinstance(cls, ast.ClassDef):
+            summary = summaries.setdefault(cls, _ClassSummary(cls))
+        arms = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.If) and
+                    isinstance(node.test, ast.Compare) and
+                    isinstance(node.test.left, ast.Name) and
+                    node.test.left.id == cmdvar and
+                    len(node.test.ops) == 1):
+                continue
+            op = node.test.ops[0]
+            comp = node.test.comparators[0]
+            cmds: List[str] = []
+            delegated = False
+            if isinstance(op, ast.Eq):
+                c = _const_str(comp)
+                if c is not None:
+                    cmds = [c]
+            elif isinstance(op, ast.In):
+                if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    cmds = [c for c in map(_const_str, comp.elts)
+                            if c is not None]
+                elif isinstance(comp, ast.Attribute) and \
+                        comp.attr == "CMDS" and \
+                        isinstance(comp.value, ast.Name):
+                    cmds = [f"@{comp.value.id}"]
+                    delegated = True
+            if not cmds:
+                continue
+            arms.append((node, cmds, delegated))
+        if len(arms) < 2:
+            continue  # not a dispatcher (incidental cmd comparison)
+        for node, cmds, delegated in arms:
+            required, optional = _msg_reads(node.body, msgvar, summary)
+            if summary is not None and not delegated:
+                mutates, calls_apply = summary.behavior_of_body(node.body)
+                keys, opn = _returns_in(node.body, summary, set())
+            else:
+                mutates, calls_apply = False, False
+                keys, opn = set(), True
+            for c in cmds:
+                out.arms.append({
+                    "cmd": c, "line": node.lineno,
+                    "required": required, "optional": optional,
+                    "resp_keys": keys, "resp_open": opn,
+                    "mutates": mutates, "calls_apply": calls_apply,
+                    "delegated": delegated})
+
+
+def _dispatch_vars(fn: ast.AST) -> Optional[Tuple[str, str]]:
+    """(cmd_var, msg_var) when ``fn`` opens with the dispatcher idiom
+    ``cmd = msg.get("cmd")``."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and isinstance(n.value.func, ast.Attribute) \
+                and n.value.func.attr == "get" \
+                and isinstance(n.value.func.value, ast.Name) \
+                and n.value.args \
+                and _const_str(n.value.args[0]) == "cmd":
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    return t.id, n.value.func.value.id
+    return None
+
+
+def _msg_reads(body: Sequence[ast.stmt], msgvar: str,
+               summary: Optional[_ClassSummary],
+               depth: int = 1) -> Tuple[Set[str], Set[str]]:
+    """(required, optional) message fields read in an arm body —
+    ``msg["k"]`` vs ``msg.get("k")`` — following one hop into
+    same-class methods the whole ``msg`` is passed to.  A field is
+    demoted to optional only when a ``.get`` read PRECEDES its first
+    subscript read (the presence-guard idiom); a required read that
+    merely has a later defaulted read stays required."""
+    sub_line: Dict[str, int] = {}
+    get_line: Dict[str, int] = {}
+    callee_req: Set[str] = set()
+    callee_opt: Set[str] = set()
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Subscript) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id == msgvar:
+                key = _const_str(n.slice)
+                if key is not None:
+                    sub_line[key] = min(sub_line.get(key, n.lineno),
+                                        n.lineno)
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute):
+                if n.func.attr == "get" and \
+                        isinstance(n.func.value, ast.Name) and \
+                        n.func.value.id == msgvar and n.args:
+                    key = _const_str(n.args[0])
+                    if key is not None:
+                        get_line[key] = min(get_line.get(key, n.lineno),
+                                            n.lineno)
+                elif depth > 0 and summary is not None and \
+                        isinstance(n.func.value, ast.Name) and \
+                        n.func.value.id == "self" and any(
+                            isinstance(a, ast.Name) and a.id == msgvar
+                            for a in n.args):
+                    callee = summary.methods.get(n.func.attr)
+                    if callee is not None:
+                        # map the msg argument to the callee's parameter
+                        pos = next(i for i, a in enumerate(n.args)
+                                   if isinstance(a, ast.Name)
+                                   and a.id == msgvar)
+                        params = [a.arg for a in callee.args.args
+                                  if a.arg != "self"]
+                        if pos < len(params):
+                            r2, o2 = _msg_reads(
+                                list(callee.body), params[pos],
+                                summary, depth - 1)
+                            callee_req |= r2
+                            callee_opt |= o2
+    required: Set[str] = set()
+    optional: Set[str] = set()
+    for key, line in sub_line.items():
+        if key in get_line and get_line[key] <= line:
+            optional.add(key)  # presence-guarded before use
+        else:
+            required.add(key)
+    optional |= set(get_line) - required - optional
+    # helper reads merge as sets AFTER the local ordering verdicts: a
+    # required local read (or a required callee read) wins over any
+    # defaulted read elsewhere — a callee's .get must not launder an
+    # arm's unguarded msg["k"] into optional
+    required |= callee_req - optional
+    optional = (optional | callee_opt) - required
+    return (required - _TRANSPORT_FIELDS,
+            optional - _TRANSPORT_FIELDS)
+
+
+# ---------------------------------------------------------------------------
+# the protocol registry + catalog (AST-parsed, never imported)
+# ---------------------------------------------------------------------------
+
+
+def _load_proto_registry(project: ProjectContext) -> Optional[Dict[str,
+                                                                   dict]]:
+    """{cmd: {roles, idem, flags, line}} from the PROTOCOL_REGISTRY dict
+    literal; None when the tree has no registry (fixture roots)."""
+    if "proto_registry" in project.data:
+        return project.data["proto_registry"]  # type: ignore
+    reg: Optional[Dict[str, dict]] = None
+    path = os.path.join(project.root, _COMMANDS_RELPATH)
+    if os.path.exists(path):
+        reg = {}
+        with open(path) as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            targets: list = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not any(isinstance(t, ast.Name) and
+                       t.id == "PROTOCOL_REGISTRY" for t in targets):
+                continue
+            if isinstance(value, ast.Dict):
+                for k, v in zip(value.keys, value.values):
+                    cmd = _const_str(k) if k is not None else None
+                    if cmd is None or not isinstance(v, ast.Tuple) or \
+                            len(v.elts) != 4:
+                        continue
+                    roles, idem, flags, _doc = [
+                        _const_str(e) or "" for e in v.elts]
+                    reg[cmd] = {
+                        "roles": frozenset(roles.split("|")) - {""},
+                        "idem": idem,
+                        "flags": frozenset(flags.split("|")) - {""},
+                        "line": k.lineno}
+    project.data["proto_registry"] = reg
+    return reg
+
+
+_CATALOG_CMD_RE = re.compile(r"^\|\s*`([^`]+)`")
+
+
+def _load_catalog(root: str) -> Optional[Dict[str, int]]:
+    """{cmd: line} from the generated docs/protocol_commands.md table;
+    None when the file does not exist."""
+    path = os.path.join(root, _CATALOG_RELPATH)
+    if not os.path.exists(path):
+        return None
+    out: Dict[str, int] = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            m = _CATALOG_CMD_RE.match(line.strip())
+            if m:
+                out[m.group(1)] = lineno
+    return out
+
+
+def _full_scope(project: ProjectContext) -> bool:
+    linted = {p.rstrip("/") for p in project.paths}
+    return set(DEFAULT_PATHS) <= linted
+
+
+def _expand_arms(project: ProjectContext) -> List[dict]:
+    """All arms across files, with ``@Class`` delegation arms expanded
+    through the ``CMDS`` consts collected from any linted file."""
+    files: Dict[str, FileProto] = project.data.get("proto_files", {})
+    consts: Dict[str, Tuple[str, ...]] = {}
+    for fp in files.values():
+        consts.update(fp.cmds_consts)
+    arms: List[dict] = []
+    for path, fp in sorted(files.items()):
+        for arm in fp.arms:
+            if arm["cmd"].startswith("@"):
+                for c in consts.get(arm["cmd"][1:], ()):
+                    a = dict(arm)
+                    a["cmd"] = c
+                    a["path"] = path
+                    arms.append(a)
+            else:
+                a = dict(arm)
+                a["path"] = path
+                arms.append(a)
+    return arms
+
+
+# ---------------------------------------------------------------------------
+# DT012 — wire contract
+# ---------------------------------------------------------------------------
+
+
+class WireContract(Rule):
+    """DT012: every literal ``{"cmd": ...}`` send must have a handler
+    arm, every arm a sender (or an ``external`` registry flag naming
+    its out-of-tree consumer), every sent field a reader, every
+    required read a sender that supplies it, every response key a
+    caller reads a handler that returns it — and the whole vocabulary
+    must match ``PROTOCOL_REGISTRY``, the ``rpc.<cmd>`` obs-name
+    family, and the generated ``docs/protocol_commands.md`` catalog."""
+
+    id = "DT012"
+    name = "wire-contract"
+    hint = ("keep senders, handler arms, dt_tpu.elastic.commands."
+            "PROTOCOL_REGISTRY, and docs/protocol_commands.md (python -m "
+            "dt_tpu.elastic.commands) in lockstep")
+
+    def check_file(self, ctx: FileContext,
+                   project: ProjectContext) -> Iterable[Finding]:
+        file_proto(ctx, project)  # build/cache the model
+        return ()
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        if not _full_scope(project):
+            return  # cross-file checks need the whole vocabulary
+        files: Dict[str, FileProto] = project.data.get("proto_files", {})
+        arms = _expand_arms(project)
+        if not arms:
+            return  # no dispatcher in this tree (fixture roots)
+        by_cmd: Dict[str, List[dict]] = {}
+        for a in arms:
+            by_cmd.setdefault(a["cmd"], []).append(a)
+        sends: List[dict] = []
+        for path, fp in sorted(files.items()):
+            for s in fp.sends:
+                s2 = dict(s)
+                s2["path"] = path
+                sends.append(s2)
+        sent_cmds = {s["cmd"] for s in sends}
+        registry = _load_proto_registry(project)
+
+        # 1. sent-but-unhandled
+        for s in sends:
+            if s["cmd"] not in by_cmd:
+                yield Finding(
+                    rule=self.id, path=s["path"], line=s["line"],
+                    message=f"command {s['cmd']!r} is sent here but no "
+                            f"dispatcher has a handler arm for it",
+                    hint=self.hint,
+                    snippet=self._snip(project, s["path"], s["line"]))
+        # 2. dead handler arms
+        for cmd, cmd_arms in sorted(by_cmd.items()):
+            if cmd in sent_cmds:
+                continue
+            if registry and "external" in registry.get(cmd, {}).get(
+                    "flags", frozenset()):
+                continue  # documented out-of-tree sender
+            a = min(cmd_arms, key=lambda x: (x["path"], x["line"]))
+            yield Finding(
+                rule=self.id, path=a["path"], line=a["line"],
+                message=f"dead handler arm: command {cmd!r} is handled "
+                        f"here but nothing in the linted tree sends it "
+                        f"(flag it 'external' in PROTOCOL_REGISTRY with "
+                        f"the consumer named, or delete the arm)",
+                hint=self.hint,
+                snippet=self._snip(project, a["path"], a["line"]))
+        # 3./4. field drift per send site
+        for s in sends:
+            cmd_arms = by_cmd.get(s["cmd"])
+            if not cmd_arms:
+                continue
+            readable: Set[str] = set()
+            required: Set[str] = set()
+            for a in cmd_arms:
+                readable |= a["required"] | a["optional"]
+                required |= a["required"]
+            if not s["open"]:
+                for f in sorted(s["fields"] - readable
+                                - _TRANSPORT_FIELDS):
+                    yield Finding(
+                        rule=self.id, path=s["path"], line=s["line"],
+                        message=f"field {f!r} of command {s['cmd']!r} "
+                                f"is sent here but no handler arm ever "
+                                f"reads it",
+                        hint=self.hint,
+                        snippet=self._snip(project, s["path"],
+                                           s["line"]))
+                for f in sorted(required - s["fields"]):
+                    yield Finding(
+                        rule=self.id, path=s["path"], line=s["line"],
+                        message=f"command {s['cmd']!r} handler requires "
+                                f"field {f!r} (read as msg[{f!r}]) but "
+                                f"this send site does not supply it",
+                        hint=self.hint,
+                        snippet=self._snip(project, s["path"],
+                                           s["line"]))
+            # 5. response keys read that no handler returns
+            if all(not a["resp_open"] for a in cmd_arms):
+                returned: Set[str] = set()
+                for a in cmd_arms:
+                    returned |= a["resp_keys"]
+                for key, line in sorted(s["reads"].items()):
+                    if key not in returned | _TRANSPORT_RESP:
+                        yield Finding(
+                            rule=self.id, path=s["path"], line=line,
+                            message=f"response key {key!r} of command "
+                                    f"{s['cmd']!r} is read here but no "
+                                    f"handler arm returns it",
+                            hint=self.hint,
+                            snippet=self._snip(project, s["path"], line))
+        # 6. registry coverage, both directions
+        if registry is not None:
+            for cmd in sorted(set(by_cmd) | sent_cmds):
+                if cmd not in registry:
+                    anchor = by_cmd.get(cmd) or \
+                        [s for s in sends if s["cmd"] == cmd]
+                    a = min(anchor, key=lambda x: (x["path"], x["line"]))
+                    yield Finding(
+                        rule=self.id, path=a["path"], line=a["line"],
+                        message=f"command {cmd!r} is on the wire but "
+                                f"has no PROTOCOL_REGISTRY row "
+                                f"({_COMMANDS_RELPATH})",
+                        hint=self.hint,
+                        snippet=self._snip(project, a["path"],
+                                           a["line"]))
+            for cmd, row in sorted(registry.items()):
+                if cmd not in by_cmd:
+                    yield Finding(
+                        rule=self.id, path=_COMMANDS_RELPATH,
+                        line=row["line"],
+                        message=f"dead registry row: command {cmd!r} is "
+                                f"declared but no dispatcher handles it",
+                        hint=self.hint, snippet=cmd)
+            # 7. the generated catalog must match the registry
+            catalog = _load_catalog(project.root)
+            if catalog is None:
+                yield Finding(
+                    rule=self.id, path=_COMMANDS_RELPATH, line=1,
+                    message=f"{_CATALOG_RELPATH} is missing — "
+                            f"regenerate it (python -m "
+                            f"dt_tpu.elastic.commands)",
+                    hint=self.hint, snippet="")
+            else:
+                for cmd in sorted(set(registry) - set(catalog)):
+                    yield Finding(
+                        rule=self.id, path=_CATALOG_RELPATH, line=1,
+                        message=f"catalog is stale: command {cmd!r} is "
+                                f"in PROTOCOL_REGISTRY but not in the "
+                                f"table — regenerate it",
+                        hint=self.hint, snippet=cmd)
+                for cmd in sorted(set(catalog) - set(registry)):
+                    yield Finding(
+                        rule=self.id, path=_CATALOG_RELPATH,
+                        line=catalog[cmd],
+                        message=f"catalog is stale: command {cmd!r} is "
+                                f"in the table but not in "
+                                f"PROTOCOL_REGISTRY — regenerate it",
+                        hint=self.hint, snippet=cmd)
+        # 8. every handled command needs an rpc.<cmd> obs-name family row
+        obs = _load_obs_registry(project)
+        if obs:
+            for cmd, cmd_arms in sorted(by_cmd.items()):
+                name = f"rpc.{cmd}"
+                ok = name in obs or any(
+                    k.endswith("*") and name.startswith(k[:-1])
+                    for k in obs)
+                if not ok:
+                    a = min(cmd_arms,
+                            key=lambda x: (x["path"], x["line"]))
+                    yield Finding(
+                        rule=self.id, path=a["path"], line=a["line"],
+                        message=f"handler span name {name!r} has no "
+                                f"covering NAME_REGISTRY row (the "
+                                f"traced_handle wrapper emits it; "
+                                f"DT011 family rule 'rpc.*')",
+                        hint=self.hint,
+                        snippet=self._snip(project, a["path"],
+                                           a["line"]))
+
+    @staticmethod
+    def _snip(project: ProjectContext, path: str, line: int) -> str:
+        try:
+            with open(os.path.join(project.root, path)) as f:
+                lines = f.read().splitlines()
+            return lines[line - 1].strip() if 0 < line <= len(lines) \
+                else ""
+        except OSError:
+            return ""
+
+
+# ---------------------------------------------------------------------------
+# DT013 — retry / idempotency discipline
+# ---------------------------------------------------------------------------
+
+
+class RetryDiscipline(Rule):
+    """DT013: the token-cache exemption sets must agree with what the
+    handlers actually do.  A journaled mutation (``_apply``) under a
+    token-exempt command re-opens the at-least-once replay window (the
+    PR-6 re-applied-gradient class); a ``once``-classified command in
+    the exemption set, a ``read_only`` row over a mutating handler, and
+    a token-guarded read-only handler (cache churn) are the registry-
+    level variants of the same drift."""
+
+    id = "DT013"
+    name = "retry-discipline"
+    hint = ("token-cache mutating no-dedup commands (class 'once'); "
+            "exempt read-only / self-dedup'd ones — and keep "
+            "PROTOCOL_REGISTRY's idempotency class honest about what "
+            "the handler does")
+
+    def check_file(self, ctx: FileContext,
+                   project: ProjectContext) -> Iterable[Finding]:
+        fp = file_proto(ctx, project)
+        if not fp.arms or fp.exempt is None:
+            return
+        registry = _load_proto_registry(project)
+        exempt = self._effective(fp.exempt, registry)
+        if exempt is None:
+            return  # derived view with no registry in tree: undecidable
+        kind = fp.exempt[0]
+        consts = fp.cmds_consts
+        # resolve delegation locally when the consts are known
+        arms: List[dict] = []
+        for arm in fp.arms:
+            if arm["cmd"].startswith("@"):
+                for c in consts.get(arm["cmd"][1:], ()):
+                    a = dict(arm)
+                    a["cmd"] = c
+                    arms.append(a)
+            else:
+                arms.append(arm)
+        seen: Set[str] = set()
+        for arm in arms:
+            cmd = arm["cmd"]
+            ex = cmd in exempt
+            row = registry.get(cmd) if registry else None
+            if cmd not in seen:
+                seen.add(cmd)
+                if row is not None:
+                    idem = row["idem"]
+                    if ex and idem == "once":
+                        yield ctx.finding(
+                            self, arm["line"],
+                            f"command {cmd!r} is token-exempt but "
+                            f"PROTOCOL_REGISTRY classifies it 'once' "
+                            f"(mutating, no self-dedup): an at-least-"
+                            f"once retry would re-dispatch the "
+                            f"mutation")
+                    if not ex and idem == "read_only":
+                        yield ctx.finding(
+                            self, arm["line"],
+                            f"command {cmd!r} is read-only but token-"
+                            f"guarded: caching its responses churns "
+                            f"the bounded token cache for nothing — "
+                            f"add it to the exemption set")
+                    if kind == "literal":
+                        reg_ex = "exempt" in row["flags"]
+                        if ex != reg_ex:
+                            yield ctx.finding(
+                                self, fp.exempt[2],
+                                f"_TOKEN_EXEMPT drifted from "
+                                f"PROTOCOL_REGISTRY: {cmd!r} is "
+                                f"{'exempt here' if ex else 'cached here'}"
+                                f" but the registry says "
+                                f"{'exempt' if reg_ex else 'cached'}")
+            if arm["delegated"]:
+                continue  # verdict lives with the delegate's own arms
+            if ex and arm["calls_apply"]:
+                yield ctx.finding(
+                    self, arm["line"],
+                    f"handler arm for token-exempt command {cmd!r} "
+                    f"journals control-state mutations (_apply): a "
+                    f"replayed request re-applies the op — remove the "
+                    f"exemption or give the command its own dedup")
+            if row is not None and row["idem"] == "read_only" and \
+                    arm["mutates"]:
+                yield ctx.finding(
+                    self, arm["line"],
+                    f"PROTOCOL_REGISTRY classifies {cmd!r} read_only "
+                    f"but its handler arm mutates state")
+            if row is None and not ex and not arm["mutates"]:
+                yield ctx.finding(
+                    self, arm["line"],
+                    f"command {cmd!r} is token-guarded but its handler "
+                    f"arm is read-only (cache churn); exempt it or "
+                    f"declare it in PROTOCOL_REGISTRY")
+
+    @staticmethod
+    def _effective(binding: tuple, registry: Optional[Dict[str, dict]]
+                   ) -> Optional[Set[str]]:
+        kind, value, _line = binding
+        if kind == "literal":
+            return set(value)
+        if registry is None:
+            return None
+        role = value
+        return {cmd for cmd, row in registry.items()
+                if role in row["roles"] and "exempt" in row["flags"]}
+
+
+# ---------------------------------------------------------------------------
+# DT014 — replay / byte-determinism discipline
+# ---------------------------------------------------------------------------
+
+
+class ReplayDeterminism(Rule):
+    """DT014: deterministic surfaces must be deterministic.
+    ``ControlState._op_*`` methods (journal replay == live state) and
+    any function marked ``# deterministic: replay`` must not read wall
+    clocks, draw RNG/uuid values, iterate sets into ordered output, or
+    ``json.dump`` without ``sort_keys``; ``# deterministic: bytes``
+    surfaces (export/bundle/Prometheus writers — timestamps are data
+    there) get the serialization checks only.  Arguments of journaled
+    ``self._apply(...)`` calls are a replay surface wherever they
+    appear.  The core promised surfaces must carry their marker."""
+
+    id = "DT014"
+    name = "replay-determinism"
+    hint = ("inject clocks/RNG as parameters, sort set/dict iteration "
+            "that reaches journaled records or serialized bytes, and "
+            "json.dump(..., sort_keys=True) on byte-deterministic "
+            "surfaces (docs/dtlint_rules.md#dt014)")
+
+    def check_file(self, ctx: FileContext,
+                   project: ProjectContext) -> Iterable[Finding]:
+        src = ctx.source
+        interesting = ("deterministic:" in src or "ControlState" in src
+                       or "._apply(" in src or "_apply(" in src)
+        markers = self._markers(ctx)
+        for line, msg in self._expected_missing(ctx, markers):
+            yield ctx.finding(self, line, msg)
+        if not interesting:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name == "ControlState":
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and \
+                            meth.name.startswith("_op_"):
+                        yield from self._check_fn(
+                            ctx, meth, "replay",
+                            f"ControlState.{meth.name} (journal replay "
+                            f"surface)")
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                # marker anchors: trailing on the def line, the line
+                # above it, or (for decorated defs) on/above the first
+                # decorator line
+                mode = next(
+                    (markers[a]
+                     for a in sorted(self._anchor_lines(node))
+                     if a in markers), None)
+                if mode is not None:
+                    yield from self._check_fn(
+                        ctx, node, mode,
+                        f"{node.name} (marked deterministic: {mode})")
+        # journaled-op arguments are a replay surface everywhere
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "_apply" and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                args = list(node.args) + [kw.value
+                                          for kw in node.keywords]
+                for a in args:
+                    yield from self._check_exprs(
+                        ctx, a, "replay",
+                        "journaled _apply argument",
+                        include_sort_keys=False)
+
+    @classmethod
+    def _expected_missing(cls, ctx: FileContext,
+                          markers: Dict[int, str]):
+        """(line, message) per promised surface in this file that lost
+        its marker — or the function itself (renamed/moved promises rot
+        silently otherwise; updating _EXPECTED_MARKED is the conscious
+        act this finding forces)."""
+        for path, fname, mode in sorted(_EXPECTED_MARKED):
+            if ctx.path != path:
+                continue
+            fn = next(
+                (n for n in ast.walk(ctx.tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))
+                 and n.name == fname), None)
+            if fn is None:
+                yield 1, (f"promised deterministic surface {fname}() "
+                          f"is gone from this module — update the "
+                          f"DT014 surface registry "
+                          f"(dt_tpu/analysis/rules_proto.py "
+                          f"_EXPECTED_MARKED) consciously, don't let "
+                          f"the promise rot")
+                continue
+            if not any(markers.get(a) == mode
+                       for a in cls._anchor_lines(fn)):
+                yield fn.lineno, (
+                    f"{fname}() is a promised deterministic surface "
+                    f"but carries no '# deterministic: {mode}' marker "
+                    f"(the chaos byte-identity gates rest on it)")
+
+    @staticmethod
+    def _anchor_lines(fn: ast.AST) -> Set[int]:
+        """Lines where a marker counts for ``fn``: on/above the def, or
+        on/above the first decorator."""
+        anchors = {fn.lineno, fn.lineno - 1}
+        if fn.decorator_list:
+            first = min(d.lineno for d in fn.decorator_list)
+            anchors |= {first, first - 1}
+        return anchors
+
+    @staticmethod
+    def _markers(ctx: FileContext) -> Dict[int, str]:
+        """{marker lineno: mode} for every ``# deterministic: <mode>``
+        COMMENT (tokenized — docstring prose quoting the convention
+        must not mint surfaces); the def-site lookup matches anchors
+        on/above the def or its first decorator."""
+        out: Dict[int, str] = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(ctx.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _DET_MARKER_RE.search(tok.string)
+                if m:
+                    out[tok.start[0]] = m.group(1)
+        except tokenize.TokenError:
+            pass
+        return out
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST, mode: str,
+                  where: str) -> Iterable[Finding]:
+        for stmt in fn.body:
+            yield from self._check_exprs(ctx, stmt, mode, where)
+
+    def _check_exprs(self, ctx: FileContext, root: ast.AST, mode: str,
+                     where: str,
+                     include_sort_keys: bool = True
+                     ) -> Iterable[Finding]:
+        for n in ast.walk(root):
+            if isinstance(n, ast.Call):
+                dotted = _dotted(n.func)
+                rootname = dotted.split(".", 1)[0] if dotted else ""
+                if mode == "replay" and dotted in _CLOCK_CALLS:
+                    yield ctx.finding(
+                        self, n.lineno,
+                        f"wall-clock read ({dotted}) in {where}: replay "
+                        f"would diverge from live — inject the clock or "
+                        f"stamp the value into the journaled record "
+                        f"once, at the call site")
+                elif mode == "replay" and (
+                        rootname in _RNG_ROOTS or
+                        dotted.startswith(("np.random", "numpy.random"))):
+                    yield ctx.finding(
+                        self, n.lineno,
+                        f"unseeded RNG/uuid ({dotted}) in {where}: the "
+                        f"surface must be a pure function of its "
+                        f"inputs")
+                elif include_sort_keys and dotted in ("json.dump",
+                                                      "json.dumps"):
+                    sk = next((kw for kw in n.keywords
+                               if kw.arg == "sort_keys"), None)
+                    if sk is None or not (
+                            isinstance(sk.value, ast.Constant)
+                            and sk.value.value is True):
+                        yield ctx.finding(
+                            self, n.lineno,
+                            f"{dotted}(...) without sort_keys=True in "
+                            f"{where}: dict-order bytes are not "
+                            f"deterministic across construction "
+                            f"histories")
+                elif isinstance(n.func, ast.Name) and \
+                        n.func.id in ("list", "tuple") and n.args and \
+                        self._is_set_expr(n.args[0]):
+                    yield ctx.finding(
+                        self, n.lineno,
+                        f"unsorted set materialization in {where}: use "
+                        f"sorted(...) — set order depends on hash "
+                        f"seeding")
+            iters = []
+            if isinstance(n, ast.For):
+                iters = [n.iter]
+            elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                iters = [g.iter for g in n.generators]
+            for it in iters:
+                if self._is_set_expr(it):
+                    yield ctx.finding(
+                        self, it.lineno,
+                        f"iteration over a set in {where}: order "
+                        f"depends on hash seeding — wrap it in "
+                        f"sorted(...)")
+
+    @staticmethod
+    def _is_set_expr(node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("set", "frozenset"):
+            return True
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.BitAnd, ast.BitOr, ast.Sub)):
+            # set algebra (a - b, a | b) over set operands is the
+            # common journaled-path shape; flag only when a side is a
+            # syntactic set
+            return (ReplayDeterminism._is_set_expr(node.left) or
+                    ReplayDeterminism._is_set_expr(node.right))
+        return False

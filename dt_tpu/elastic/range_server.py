@@ -39,7 +39,7 @@ import time
 from typing import List, Optional, Set
 
 from dt_tpu import config
-from dt_tpu.elastic import faults, protocol
+from dt_tpu.elastic import commands, faults, protocol
 from dt_tpu.elastic.dataplane import DataPlane
 from dt_tpu.obs import trace as obs_trace
 
@@ -47,10 +47,9 @@ logger = logging.getLogger("dt_tpu.elastic")
 _drop_rng = random.Random(0x5EED)  # deterministic fault injection
 
 #: responses never token-cached (read-only / own (host, seq) dedup);
-#: mirrors the scheduler's exemption list
-_TOKEN_EXEMPT = frozenset({"allreduce", "async_init", "async_push",
-                           "async_pull_rows", "async_stats", "ping",
-                           "stats"})
+#: derived view over the r17 PROTOCOL_REGISTRY (elastic/commands.py),
+#: like the scheduler's — dtlint DT013 pins it to handler reality
+_TOKEN_EXEMPT = commands.token_exempt("range_server")
 
 
 class RangeServer:

@@ -61,7 +61,7 @@ import numpy as np
 
 from dt_tpu import config
 from dt_tpu import policy as policy_lib
-from dt_tpu.elastic import faults, journal, protocol
+from dt_tpu.elastic import commands, faults, journal, protocol
 from dt_tpu.elastic.dataplane import DataPlane
 from dt_tpu.obs import blackbox as obs_blackbox
 from dt_tpu.obs import metrics as obs_metrics
@@ -73,19 +73,19 @@ _drop_rng = random.Random(0xD207)  # deterministic fault injection
 #: commands whose responses are NOT token-cached: read-only, or already
 #: dedup'd by their own (host, seq) machinery — fetch_snapshot blobs would
 #: dominate the cache's memory, and high-rate heartbeats would churn the
-#: bounded cache out of the very tokens the dedup exists to protect
-_TOKEN_EXEMPT = frozenset({"fetch_snapshot", "allreduce", "async_init",
-                           "async_push", "async_pull_rows", "async_stats",
-                           "heartbeat", "num_dead", "membership",
-                           "servers", "obs_push", "obs_dump", "ha_round",
-                           "status", "health", "blackbox_index"})
+#: bounded cache out of the very tokens the dedup exists to protect.
+#: Derived view over the r17 PROTOCOL_REGISTRY (elastic/commands.py):
+#: the idempotency class declared per command IS the exemption decision,
+#: and dtlint DT013 cross-checks both against the handler's actual
+#: behavior — a mutating no-dedup command can no longer slip in here
+_TOKEN_EXEMPT = commands.token_exempt("scheduler")
 
 #: commands a PASSIVE instance (warm standby / fenced ex-leader) still
 #: serves: round replication from the live primary, obs ingest/export,
 #: health introspection, and shutdown — everything else is refused with
-#: ``not_leader`` so clients rotate to the real leader
-_PASSIVE_CMDS = frozenset({"ha_round", "obs_push", "obs_dump", "status",
-                           "health", "blackbox_index", "shutdown"})
+#: ``not_leader`` so clients rotate to the real leader.  Derived view
+#: over the PROTOCOL_REGISTRY ``passive`` flag (elastic/commands.py)
+_PASSIVE_CMDS = commands.passive_cmds()
 
 #: bound on retained (host, incarnation) obs tracks — LRU-evicted so a
 #: job with heavy restart churn can't grow scheduler memory unboundedly

@@ -290,6 +290,7 @@ def build_bundle(trigger: str, host: Optional[str] = None,
     }
 
 
+# deterministic: bytes — bundle serialization is canonical (sort_keys)
 def _dump(bundle: dict) -> bytes:
     return json.dumps(bundle, sort_keys=True, default=repr).encode()
 
@@ -459,6 +460,7 @@ def manifest_path(dirpath: Optional[str] = None) -> str:
     return os.path.join(dirpath or bundle_dir(), "manifest.jsonl")
 
 
+# deterministic: bytes — manifest rows serialize canonically
 def manifest_append(row: dict, dirpath: Optional[str] = None) -> bool:
     """Append one row (fsync'd).  Never raises; False on failure."""
     try:

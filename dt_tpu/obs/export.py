@@ -423,6 +423,7 @@ def metrics_path(trace_path: str) -> str:
     return root + ".metrics.json"
 
 
+# deterministic: bytes — two writes of one dump are byte-identical
 def write(trace_path: str, job: Dict[str, Any]) -> Dict[str, Any]:
     """Write the merged chrome trace to ``trace_path`` and the metrics/
     summary snapshot next to it; returns the summary.  Byte-

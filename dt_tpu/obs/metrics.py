@@ -415,6 +415,7 @@ def _help_for(name: str) -> str:
         return ""
 
 
+# deterministic: bytes — the exposition golden-file contract
 def render_prometheus(jobs: Sequence[Tuple[Dict[str, str], Dict[str, Any],
                                            Dict[str, int]]]) -> str:
     """Render Prometheus text exposition from one or more label-scoped

@@ -93,6 +93,7 @@ class PolicyEngine:
 
     # ------------------------------------------------------------------
 
+    # deterministic: replay — decision_log_sha256 identity across runs
     def decide(self, epoch: int, workers: Sequence[str], base: Set[str],
                streaks: Mapping[str, int],
                scores: Mapping[str, float]) -> Decision:

@@ -1,0 +1,27 @@
+"""DT013 bad fixture: a mutating journaled handler sits in the
+token-exempt set — the re-applied-gradient replay window."""
+
+import threading
+
+_TOKEN_EXEMPT = frozenset({"push", "snapshot"})
+
+
+class MiniServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._tokens = {}
+
+    def _apply(self, op, **kw):
+        self._state[op] = kw
+
+    def _dispatch(self, msg):
+        cmd = msg.get("cmd")
+        if cmd == "push":
+            # BAD: journals a mutation while "push" is token-exempt —
+            # an at-least-once replay re-applies the op
+            self._apply("push", host=msg["host"])
+            return {}
+        if cmd == "snapshot":
+            return {"blob": None}
+        return {"error": f"unknown cmd {cmd!r}"}

@@ -1,0 +1,25 @@
+"""DT013 good fixture: the mutating journaled command is token-cached;
+only the read-only command is exempt."""
+
+import threading
+
+_TOKEN_EXEMPT = frozenset({"snapshot"})
+
+
+class MiniServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._tokens = {}
+
+    def _apply(self, op, **kw):
+        self._state[op] = kw
+
+    def _dispatch(self, msg):
+        cmd = msg.get("cmd")
+        if cmd == "push":
+            self._apply("push", host=msg["host"])
+            return {}
+        if cmd == "snapshot":
+            return {"blob": None}
+        return {"error": f"unknown cmd {cmd!r}"}

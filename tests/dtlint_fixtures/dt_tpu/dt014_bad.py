@@ -1,0 +1,47 @@
+"""DT014 bad fixture: wall clocks, unsorted set iteration, a journaled
+clock argument, and a canonical-bytes writer without sort_keys."""
+
+import json
+import time
+
+
+class ControlState:
+    def __init__(self):
+        self.workers = []
+        self.stamp = 0.0
+        self.order = []
+
+    def _op_evict(self, host, seq):
+        self.workers = [h for h in self.workers if h != host]
+        self.stamp = time.time()  # BAD: wall clock in a replay op
+
+    def _op_note(self, hosts):
+        # BAD: set iteration order depends on hash seeding
+        self.order = [h for h in set(hosts)]
+
+
+class MiniScheduler:
+    def __init__(self):
+        self.seq = 0
+
+    def _apply(self, op, **kw):
+        self.seq += 1
+
+    def bump(self):
+        # BAD: a wall-clock value rides into the journaled record
+        self._apply("evict", host="h", ts=time.time())
+
+
+# deterministic: bytes
+def render(rows):
+    return json.dumps(rows)  # BAD: no sort_keys on a bytes surface
+
+
+def _cache(fn):
+    return fn
+
+
+# deterministic: bytes
+@_cache
+def render_decorated(rows):
+    return json.dumps(rows)  # BAD: marker above a decorator counts too

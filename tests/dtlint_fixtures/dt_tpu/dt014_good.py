@@ -1,0 +1,46 @@
+"""DT014 good fixture: injectable clock, sorted set materialization,
+clean journaled arguments, canonical serialization."""
+
+import json
+
+
+class ControlState:
+    def __init__(self):
+        self.workers = []
+        self.stamp = 0.0
+        self.order = []
+
+    def _op_evict(self, host, seq, ts):
+        # the clock value was stamped ONCE at the call site and rides
+        # in the journaled record — replay reuses it
+        self.workers = [h for h in self.workers if h != host]
+        self.stamp = float(ts)
+
+    def _op_note(self, hosts):
+        self.order = sorted(set(hosts))
+
+
+class MiniScheduler:
+    def __init__(self):
+        self.seq = 0
+
+    def _apply(self, op, **kw):
+        self.seq += 1
+
+    def bump(self):
+        self._apply("evict", host="h", seq=self.seq + 1)
+
+
+# deterministic: bytes
+def render(rows):
+    return json.dumps(rows, sort_keys=True)
+
+
+def _cache(fn):
+    return fn
+
+
+# deterministic: bytes
+@_cache
+def render_decorated(rows):
+    return json.dumps(rows, sort_keys=True)

@@ -1,0 +1,32 @@
+"""DT012 bad fixture tree: one-sided wire edits of every flavor."""
+
+
+def send(host, port, msg):
+    return {}
+
+
+def caller():
+    # BAD: no dispatcher has a handler arm for "frobnicate"
+    send("h", 1, {"cmd": "frobnicate"})
+    # BAD: "extra" is never read by any handler; "key" (required) missing
+    send("h", 1, {"cmd": "pull", "extra": 1})
+    resp = send("h", 1, {"cmd": "pull", "key": "k"})
+    # BAD: no handler arm returns a "missing" response key
+    return resp["missing"]
+
+
+def ping_it():
+    send("h", 1, {"cmd": "ping"})
+
+
+class Server:
+    def _dispatch(self, msg):
+        cmd = msg.get("cmd")
+        if cmd == "pull":
+            return {"value": msg["key"]}
+        if cmd == "ping":
+            return {}
+        if cmd == "push":
+            # BAD: dead handler arm — nothing in the tree sends "push"
+            return {}
+        return {"error": f"unknown cmd {cmd!r}"}

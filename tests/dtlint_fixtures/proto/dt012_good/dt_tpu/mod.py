@@ -1,0 +1,22 @@
+"""DT012 good fixture tree: senders, fields, response keys, and arms
+all agree."""
+
+
+def send(host, port, msg):
+    return {}
+
+
+def caller():
+    send("h", 1, {"cmd": "ping"})
+    resp = send("h", 1, {"cmd": "pull", "key": "k"})
+    return resp["value"]
+
+
+class Server:
+    def _dispatch(self, msg):
+        cmd = msg.get("cmd")
+        if cmd == "pull":
+            return {"value": msg["key"]}
+        if cmd == "ping":
+            return {}
+        return {"error": f"unknown cmd {cmd!r}"}

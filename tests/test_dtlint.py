@@ -70,6 +70,8 @@ _PAIRS = [
     ("DT009", "dt_tpu/dt009_bad.py", "dt_tpu/dt009_good.py"),
     ("DT010", "dt_tpu/dt010_bad.py", "dt_tpu/dt010_good.py"),
     ("DT011", "dt_tpu/dt011_bad.py", "dt_tpu/dt011_good.py"),
+    ("DT013", "dt_tpu/dt013_bad.py", "dt_tpu/dt013_good.py"),
+    ("DT014", "dt_tpu/dt014_bad.py", "dt_tpu/dt014_good.py"),
 ]
 
 
@@ -281,6 +283,321 @@ def test_dt010_scheduler_copy_detects_wal_bypass(tmp_path):
     # the journaled path stays silent: _apply / replay are the WAL gate
     assert not any(f.line <= 310 for f in findings), \
         [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# DT012-DT014 (dtproto, r17): fixture trees + acceptance on copies of the
+# REAL protocol files (pristine clean; each one-sided edit yields exactly
+# the expected finding class)
+# ---------------------------------------------------------------------------
+
+#: the closure of files whose send sites / handler arms / registry /
+#: catalog make the REAL wire vocabulary self-consistent — what the
+#: acceptance tests copy into a scratch root
+_PROTO_CLOSURE = (
+    "dt_tpu/elastic/client.py",
+    "dt_tpu/elastic/scheduler.py",
+    "dt_tpu/elastic/scheduler_main.py",
+    "dt_tpu/elastic/range_server.py",
+    "dt_tpu/elastic/dataplane.py",
+    "dt_tpu/elastic/journal.py",
+    "dt_tpu/elastic/commands.py",
+    "dt_tpu/obs/names.py",
+    "tools/chaos_run.py",
+    "tools/dtop.py",
+    "tools/wire_bench.py",
+    "docs/protocol_commands.md",
+)
+
+
+def _proto_root(tmp_path, edits=None):
+    """A scratch root holding the protocol closure, with optional
+    ``{relpath: (old, new)}`` source edits applied (each must match)."""
+    edits = edits or {}
+    root = tmp_path / "proto"
+    for rel in _PROTO_CLOSURE:
+        src = open(os.path.join(ROOT, *rel.split("/"))).read()
+        if rel in edits:
+            old, new = edits[rel]
+            assert old in src, f"edit anchor missing in {rel}: {old!r}"
+            src = src.replace(old, new)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src)
+    return root
+
+
+def _proto_run(root, select):
+    return run(str(root), paths=list(DEFAULT_PATHS), select=set(select))
+
+
+def test_dt012_fixture_trees():
+    bad = run(os.path.join(FIXTURES, "proto", "dt012_bad"),
+              paths=list(DEFAULT_PATHS), select={"DT012"})
+    msgs = [f.message for f in bad]
+    assert any("'frobnicate'" in m and "no dispatcher" in m
+               for m in msgs), msgs
+    assert any("dead handler arm" in m and "'push'" in m
+               for m in msgs), msgs
+    assert any("'extra'" in m and "ever reads it" in m
+               for m in msgs), msgs
+    assert any("requires field 'key'" in m for m in msgs), msgs
+    assert any("response key 'missing'" in m for m in msgs), msgs
+    good = run(os.path.join(FIXTURES, "proto", "dt012_good"),
+               paths=list(DEFAULT_PATHS), select={"DT012"})
+    assert not good, [f.render() for f in good]
+
+
+def test_proto_pristine_copies_clean(tmp_path):
+    root = _proto_root(tmp_path)
+    findings = _proto_run(root, {"DT012", "DT013", "DT014"})
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_dt012_unhandled_send_on_client_copy(tmp_path):
+    """Inject a send of a command no dispatcher handles (the ROADMAP-1
+    resharding shape: sender written first) — DT012 flags both the
+    orphan send and the missing registry row."""
+    root = _proto_root(tmp_path, edits={
+        "dt_tpu/elastic/client.py": (
+            "def auto_client(",
+            "def reshard_probe(host, port):\n"
+            "    return protocol.request(host, port,\n"
+            "                            {\"cmd\": \"reshard\"})\n\n\n"
+            "def auto_client(")})
+    findings = _proto_run(root, {"DT012"})
+    msgs = [f.message for f in findings]
+    assert any("'reshard'" in m and "no dispatcher" in m
+               for m in msgs), msgs
+    assert any("'reshard'" in m and "PROTOCOL_REGISTRY" in m
+               for m in msgs), msgs
+
+
+def test_dt012_deleted_handler_arm_on_scheduler_copy(tmp_path):
+    """Deleting one handler arm flips DT012: the client's send goes
+    unhandled and the registry row goes dead."""
+    root = _proto_root(tmp_path, edits={
+        "dt_tpu/elastic/scheduler.py": (
+            '        if cmd == "num_dead":\n'
+            '            return {"count": '
+            'self._num_dead(float(msg.get("timeout_s", 60)))}\n',
+            "")})
+    findings = _proto_run(root, {"DT012"})
+    msgs = [f.message for f in findings]
+    assert any("'num_dead'" in m and "no dispatcher" in m
+               for m in msgs), msgs
+    assert any("dead registry row" in m and "'num_dead'" in m
+               for m in msgs), msgs
+
+
+def test_dt012_deleted_registry_row_flips(tmp_path):
+    root = _proto_root(tmp_path, edits={
+        "dt_tpu/elastic/commands.py": (
+            '    "num_dead": (\n'
+            '        "scheduler", "read_only", "exempt",\n'
+            '        "count workers silent past timeout_s '
+            '(postoffice.cc:410-429)"),\n',
+            "")})
+    findings = _proto_run(root, {"DT012"})
+    msgs = [f.message for f in findings]
+    assert any("'num_dead'" in m and "no PROTOCOL_REGISTRY row" in m
+               for m in msgs), msgs
+    # the committed catalog still lists it: stale-table finding too
+    assert any("catalog is stale" in m and "'num_dead'" in m
+               for m in msgs), msgs
+
+
+def test_dt013_register_moved_into_token_exempt(tmp_path):
+    """The acceptance scenario from the PR-6 bug class: make the
+    derived exemption view a literal that includes the mutating
+    no-dedup 'register' — DT013 flags the journaled mutation under an
+    exempt command AND the registry drift."""
+    literal = ('_TOKEN_EXEMPT = frozenset({"register", "fetch_snapshot",'
+               ' "allreduce",\n'
+               '                           "async_init", "async_push",\n'
+               '                           "async_pull_rows", '
+               '"async_stats",\n'
+               '                           "heartbeat", "num_dead", '
+               '"membership",\n'
+               '                           "servers", "obs_push", '
+               '"obs_dump",\n'
+               '                           "ha_round", "status", '
+               '"health",\n'
+               '                           "blackbox_index"})')
+    root = _proto_root(tmp_path, edits={
+        "dt_tpu/elastic/scheduler.py": (
+            '_TOKEN_EXEMPT = commands.token_exempt("scheduler")',
+            literal)})
+    findings = _proto_run(root, {"DT013"})
+    msgs = [f.message for f in findings]
+    assert any("'register'" in m and "_apply" in m for m in msgs), msgs
+    assert any("'register'" in m and "'once'" in m for m in msgs), msgs
+    assert any("drifted" in m and "'register'" in m for m in msgs), msgs
+
+
+def test_dt014_clock_inside_apply_op_on_journal_copy(tmp_path):
+    """time.time() inside a ControlState op: replay would re-stamp a
+    different value than live — the exact divergence the HA
+    journal-replay contract forbids."""
+    root = _proto_root(tmp_path, edits={
+        "dt_tpu/elastic/journal.py": (
+            "    def _op_evict(self, host: str, seq: int) -> None:\n",
+            "    def _op_evict(self, host: str, seq: int) -> None:\n"
+            "        self.stamp = time.time()\n")})
+    findings = _proto_run(root, {"DT014"})
+    hits = [f for f in findings if "_op_evict" in f.message
+            and "wall-clock" in f.message]
+    assert hits, [f.render() for f in findings]
+
+
+def test_dt014_sort_keys_and_marker_on_export_copy(tmp_path):
+    """Deleting sort_keys in a byte-deterministic surface — or the
+    marker that declares it — flips DT014 on a pristine-clean copy of
+    the real export module."""
+    rel = "dt_tpu/obs/export.py"
+    src = open(os.path.join(ROOT, *rel.split("/"))).read()
+    root = tmp_path / "fr"
+    dst = root / rel
+    dst.parent.mkdir(parents=True)
+    dst.write_text(src)
+    clean = run(str(root), paths=["dt_tpu"], select={"DT014"})
+    assert not clean, "\n".join(f.render() for f in clean)
+
+    broken = src.replace("json.dump(chrome, f, sort_keys=True)",
+                         "json.dump(chrome, f)")
+    assert broken != src
+    dst.write_text(broken)
+    findings = run(str(root), paths=["dt_tpu"], select={"DT014"})
+    assert any("sort_keys" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+    unmarked = src.replace(
+        "# deterministic: bytes — two writes of one dump are "
+        "byte-identical\n", "")
+    assert unmarked != src
+    dst.write_text(unmarked)
+    findings = run(str(root), paths=["dt_tpu"], select={"DT014"})
+    assert any("promised deterministic surface" in f.message
+               for f in findings), [f.render() for f in findings]
+
+    # renaming the promised function must not let the promise rot
+    renamed = src.replace("def write(", "def write_renamed(")
+    assert renamed != src
+    dst.write_text(renamed)
+    findings = run(str(root), paths=["dt_tpu"], select={"DT014"})
+    assert any("is gone from this module" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+def test_protocol_catalog_in_sync():
+    """docs/protocol_commands.md is generated — the committed bytes
+    must equal render_catalog() exactly (DT012 checks the cmd set; this
+    pins the whole table)."""
+    from dt_tpu.elastic import commands
+    committed = open(os.path.join(ROOT, "docs",
+                                  "protocol_commands.md")).read()
+    assert committed == commands.render_catalog(), \
+        "regenerate: python -m dt_tpu.elastic.commands > " \
+        "docs/protocol_commands.md"
+
+
+def test_derived_views_are_consistent():
+    """The servers' exemption/passive sets ARE the registry views (no
+    literal to drift), and the registry's own invariants hold."""
+    from dt_tpu.elastic import commands, range_server, scheduler
+    assert scheduler._TOKEN_EXEMPT == commands.token_exempt("scheduler")
+    assert scheduler._PASSIVE_CMDS == commands.passive_cmds()
+    assert range_server._TOKEN_EXEMPT == \
+        commands.token_exempt("range_server")
+    for cmd, (roles, idem, flags, doc) in \
+            commands.PROTOCOL_REGISTRY.items():
+        if idem == "once":
+            assert "exempt" not in flags.split("|"), cmd
+
+
+def test_sarif_round_trip(tmp_path):
+    """--sarif writes a valid SARIF 2.1.0 log whose results mirror the
+    reported findings (here: a tree with known findings, no baseline)."""
+    import json as _json
+    root = tmp_path / "s"
+    (root / "dt_tpu").mkdir(parents=True)
+    bad = open(os.path.join(FIXTURES, "dt_tpu", "dt003_bad.py")).read()
+    (root / "dt_tpu" / "mod.py").write_text(bad)
+    sarif_path = str(tmp_path / "out.sarif")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--root", str(root), "--no-cache", "--no-baseline",
+         "--select", "DT003", "--sarif", sarif_path],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    doc = _json.load(open(sarif_path))
+    assert doc["version"] == "2.1.0"
+    rundoc = doc["runs"][0]
+    rule_ids = [r["id"] for r in rundoc["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(r.id for r in all_rules())
+    results = rundoc["results"]
+    findings = run(str(root), paths=["dt_tpu"], select={"DT003"})
+    assert len(results) == len(findings) > 0
+    for res, f in zip(results, findings):
+        assert res["ruleId"] == f.rule == "DT003"
+        assert res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == f.path
+        assert loc["region"]["startLine"] == f.line
+        assert f.message in res["message"]["text"]
+    # clean tree -> zero results, exit 0, still a valid log
+    (root / "dt_tpu" / "mod.py").write_text("import os\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         "--root", str(root), "--no-cache", "--no-baseline",
+         "--select", "DT003", "--sarif", sarif_path],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert _json.load(open(sarif_path))["runs"][0]["results"] == []
+
+
+def test_cold_and_cached_runs_meet_the_perf_gates(tmp_path):
+    """The rule count hit 14 (three of them cross-file): the canonical
+    full run must stay ≤ 8 s cold and < 1 s cached — the ProtocolModel
+    rides project.data like the DT008/DT009 ClassModel cache, and the
+    result cache covers the whole verdict."""
+    import shutil
+    import time as _time
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    # run against a pristine copy of the default scope so this test
+    # never races the developer's working tree or the repo's own cache
+    root = tmp_path / "repo"
+    for rel in DEFAULT_PATHS + ("docs", "PARITY.md",
+                                "dtlint_baseline.txt"):
+        src = os.path.join(ROOT, rel)
+        dst = root / rel
+        if os.path.isdir(src):
+            shutil.copytree(src, dst)
+        elif os.path.exists(src):
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy(src, dst)
+    cli = os.path.join(ROOT, "tools", "dtlint.py")
+    t0 = _time.monotonic()
+    cold = subprocess.run(
+        [sys.executable, cli, "--root", str(root), "--no-cache"],
+        capture_output=True, text=True, env=env, timeout=120)
+    cold_s = _time.monotonic() - t0
+    assert cold.returncode == 0, cold.stdout + cold.stderr
+    assert cold_s <= 8.0, f"cold run took {cold_s:.1f}s (> 8s gate)"
+    warm = subprocess.run([sys.executable, cli, "--root", str(root)],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+    assert warm.returncode == 0, warm.stdout + warm.stderr
+    t0 = _time.monotonic()
+    cached = subprocess.run([sys.executable, cli, "--root", str(root)],
+                            capture_output=True, text=True, env=env,
+                            timeout=120)
+    cached_s = _time.monotonic() - t0
+    assert cached.returncode == 0, cached.stdout + cached.stderr
+    assert cached_s < 1.0, f"cached run took {cached_s:.2f}s (>= 1s gate)"
 
 
 # ---------------------------------------------------------------------------
@@ -607,10 +924,18 @@ def test_baseline_requires_reason(tmp_path):
 def test_rule_ids_unique_and_documented():
     rules = all_rules()
     ids = [r.id for r in rules]
-    assert len(set(ids)) == len(ids) == 11
+    assert len(set(ids)) == len(ids) == 14
     catalog = open(os.path.join(ROOT, "docs", "dtlint_rules.md")).read()
     for r in rules:
         assert r.id in catalog, f"{r.id} missing from docs/dtlint_rules.md"
+
+
+def test_repo_baseline_ships_empty():
+    """House style: true positives get FIXED, not baselined — the
+    checked-in baseline must stay empty (r8 discipline, re-pinned when
+    the r17 dtproto rules landed with their sweep's fixes applied)."""
+    baseline = Baseline.load(os.path.join(ROOT, "dtlint_baseline.txt"))
+    assert baseline.entries == {}, sorted(baseline.entries)
 
 
 def test_bench_and_chaos_run_import_without_side_effects():

@@ -273,5 +273,19 @@ def test_live_round_wait_breach_blames_straggler():
         # serves
         assert sched._metrics.hist_quantile("round.wait_ms", 0.5) \
             is not None
+        # r17: dtop --health renders the same breach over the same
+        # wire command (the operator one-liner DT012 pins a sender for)
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ, PYTHONPATH=repo)
+        board = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "dtop.py"),
+             "--scheduler", f"127.0.0.1:{sched.port}", "--health"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert board.returncode == 0, board.stdout + board.stderr
+        assert "BREACH round_wait" in board.stdout
+        assert "worker=w1" in board.stdout
     finally:
         sched.close()

@@ -495,6 +495,41 @@ def test_export_write_is_byte_deterministic(tmp_path):
         open(obs_export.metrics_path(b), "rb").read()
 
 
+def test_dtop_status_and_health_flags():
+    """r17: `dtop --status` / `--health` ride the light `status` /
+    `health` wire commands (the in-tree senders DT012's dead-arm check
+    pins) — identity/progress and the SLO view without an obs_dump
+    pull."""
+    from dt_tpu.elastic import Scheduler
+    sched = Scheduler(initial_workers=["w0", "w1"])
+    try:
+        addr = f"127.0.0.1:{sched.port}"
+        env = dict(os.environ, PYTHONPATH=REPO, DT_OBS="",
+                   DT_METRICS="")
+        dtop = os.path.join(REPO, "tools", "dtop.py")
+        st = subprocess.run(
+            [sys.executable, dtop, "--scheduler", addr, "--status"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert st.returncode == 0, st.stdout + st.stderr
+        assert "leader: yes" in st.stdout
+        assert "w0" in st.stdout and "w1" in st.stdout
+        stj = subprocess.run(
+            [sys.executable, dtop, "--scheduler", addr, "--status",
+             "--json"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert stj.returncode == 0, stj.stdout + stj.stderr
+        doc = json.loads(stj.stdout)
+        assert doc["workers"] == ["w0", "w1"] and doc["active"] is True
+        # the health view degrades gracefully when the plane is off
+        h = subprocess.run(
+            [sys.executable, dtop, "--scheduler", addr, "--health"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert h.returncode == 0, h.stdout + h.stderr
+        assert "metrics plane off" in h.stdout
+    finally:
+        sched.close()
+
+
 def test_dtop_live_scheduler_and_follow():
     """The live-poll paths: one-shot --scheduler render and a bounded
     --follow loop against an in-process scheduler, sections asserted."""

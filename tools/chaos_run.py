@@ -384,7 +384,40 @@ def main():
                          "becomes last-epoch rate >= 0.8x this (without "
                          "it, the fault-free rate is estimated as epoch "
                          "wall minus the known injected sleep)")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the pre-drill dtlint gate (r17: the "
+                         "wire-contract/determinism rules guard exactly "
+                         "the surfaces these drills exercise — don't "
+                         "spend minutes on a drill against code dtlint "
+                         "already rejects)")
     args = ap.parse_args()
+
+    if not args.no_lint:
+        # the FULL default-scope run, not --changed: DT012's cross-file
+        # wire-contract checks only fire over the whole vocabulary, and
+        # the whole-tree result cache makes this ~1 s warm / a few s
+        # cold — cheap next to a multi-minute drill
+        try:
+            lint = subprocess.run(
+                [sys.executable, os.path.join(HERE, "dtlint.py")],
+                capture_output=True, text=True, timeout=300)
+            rc, out, err = lint.returncode, lint.stdout, lint.stderr
+        except subprocess.TimeoutExpired as e:
+            rc = -1
+
+            def _salvage(stream):
+                return stream.decode(errors="replace") \
+                    if isinstance(stream, bytes) else (stream or "")
+            out = _salvage(e.stdout)
+            err = _salvage(e.stderr) + "dtlint timed out after 300 s\n"
+        if rc != 0:
+            print(out, end="", file=sys.stderr)
+            print(err, end="", file=sys.stderr)
+            what = "found issues in your working tree" if rc == 1 \
+                else f"failed to run (rc {rc})"
+            print(f"chaos_run: dtlint {what}; fix that (or pass "
+                  f"--no-lint) before the drill", file=sys.stderr)
+            return 1
 
     ha_plan = args.plan in SCHED_KILL_SITES
     policy_plan = args.plan == "straggler"

@@ -15,6 +15,7 @@ Usage::
     python tools/dtlint.py --no-baseline    # full finding set
     python tools/dtlint.py --write-baseline # grandfather current findings
     python tools/dtlint.py --fix-annotations  # insert DT008's guarded-by
+    python tools/dtlint.py --sarif out.sarif  # CI diff-annotation output
     python tools/dtlint.py --list-rules
 
 Exit codes: 0 clean (after baseline), 1 findings (or stale baseline
@@ -100,7 +101,11 @@ def _cached_findings(analysis, root, paths, select):
     sig = {"paths": list(paths), "select": sorted(select or []),
            "files": _tree_signature(root, relpaths),
            "engine_digest": _analysis_digest()}
-    for extra in ("PARITY.md", "dt_tpu/config.py"):
+    # non-linted cross-file inputs: PARITY.md (DT007), the env registry
+    # (DT005), and the r17 generated wire-command catalog (DT012) —
+    # editing any of them must invalidate the whole-tree verdict
+    for extra in ("PARITY.md", "dt_tpu/config.py",
+                  "docs/protocol_commands.md"):
         if os.path.exists(os.path.join(root, extra)):
             sig["files"][extra] = _tree_signature(root, [extra])[extra]
     cache_path = os.path.join(root, _CACHE_NAME)
@@ -218,6 +223,51 @@ def _fix_annotations(root, paths, baseline_keys=frozenset()):
     return edits
 
 
+def _write_sarif(path, analysis, reported):
+    """SARIF 2.1.0 log of the post-baseline findings (r17) — the
+    interchange format CI uses to annotate diffs (GitHub code scanning,
+    ``sarif-tools``).  One run, one rule table (id + short description
+    from each rule's docstring), one result per finding with a
+    ``physicalLocation`` region; byte-deterministic (sort_keys) like
+    every other serialized surface in this repo."""
+    rules = analysis.all_rules()
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dtlint",
+                "rules": [{
+                    "id": r.id,
+                    "name": r.name,
+                    "shortDescription": {
+                        "text": (r.__doc__ or r.name)
+                        .strip().splitlines()[0]},
+                    # repo-relative, anchor-free: heading anchors vary
+                    # by renderer, a dead link helps nobody
+                    "helpUri": "docs/dtlint_rules.md",
+                } for r in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message
+                            + (f"  [hint: {f.hint}]" if f.hint else "")},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path,
+                                             "uriBaseId": "SRCROOT"},
+                        "region": {"startLine": max(f.line, 1),
+                                   "snippet": {"text": f.snippet}},
+                    }}],
+            } for f in reported],
+        }],
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="dtlint", description=__doc__,
@@ -243,6 +293,10 @@ def main(argv=None):
                     help="insert the '# guarded-by:' comments DT008 "
                          "suggests (idempotent), then exit")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write the post-baseline findings as a "
+                         "SARIF 2.1.0 log (CI diff annotation); exit "
+                         "code is unchanged")
     ap.add_argument("--json", action="store_true",
                     help="one JSON object per finding, then one "
                          "rule_timings_ms summary object")
@@ -329,6 +383,8 @@ def main(argv=None):
     stale = [] if (args.no_baseline or not full_scope) else \
         baseline.stale(findings)
 
+    if args.sarif:
+        _write_sarif(args.sarif, analysis, reported)
     for f in reported:
         print(json.dumps(vars(f)) if args.json else f.render())
     if args.json:

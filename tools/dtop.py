@@ -59,12 +59,8 @@ if "dt_tpu" not in sys.modules:
 def _load_chrome(args):
     from dt_tpu.obs import export as obs_export
     if args.scheduler:
-        host, _, port = args.scheduler.rpartition(":")
-        from dt_tpu.elastic import protocol
-        resp = protocol.request(host or "127.0.0.1", int(port),
-                                {"cmd": "obs_dump"}, timeout=30)
-        if "error" in resp:
-            raise SystemExit(f"scheduler error: {resp['error']}")
+        resp = _sched_request(args.scheduler, {"cmd": "obs_dump"},
+                              timeout=30)
         return obs_export.chrome_trace(resp["job"])
     if not args.trace:
         raise SystemExit("give a trace file or --scheduler host:port")
@@ -441,6 +437,80 @@ def render_postmortem(bundle, manifest_rows=None, path="") -> str:
     return "\n".join(lines)
 
 
+def _sched_request(spec: str, msg: dict, timeout: float = 10.0) -> dict:
+    """One control request against a live ``host:port`` scheduler —
+    shared by the ``obs_dump`` pull and the r17 ``status``/``health``
+    introspection commands (PROTOCOL_REGISTRY), which answer on PASSIVE
+    standbys too and cost none of ``obs_dump``'s payload."""
+    from dt_tpu.elastic import protocol
+    host, _, port = spec.rpartition(":")
+    try:
+        portnum = int(port)
+    except ValueError:
+        raise SystemExit(f"--scheduler needs host:port, got {spec!r}")
+    resp = protocol.request(host or "127.0.0.1", portnum, msg,
+                            timeout=timeout)
+    if "error" in resp:
+        raise SystemExit(f"scheduler error: {resp['error']}")
+    return resp
+
+
+def render_status(resp: dict) -> str:
+    """The ``status`` command's one-screen identity/progress view:
+    leadership + incarnation (docs/ha.md), membership, epoch progress,
+    the straggler board, and the applied policy shares."""
+    lines = [f"leader: {'yes' if resp.get('active') else 'PASSIVE'}   "
+             f"incarnation: {resp.get('incarnation', 0)}   "
+             f"last_completed_epoch: "
+             f"{resp.get('last_completed_epoch', -1)}"]
+    lines.append("workers: " + (", ".join(resp.get("workers", []))
+                                or "(none)"))
+    strag = resp.get("straggler") or {}
+    if strag:
+        lines.append("straggler board (round-lag EWMA ms): " + "  ".join(
+            f"{h}={v:.1f}" for h, v in sorted(strag.items())))
+    pol = resp.get("policy") or {}
+    if pol.get("enabled"):
+        shares = pol.get("shares") or {}
+        lines.append(
+            f"policy: seq={pol.get('seq', 0)} lr_scale="
+            f"{pol.get('lr_scale', 1.0)} shares=" + (" ".join(
+                f"{h}:{u}" for h, u in sorted(shares.items())) or "-"))
+    return "\n".join(lines)
+
+
+def render_health(resp: dict) -> str:
+    """The ``health`` command's SLO/gauge view (the r15 training-health
+    surface the serving plane scrapes)."""
+    h = resp.get("health") or {}
+    if not h.get("enabled"):
+        return "metrics plane off (DT_METRICS=0)"
+    lines = []
+    slo = h.get("slo") or {}
+    active = slo.get("active") or {}
+    lines.append(f"SLO: {len(active)} active breach(es)")
+    for rule, b in sorted(active.items()):
+        lines.append(f"  BREACH {rule}: worker="
+                     f"{b.get('worker') or '-'} value={b.get('value')} "
+                     f"threshold={b.get('threshold')}")
+    gauges = h.get("gauges") or []
+    if gauges:
+        parts = []
+        for name, labels, val in gauges:
+            lk = ",".join(f"{k}={v}" for k, v in sorted(dict(labels)
+                                                        .items()))
+            parts.append(f"{name}{{{lk}}}={val}" if lk
+                         else f"{name}={val}")
+        lines.append("scheduler gauges: " + "  ".join(parts))
+    workers = h.get("workers") or {}
+    for track, w in sorted(workers.items()):
+        g = "  ".join(f"{k}={v}" for k, v in
+                      sorted((w.get("gauges") or {}).items()))
+        lines.append(f"  {track}: samples={w.get('samples', 0)} "
+                     f"dropped={w.get('dropped', 0)}  {g}")
+    return "\n".join(lines)
+
+
 def _follow(args) -> int:
     """Live mode: poll the scheduler's ``obs_dump`` and re-render a
     compact board each cycle.  The step RATE is computed from the delta
@@ -506,7 +576,29 @@ def main(argv=None):
                          "decomposition on every worker track (STEP "
                          "indexes each track's own recorded steps; a "
                          "restarted incarnation recounts from 0)")
+    ap.add_argument("--status", action="store_true",
+                    help="one-screen scheduler identity/progress via "
+                         "the light 'status' command (answers on a "
+                         "passive standby too) instead of obs_dump")
+    ap.add_argument("--health", action="store_true",
+                    help="the r15 SLO/gauge training-health view via "
+                         "the 'health' command instead of obs_dump")
     args = ap.parse_args(argv)
+
+    if args.status or args.health:
+        if not args.scheduler:
+            raise SystemExit("--status/--health need --scheduler "
+                             "host:port")
+        resp = _sched_request(args.scheduler, {"cmd": "status"}) \
+            if args.status else \
+            _sched_request(args.scheduler, {"cmd": "health"})
+        if args.json:
+            print(json.dumps(resp, indent=2, sort_keys=True,
+                             default=repr))
+        else:
+            print(render_status(resp) if args.status
+                  else render_health(resp))
+        return 0
 
     if args.postmortem:
         bundle, rows, bpath = load_postmortem(args.postmortem)
