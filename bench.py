@@ -434,6 +434,8 @@ def measure_tier(net, batch, size):
     # donating call deletes the input buffers, and AOT avoids lowering
     # twice
     phase("compiling train step")
+    from dt_tpu.obs import device as obs_device
+    cache = obs_device.cache_probe()
     t_compile = time.perf_counter()
     compiled = step.lower(state, x, y).compile()
     step_flops = _compiled_flops(compiled)
@@ -508,7 +510,14 @@ def measure_tier(net, batch, size):
         "sync_agreement": sync_agreement,
         "num_chips": num_chips,
         "value_per_chip": round(imgs_per_sec / num_chips, 2),
-        "compile_s": round(t_compile, 1),
+        # r18 capture discipline (ROADMAP 5): a wedged-tunnel retry can
+        # prove the persistent cache saved recompilation from the
+        # committed jsonl row alone (renamed from the old compile_s —
+        # no consumer read it, one canonical field)
+        "compile_time_s": round(t_compile, 1),
+        "cache_hits": int(cache.outcome() == "hit"),
+        "cache_misses": int(cache.outcome() == "miss"),
+        "compile_cache": cache.outcome(),
         "model_tflops_per_sec": round(model_tflops, 2) if flops_per_img
         else None,
         "flops_source": flops_source,
@@ -586,6 +595,8 @@ def measure_tier_lm():
 
     step = jax.jit(train_step, donate_argnums=(0,))
     phase("compiling LM train step")
+    from dt_tpu.obs import device as obs_device
+    cache = obs_device.cache_probe()
     t_compile = time.perf_counter()
     compiled = step.lower(state, toks).compile()
     step_flops = _compiled_flops(compiled)
@@ -627,7 +638,10 @@ def measure_tier_lm():
                                 / max(queued, synced), 3),
         "num_chips": num_chips,
         "tokens_per_sec_per_chip": round(tokens_per_sec / num_chips, 1),
-        "compile_s": round(t_compile, 1),
+        "compile_time_s": round(t_compile, 1),
+        "cache_hits": int(cache.outcome() == "hit"),
+        "cache_misses": int(cache.outcome() == "miss"),
+        "compile_cache": cache.outcome(),
         "model_tflops_per_sec": round(model_tflops, 2)
         if model_tflops else None,
         "flops_source": "compiler" if step_flops else None,

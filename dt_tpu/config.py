@@ -97,6 +97,9 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "DT_BLACKBOX_MAX_MB": ("8", "per-bundle size cap (MiB), best-effort: ring tails trimmed first, thread stacks truncated last"),
     "DT_BLACKBOX_MAX_BUNDLES": ("64", "per-directory bundle retention cap: oldest bundles pruned on write (manifest rows are kept)"),
     "DT_HANG_S": ("120", "step/fleet-progress stall threshold (seconds) before the hang watchdog dumps a live bundle"),
+    # device-plane observability (dt_tpu/obs/device.py, r18 —
+    # docs/observability.md)
+    "DT_DEVICE_OBS": ("", "1 = arm the device plane: compile.* spans + recompile-cause ledger, device.hbm_* gauges, OOM census bundles, on-demand profile_capture (chaos arms it; works with DT_OBS=0)"),
     # policy engine (dt_tpu/policy — straggler-adaptive dynamic mini-batch
     # + autoscaling; docs/policy.md)
     "DT_POLICY": ("", "1 = enable the scheduler-side policy engine (batch-share rebalancing, auto-eviction, scale proposals)"),

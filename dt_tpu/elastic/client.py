@@ -24,6 +24,7 @@ import numpy as np
 from dt_tpu import config
 from dt_tpu.elastic import faults, protocol
 from dt_tpu.obs import blackbox as obs_blackbox
+from dt_tpu.obs import device as obs_device
 from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 
@@ -205,7 +206,15 @@ class WorkerClient:
         self._hm_pending: list = []  # guarded-by: _hm_lock
         self._hm_shed = 0  # guarded-by: _hm_lock
         self._hm_gseq = 0  # gauge/hist snapshot ordering; guarded-by: _hm_lock
-        self._hm_sampler = obs_metrics.Sampler(obs_metrics.registry()) \
+        # the r18 device plane rides the same sampler: when both planes
+        # are armed the hook sets the device.hbm_*/rss/staging gauges
+        # each cadence, so they ship with the existing hm export — and
+        # every heartbeat carries the small `dev` view (compile totals,
+        # compiling-now flag, memory snapshot) the scheduler's device
+        # section and fleet-hang detector consume
+        self._dev_export = obs_device.enabled()
+        self._hm_sampler = obs_metrics.Sampler(
+            obs_metrics.registry(), hook=obs_device.metrics_hook()) \
             if self._hm_export else None
         # r16 flight recorder (dt_tpu/obs/blackbox.py): arm the process
         # crash hooks (SIGTERM/excepthook/faulthandler — idempotent,
@@ -449,6 +458,12 @@ class WorkerClient:
                     and obs_metrics.enabled() else None
                 if hm is not None:
                     msg["hm"] = hm
+                # the r18 device view rides too (tiny; eligibility
+                # captured at construction like the exports above)
+                dev = obs_device.wire_payload() if self._dev_export \
+                    and obs_device.enabled() else None
+                if dev is not None:
+                    msg["dev"] = dev
                 # retries=1: a lost heartbeat is superseded by the next
                 # interval's; a long retry loop would only delay close()
                 if obs_trace.enabled():
@@ -460,6 +475,12 @@ class WorkerClient:
                     self._hm_ack(hm)
                 for c in resp.get("profile_cmds", []):
                     self._apply_profile_cmd(c)
+                if dev is not None:
+                    # targeted r18 capture commands (profile_capture):
+                    # seq-guarded in the device plane, so at-least-once
+                    # re-delivery is a no-op
+                    obs_device.handle_capture_cmds(
+                        resp.get("capture_cmds"), host=self.host)
             except (OSError, RuntimeError):
                 pass  # scheduler gone; dead-node detection is its problem
             self._stop.wait(interval)

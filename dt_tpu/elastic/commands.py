@@ -95,6 +95,12 @@ PROTOCOL_REGISTRY: Mapping[str, Tuple[str, str, str, str]] = {
         "scheduler", "idempotent", "",
         "rank-0-drives-all profiler command post; (host, post_seq) "
         "dedups replays (kvstore_dist_server.h:275-322)"),
+    "profile_capture": (
+        "scheduler", "idempotent", "",
+        "queue a bounded N-step jax.profiler capture on ONE worker "
+        "(r18 device plane): delivered on the target's next heartbeat, "
+        "trace lands in DT_BLACKBOX_DIR + manifest.jsonl; "
+        "(host, post_seq) dedups replays like 'profile'"),
     "shutdown": (
         "scheduler|range_server", "idempotent", "passive|external",
         "remote shutdown of the serving process (idempotent close); "

@@ -240,6 +240,18 @@ class Scheduler:
         self._profile_cmds: List[dict] = []  # guarded-by: _lock
         self._profile_seq = 0  # guarded-by: _lock
         self._profile_posted: Dict[tuple, int] = {}  # retry dedup; guarded-by: _lock
+        # r18 device plane (dt_tpu/obs/device.py): the latest per-host
+        # heartbeat `dev` view (compile totals, compiling-now flag,
+        # memory snapshot) — obs_dump/health carry it, the fleet-hang
+        # detector demotes a compiling worker's blame — plus the
+        # targeted profile_capture command queue (delivered on the
+        # target's next heartbeat, (host, post_seq) retry dedup exactly
+        # like the broadcast profiler commands above)
+        self._dev_lock = threading.Lock()
+        self._dev_tracks: Dict[str, dict] = {}  # guarded-by: _dev_lock
+        self._capture_cmds: List[dict] = []  # guarded-by: _lock
+        self._capture_seq = 0  # guarded-by: _lock
+        self._capture_posted: Dict[tuple, int] = {}  # guarded-by: _lock
         # idempotency-token response cache (protocol.request reliable
         # mode); TTL + LRU bound its memory on a long-running scheduler
         self._tokens = protocol.TokenCache(
@@ -478,19 +490,56 @@ class Scheduler:
             if stalled:
                 oldest = max(stalled, key=lambda p: p["age_s"])
                 scores = self._dp.straggler_scores()
+                # r18: a waited-on worker whose heartbeat dev view says
+                # it is mid-XLA-compile is doing legitimate work, not
+                # wedged — demote it below every non-compiling waiter
+                # (and label the suspect) so a recompiling-after-resize
+                # worker is not blamed for a hang it isn't causing.
+                # BOUNDED demotion: only while the dev view is FRESH
+                # (a dead worker's frozen track must not deflect blame
+                # until eviction) and the compile's own age is under
+                # max(10x the hang threshold, 5 min) — a worker WEDGED
+                # inside lower().compile() (the r4 axon-tunnel failure
+                # mode) becomes blamable again, still carrying the
+                # compile label so the post-mortem names the wedge
+                # site.  When every eligible waiter is compiling, the
+                # worst straggler still gets named, labeled.
+                demote_max = max(10.0 * threshold, 300.0)
+                now = time.time()
+                with self._dev_lock:
+                    compiling = {
+                        h for h, v in self._dev_tracks.items()
+                        if v.get("compiling")
+                        and now - v.get("_ts", 0.0) <= 2.0 * threshold
+                        and float(v.get("compiling_age_s", 0.0))
+                        <= demote_max}
+                    labeled = {h for h, v in self._dev_tracks.items()
+                               if v.get("compiling")}
                 blamed = max(oldest["waiting"],
-                             key=lambda h: scores.get(h, 0.0))
+                             key=lambda h: (h not in compiling,
+                                            scores.get(h, 0.0)))
                 cur = {"round": oldest["key"],
                        "age_s": oldest["age_s"],
                        "waiting": oldest["waiting"],
                        "contributed": oldest["contributed"],
                        "blamed": blamed,
                        "straggler_ms": round(scores.get(blamed, 0.0), 3)}
+                if blamed in labeled:
+                    cur["compile_in_progress"] = True
+                if labeled & set(oldest["waiting"]):
+                    cur["compiling"] = sorted(
+                        labeled & set(oldest["waiting"]))
                 if was is None:
                     self._bb_suspect = cur
                     fired = cur
                 else:
                     was.update(cur)  # refresh age/blame, no re-fire
+                    for k in ("compile_in_progress", "compiling"):
+                        # conditional keys must CLEAR on refresh — a
+                        # finished compile's label sticking to a now-
+                        # genuine wedge would mislead the post-mortem
+                        if k not in cur:
+                            was.pop(k, None)
             elif was is not None:
                 self._bb_suspect = None
                 cleared = True
@@ -829,6 +878,12 @@ class Scheduler:
         out = {"tracks": tracks,
                "straggler": self._dp.straggler_scores(),
                "policy": pol}
+        dev = self._dev_view()
+        if dev["workers"]:
+            # the r18 device section rides the dump like policy/health:
+            # export threads it through otherData to .metrics.json and
+            # dtop's device board
+            out["device"] = dev
         if self._metrics is not None:
             # the r15 time-series + health sections ride the dump so
             # export.write lands them in .metrics.json and dtop's health
@@ -891,6 +946,45 @@ class Scheduler:
                                (payload.get("hists") or ())]
                 tr["dropped"] = int(payload.get("dropped",
                                                 tr["dropped"]))
+
+    def _dev_ingest(self, host: str, payload: dict) -> None:
+        """Keep the NEWEST per-host device view (heartbeat ``dev``
+        section).  ``dseq`` orders payloads on the at-least-once
+        channel — a delayed/duplicated old beat must not roll the view
+        back (resurrecting a cleared ``compiling`` flag would feed the
+        fleet-blame demotion stale facts); the ingest wall-clock rides
+        as ``_ts`` so the demotion can require a FRESH view.  Bounded
+        by the worker set plus the same LRU cap as the other
+        ingests."""
+        with self._dev_lock:
+            tr = self._dev_tracks.get(host)
+            dseq = int(payload.get("dseq", 0))
+            if tr is not None and dseq and int(tr.get("dseq", 0)) >= dseq:
+                return  # stale or duplicated beat
+            self._dev_tracks.pop(host, None)
+            entry = dict(payload)
+            entry["_ts"] = time.time()
+            self._dev_tracks[host] = entry
+            while len(self._dev_tracks) > _OBS_MAX_TRACKS:
+                del self._dev_tracks[next(iter(self._dev_tracks))]
+
+    def _dev_forget(self, hosts) -> None:
+        """Membership removals scrub the device view too (the
+        ``_metrics_forget`` analog): an evicted worker must not keep
+        advertising a frozen compile/memory row."""
+        hosts = set(hosts)
+        with self._dev_lock:
+            for h in hosts:
+                self._dev_tracks.pop(h, None)
+
+    def _dev_view(self) -> dict:
+        """The obs_dump/health device section: per-host compile +
+        memory views, plus which hosts report a compile in progress."""
+        with self._dev_lock:
+            workers = {h: dict(v) for h, v in self._dev_tracks.items()}
+        return {"workers": workers,
+                "compiling": sorted(h for h, v in workers.items()
+                                    if v.get("compiling"))}
 
     def _metrics_forget(self, hosts) -> None:
         """Membership removals scrub the per-worker metrics state (the
@@ -979,12 +1073,16 @@ class Scheduler:
                     "gauges": dict(t["samples"][-1].get("gauges") or {})
                     if t["samples"] else {}}
                 for k, t in sorted(self._hm_tracks.items())}
-        return {"enabled": True,
-                "interval_s": obs_metrics.interval_s(),
-                "slo": self._slo.state(),
-                "gauges": self._metrics.gauges_export(),
-                "hists": self._metrics.hists_export(),
-                "workers": workers}
+        out = {"enabled": True,
+               "interval_s": obs_metrics.interval_s(),
+               "slo": self._slo.state(),
+               "gauges": self._metrics.gauges_export(),
+               "hists": self._metrics.hists_export(),
+               "workers": workers}
+        dev = self._dev_view()
+        if dev["workers"]:
+            out["device"] = dev  # r18: the health RPC carries it too
+        return out
 
     def metrics_text(self) -> str:
         """Prometheus text exposition: the scheduler/process registry
@@ -1099,11 +1197,25 @@ class Scheduler:
             hm = msg.get("hm")
             if hm is not None:
                 self._hm_ingest(msg["host"], hm)
+            dev = msg.get("dev")
+            if dev is not None:
+                self._dev_ingest(msg["host"], dev)
             with self._lock:
                 self._heartbeats[msg["host"]] = time.time()
                 pseq = int(msg.get("pseq", 0))
                 newer = [c for c in self._profile_cmds if c["seq"] > pseq]
-            return {"profile_cmds": newer} if newer else {}
+                caps = []
+                if dev is not None:
+                    cseq = int(dev.get("cseq", 0))
+                    caps = [c for c in self._capture_cmds
+                            if c["target"] == msg["host"]
+                            and c["seq"] > cseq]
+            out = {}
+            if newer:
+                out["profile_cmds"] = newer
+            if caps:
+                out["capture_cmds"] = caps
+            return out
         if cmd == "obs_push":
             # synchronous flush (worker close / injected-crash path);
             # rseq/sample-seq dedup makes replays idempotent
@@ -1164,6 +1276,40 @@ class Scheduler:
                         self._profile_posted.pop(
                             next(iter(self._profile_posted)))
                 return {"seq": self._profile_seq}
+        if cmd == "profile_capture":
+            # r18 device plane: queue a bounded N-step jax.profiler
+            # capture on ONE worker; delivered on the target's next
+            # heartbeat (dev.cseq dedups), (host, post_seq) dedups
+            # at-least-once client retries exactly like "profile"
+            with self._lock:
+                key = (msg.get("host"), msg.get("post_seq"))
+                if key[0] is not None and key in self._capture_posted:
+                    return {"seq": self._capture_posted[key]}
+                if msg["target"] not in self._state.workers:
+                    # a typo'd/absent target would queue a command only
+                    # a heartbeat from that exact host could ever
+                    # collect — "queued: true" forever; fail the
+                    # operator loudly instead.  (A live worker running
+                    # without DT_DEVICE_OBS also never collects — its
+                    # heartbeats carry no dev view — which the error
+                    # message documents.)
+                    return {"error":
+                            f"profile_capture target {msg['target']!r} "
+                            f"is not a live worker (live: "
+                            f"{sorted(self._state.workers)}); note the "
+                            f"target must run with DT_DEVICE_OBS=1"}
+                self._capture_seq += 1
+                self._capture_cmds.append(
+                    {"seq": self._capture_seq,
+                     "target": msg["target"],
+                     "steps": int(msg.get("steps", 8))})
+                del self._capture_cmds[:-16]  # bounded history
+                if key[0] is not None:
+                    self._capture_posted[key] = self._capture_seq
+                    while len(self._capture_posted) > 128:
+                        self._capture_posted.pop(
+                            next(iter(self._capture_posted)))
+                return {"seq": self._capture_seq}
         if cmd in DataPlane.CMDS:
             if cmd == "allreduce":
                 # a named scheduler-crash site INSIDE the data-plane
@@ -1286,6 +1432,7 @@ class Scheduler:
                 self._audit_locked("REMOVED", host)
                 self._dp.hosts_removed({host})
                 self._metrics_forget({host})
+                self._dev_forget({host})
                 self._rewrite_host_file([host])
                 self._complete_pending_locked()
             if host in st.removed_hosts:
@@ -1383,6 +1530,7 @@ class Scheduler:
                         self._audit_locked("REMOVED", h)
                     self._dp.hosts_removed(set(dead))
                     self._metrics_forget(dead)
+                    self._dev_forget(dead)
                     self._rewrite_host_file(dead)
                     # _complete_pending_locked journal-appends too
                     # (barrier_complete / mc_* ops) — a Fenced escaping
@@ -1599,6 +1747,7 @@ class Scheduler:
                 self._audit_locked("REMOVED", h)
             self._dp.hosts_removed(removable)
             self._metrics_forget(removable)
+            self._dev_forget(removable)
         else:
             # identity reissue first (van.cc:187-218): evicted-but-
             # restarted hosts come back AS THEMSELVES — base protection

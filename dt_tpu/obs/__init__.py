@@ -1,6 +1,7 @@
 """dt_tpu.obs — structured tracing + metrics for the elastic control/data
 plane (see ``dt_tpu/obs/trace.py`` for the core API,
-``dt_tpu/obs/metrics.py`` for the r15 gauge/histogram/health plane, and
+``dt_tpu/obs/metrics.py`` for the r15 gauge/histogram/health plane,
+``dt_tpu/obs/device.py`` for the r18 compile/HBM device plane, and
 ``dt_tpu/obs/export.py`` for the merged chrome://tracing export)."""
 
 from dt_tpu.obs.metrics import (HealthHalt, MetricsRegistry, SLOEngine,

@@ -562,7 +562,16 @@ class Watchdog:
     def tick(self) -> bool:
         """One stall check; True when the watchdog fired this tick
         (edge-triggered: a continuing stall fires once, not per
-        tick)."""
+        tick).
+
+        r18 compile labeling: when the tracer's open-span table shows a
+        ``compile.*`` span in flight, the stall is (so far) the XLA
+        compiler working, not a wedge — the bundle/event carry
+        ``compile=<span name>`` + ``compile_in_progress=True`` so a
+        post-mortem (and the chaos hang gate) can tell the labeled
+        compile stall from the real hang, which arrives as the first
+        UNLABELED bundle.  The firing stays edge-triggered either way:
+        a compile that then wedges is already on record."""
         now = self._mono()
         with self._lock:
             stalled = now - self._last_beat
@@ -572,6 +581,12 @@ class Watchdog:
             step = self._last_step
         attrs = {"host": self.host, "stalled_s": round(stalled, 3),
                  "last_step": step, "hang_s": self.hang_seconds}
+        comp = next((s["name"] for s in self._tr().open_spans()
+                     if str(s.get("name", "")).startswith("compile.")),
+                    None)
+        if comp is not None:
+            attrs["compile"] = comp
+            attrs["compile_in_progress"] = True
         self._tr().event("hang.suspect", attrs)
         note("hang.suspect", **attrs)
         write_bundle("hang", host=self.host, fatal=False, extra=attrs,

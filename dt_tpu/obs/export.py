@@ -86,6 +86,11 @@ def chrome_trace(job: Dict[str, Any]) -> Dict[str, Any]:
         other["health"] = dict(job["health"] or {})
     if "metrics" in job:
         other["metrics"] = dict(job["metrics"] or {})
+    if "device" in job:
+        # r18 device plane (compile observatory + memory view) rides
+        # the export like policy/health: dtop's device board and the
+        # chaos compile/memory cross-checks read it from the summary
+        other["device"] = dict(job["device"] or {})
     # pass 1: index every id-carrying span by (track, sid) so pass 2 can
     # bind flow starts to the exact client slice
     span_at: Dict[tuple, dict] = {}
@@ -299,6 +304,7 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
     membership: List[dict] = []
     failovers: List[dict] = []
     leadership: List[dict] = []
+    recompiles: Dict[str, List[dict]] = {}  # r18 compile.recompile fold
     total_faults = 0
     for ev in chrome.get("traceEvents", ()):
         if ev.get("ph") in ("M", "s", "f", "t"):
@@ -339,6 +345,15 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
                 kind = name[len("fault."):]
                 tr["faults"][kind] = tr["faults"].get(kind, 0) + 1
                 total_faults += 1
+            if name == "compile.recompile":
+                # r18 recompile-cause timeline: each event names its
+                # signature delta; the fold below feeds the device
+                # board and the chaos recompile-churn gate
+                recompiles.setdefault(track, []).append(
+                    {"ts": ev.get("ts"),
+                     **{k: v for k, v in (ev.get("args") or {}).items()
+                        if k in ("what", "changed", "cache",
+                                 "elapsed_ms")}})
             if name in ("leader.elected", "leader.fenced"):
                 # leader-incarnation timeline: elections (primary start +
                 # failover takeovers) and fencings, job-wide order
@@ -392,6 +407,15 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
            "policy": dict((chrome.get("otherData") or {})
                           .get("policy") or {})}
     out.update(_causal_and_critical(chrome, track_of_pid))
+    # r18 device section: the scheduler's per-host compile/memory view
+    # (otherData) plus the recompile-cause timeline folded from the
+    # compile.recompile events above
+    device = dict((chrome.get("otherData") or {}).get("device") or {})
+    if recompiles:
+        device["recompiles_by_track"] = {
+            t: sorted(v, key=lambda e: e.get("ts") or 0)
+            for t, v in sorted(recompiles.items())}
+    out["device"] = device
     # r15 health plane: thread the scheduler's SLO/gauge state + the
     # per-track time-series through, then run the post-hoc SLO pass over
     # export-derived inputs (the causal join only exists here — the

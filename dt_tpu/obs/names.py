@@ -144,6 +144,40 @@ NAME_REGISTRY: Mapping[str, Tuple[str, str]] = {
                               "blamed worker)"),
     "hang.clear": ("event", "a suspected hang recovered (progress "
                             "resumed / the stalled round completed)"),
+    # -- device plane (obs/device.py, r18) ---------------------------------
+    "compile.*": ("span", "one XLA compile of an instrumented step "
+                          "(compile.<what>); open while the compiler "
+                          "runs, so hang bundles can label a "
+                          "compile-in-progress stall"),
+    "compile.recompile": ("event", "an instrumented step compiled AGAIN "
+                                   "(attrs name the signature delta: "
+                                   "shape/dtype/mesh/donate/nargs, or "
+                                   "'rebuild' for an identical-signature "
+                                   "elastic rebuild)"),
+    "compile.compiles": ("counter", "XLA compiles observed by the device "
+                                    "plane"),
+    "compile.cache_hits": ("counter", "compiles served from the "
+                                      "DT_JAX_CACHE_DIR persistent cache"),
+    "compile.cache_misses": ("counter", "compiles that wrote fresh "
+                                        "persistent-cache entries"),
+    "device.hbm_bytes": ("gauge", "per-device HBM bytes in use "
+                                  "(jax.Device.memory_stats)"),
+    "device.hbm_peak_bytes": ("gauge", "per-device peak HBM bytes in use"),
+    "device.hbm_limit_bytes": ("gauge", "per-device HBM capacity"),
+    "device.host_rss_bytes": ("gauge", "process resident-set bytes (the "
+                                       "CPU fallback when the backend "
+                                       "reports no HBM stats)"),
+    "device.staging_bytes": ("gauge", "overlap StagingPool pooled host "
+                                      "bytes (free-list occupancy)"),
+    "device.staging_outstanding": ("gauge", "overlap StagingPool buffers "
+                                            "acquired and not yet "
+                                            "released"),
+    "device.oom": ("event", "a RESOURCE_EXHAUSTED allocation failure was "
+                            "caught; the OOM bundle carries the "
+                            "live-buffer census"),
+    "profile.capture": ("event", "a bounded on-demand jax.profiler "
+                                 "capture finished (profile_capture "
+                                 "wire command; trace dir in attrs)"),
     # -- fault injection (elastic/faults.py) -------------------------------
     "fault.*": ("event", "every APPLIED fault (fault.<kind>); the chaos "
                          "harness cross-checks these against "

@@ -79,7 +79,12 @@ class Predictor:
         self._init_serving(fwd, batch_buckets, max_batch)
 
     def _init_serving(self, fwd, batch_buckets, max_batch):
-        self._fwd = jax.jit(fwd)
+        # r18 compile observatory (dt_tpu/obs/device.py): each bucket's
+        # compile runs inside a compile.predictor span with the cache
+        # hit/miss + recompile-cause ledger; a no-op wrapper (the jit
+        # fn unchanged) when DT_DEVICE_OBS=0
+        from dt_tpu.obs import device as obs_device
+        self._fwd = obs_device.instrument("predictor", jax.jit(fwd))
         self.batch_buckets = sorted(batch_buckets) if batch_buckets \
             else _default_buckets(max_batch)
         self.stats = {"requests": 0, "rows": 0, "compiles": 0,

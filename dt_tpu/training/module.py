@@ -39,6 +39,7 @@ import numpy as np
 import optax
 
 from dt_tpu import config as config_lib
+from dt_tpu.obs import device as obs_device
 from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 from dt_tpu.ops import losses as losses_lib
@@ -216,6 +217,15 @@ class Module:
         self._sentinel = False
         self._halt = False
         self.health_halted = False
+        # r18 device plane: how many times the elastic fit loop rebuilt
+        # the distributed world (and therefore recompiled the steps) vs
+        # merely resharded data (membership/policy signature changes).
+        # The chaos recompile-churn gate holds the device ledger to
+        # these: a share-only rebalance may reshape batches (shape-
+        # caused recompiles, bounded by `resharded`) but must cause
+        # ZERO program rebuilds (`mesh_rebuilds` stays 0).
+        self.mesh_rebuilds = 0
+        self.resharded = 0
 
     # ------------------------------------------------------------------
     # Binding / init
@@ -424,9 +434,37 @@ class Module:
                        mesh_lib.data_sharding(mesh))
         if sentinel:
             step_out_sh = step_out_sh + (replicated,)
-        self._train_step = jax.jit(train_step, donate_argnums=donate,
-                                   out_shardings=step_out_sh)
-        self._eval_step = jax.jit(eval_step)
+        # r18 compile observatory (dt_tpu/obs/device.py): each compiled
+        # surface is wrapped so its XLA compiles run inside compile.*
+        # spans with a recompile-cause ledger; with DT_DEVICE_OBS off
+        # instrument() returns the jit fn UNCHANGED
+        _dev_meta = {"mesh": dict(mesh.shape), "donate": donate}
+        self._train_step = obs_device.instrument(
+            "train_step", jax.jit(train_step, donate_argnums=donate,
+                                  out_shardings=step_out_sh), _dev_meta)
+        self._eval_step = obs_device.instrument(
+            "eval_step", jax.jit(eval_step), _dev_meta)
+        if obs_device.enabled() and self.state is not None:
+            # provenance shape sets for the live-buffer census (OOM
+            # forensics): params/opt-state-shaped buffers get tagged.
+            # Weak self: the provider reads the LIVE state's shapes and
+            # must not pin the build-time arrays (or this Module) alive.
+            import weakref
+            _ref = weakref.ref(self)
+
+            def _shapes(attr):
+                m = _ref()
+                if m is None or m.state is None:
+                    return set()
+                return {(str(tuple(np.shape(x))),
+                         str(getattr(x, "dtype", np.float32)))
+                        for x in jax.tree_util.tree_leaves(
+                            getattr(m.state, attr))}
+
+            obs_device.register_provenance(
+                "params", lambda: _shapes("params"))
+            obs_device.register_provenance(
+                "opt_state", lambda: _shapes("opt_state"))
 
         # host-sync two-phase variant: grads AND new BN stats ride the same
         # flattened allreduce, so running stats stay bit-identical across
@@ -466,8 +504,10 @@ class Module:
                 new_state = apply(None)
             return new_state, health
 
-        self._grad_step = jax.jit(grad_step)
-        self._apply_step = jax.jit(apply_step)
+        self._grad_step = obs_device.instrument(
+            "grad_step", jax.jit(grad_step), _dev_meta)
+        self._apply_step = obs_device.instrument(
+            "apply_step", jax.jit(apply_step), _dev_meta)
 
     @staticmethod
     def _coverage(tree, shardings, replicated):
@@ -720,9 +760,11 @@ class Module:
                         core_changed = new_sig[:-1] != members[:-1]
                         members = new_sig
                         num_workers = self.kv.num_workers
+                        self.resharded += 1
                         if core_changed and self.mesh_manager is not None:
                             # rebuild the distributed world + mesh, reshard the
                             # live state, recompile the steps for the new mesh
+                            self.mesh_rebuilds += 1
                             self._mesh, self.state = self.mesh_manager.rebuild(
                                 self.state, num_workers, self.kv.rank)
                             self._build_steps()
@@ -907,6 +949,10 @@ class Module:
                         # step progress reached the deadman; nbatch is
                         # the bundle's "last step seen alive" evidence
                         _bb_dog.beat(step=nbatch)
+                    # r18 on-demand jax.profiler capture: one global
+                    # None-check per step unless a profile_capture
+                    # command armed a bounded trace
+                    obs_device.capture_tick()
                     if _mt0 is not None:
                         obs_metrics.registry().observe(
                             "step.ms", (time.monotonic() - _mt0) * 1000.0)
@@ -979,9 +1025,20 @@ class Module:
                     if eval_end_callback is not None:
                         eval_end_callback(epoch, validation_metric)
 
+        except Exception as e:
+            # r18 OOM forensics: a RESOURCE_EXHAUSTED death writes a
+            # bundle carrying the live-buffer census before the
+            # process dies (one bool check for any other exception /
+            # when the device plane is off)
+            obs_device.maybe_oom_bundle(
+                e, host=_bb_host)
+            raise
         finally:
             if _bb_dog is not None:
                 _bb_dog.stop()
+            # a profile_capture the loop couldn't finish (job end,
+            # removal, halt) is closed out, never left running
+            obs_device.capture_abort()
         return eval_metric
 
     def _apply_synced(self, avg_g, avg_s):

@@ -148,6 +148,12 @@ class GradSyncEngine:
     def __init__(self):
         self._staging = StagingPool(
             int(config.env("DT_AR_STAGING_MB")) * (1 << 20))
+        # r18 device plane: the staging pool's occupancy surfaces as
+        # device.staging_* gauges (weak registration — a drained
+        # engine's pool stays collectable; no-op when the plane is off)
+        from dt_tpu.obs import device as obs_device
+        if obs_device.enabled():
+            obs_device.register_staging(self._staging)
 
     @property
     def staging(self) -> StagingPool:

@@ -24,6 +24,7 @@ import optax
 import numpy as np
 
 from dt_tpu.obs import blackbox as obs_blackbox
+from dt_tpu.obs import device as obs_device
 from dt_tpu.obs import metrics as obs_metrics
 from dt_tpu.obs import trace as obs_trace
 from dt_tpu.parallel import kvstore as kvstore_lib
@@ -93,7 +94,10 @@ class Trainer:
                 new_params, new_opt = do(None)
             return new_params, new_opt, health
 
-        self._step_fn = jax.jit(apply)
+        # r18 compile observatory: same wrapper as Module's steps (a
+        # no-op returning the jit fn unchanged when DT_DEVICE_OBS=0)
+        self._step_fn = obs_device.instrument(
+            "trainer_step", jax.jit(apply))
 
     def allreduce_grads(self, grads):
         """Average grads across workers (reference
@@ -201,6 +205,11 @@ class Trainer:
             else:
                 self.params, self.opt_state = self._step_fn(
                     self.params, self.opt_state, grads, 1.0 / batch_size)
+        except Exception as e:
+            # r18 OOM forensics (one bool check unless RESOURCE_EXHAUSTED
+            # with the device plane armed)
+            obs_device.maybe_oom_bundle(e)
+            raise
         finally:
             obs_trace.tracer().complete_span("trainer.step", _obs_t0)
         return self.params

@@ -232,6 +232,14 @@ def main():
         "policy_shares": dict(ctrl.policy_shares),
         "policy_seq": ctrl.policy_seq,
     }
+    # r18 device plane (chaos recompile-churn gate): the compile
+    # observatory's ledger + how many times fit rebuilt the world —
+    # a share-only policy rebalance must show ZERO recompiles
+    from dt_tpu.obs import device as obs_device
+    if obs_device.enabled():
+        result["device"] = obs_device.summary()
+        result["mesh_rebuilds"] = int(mod.mesh_rebuilds)
+        result["resharded"] = int(mod.resharded)
     # (kind, host, count) of every fault THIS incarnation applied — the
     # chaos harness's --trace mode cross-checks these against the fault
     # events on the merged obs timeline

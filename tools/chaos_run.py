@@ -319,6 +319,29 @@ def _hang_checks(args, sched, procs, bb_dir, checks):
         time.sleep(0.25)
     checks["hang_bundle_written"] = bundle_row is not None
     checks["sched_hang_bundle_written"] = sched_row is not None
+    # r18 compile labeling closes the old first-bundle ambiguity: any
+    # w1 hang bundle BEFORE the injected stall's must be labeled
+    # compile_in_progress (a JIT compile out-stalling DT_HANG_S), so
+    # the FIRST unlabeled bundle IS the injected stall
+    w1_rows = sorted((r for r in rows
+                      if r.get("host") == STRAGGLE_HOST),
+                     key=lambda r: r.get("ts_ms", 0))
+    first_unlabeled = None
+    for r in w1_rows:
+        try:
+            b = json.load(open(os.path.join(bb_dir, r["file"])))
+        except (OSError, ValueError):
+            continue
+        if not (b.get("extra") or {}).get("compile_in_progress"):
+            first_unlabeled = b
+            break
+    checks["hang_first_unlabeled_is_stall"] = (
+        first_unlabeled is not None and _names_site(first_unlabeled))
+    # the fleet detector must not have pinned its blame on a worker it
+    # knew was compiling (the demotion contract; w1 is stalled, not
+    # compiling, so a compile label on the suspect is a mis-blame)
+    checks["sched_blame_not_compiling"] = not (
+        suspect or {}).get("compile_in_progress")
     # the fleet detector blames the worker the round is WAITING on —
     # not the victims that contributed and look equally hung
     checks["sched_blames_straggler"] = bool(suspect) and \
@@ -432,6 +455,12 @@ def main():
     bb_dir = os.path.join(tmp, "blackbox")
     os.environ["DT_BLACKBOX"] = "1"
     os.environ["DT_BLACKBOX_DIR"] = bb_dir
+    # r18 device plane: EVERY plan runs with the compile observatory +
+    # memory plane armed (workers inherit through _spawn's env copy) —
+    # the straggler plan gates recompile churn on it, the hang plan
+    # gates compile-labeled bundles, traced runs cross-check the
+    # compile/memory timeline
+    os.environ["DT_DEVICE_OBS"] = "1"
     if hang_plan:
         # the watchdog threshold the gates are measured against; the
         # in-process scheduler's fleet detector reads the same knob
@@ -618,6 +647,46 @@ def main():
             if expect_crash:
                 checks["crash_recovered"] = restarted and \
                     "RECOVERED w2" in open(hw + "_log").read()
+            # r18 device plane: every surviving worker's compile
+            # observatory saw the step compiles (a silently-dead plane
+            # would zero these), and the recompile-cause ledger proves
+            # the churn invariant — a share-only policy rebalance (or
+            # any membership change without a world rebuild,
+            # mesh_rebuilds == 0) causes ZERO program-rebuild
+            # recompiles; the only recompiles allowed are the
+            # shape-caused ones the dynamic mini-batch reshard
+            # legitimately implies, bounded by the number of reshards
+            # the worker lived through.  A silent recompile storm
+            # (rebuild/mesh causes, or shape churn beyond the resize
+            # count) fails here by name.
+            checks["device_compiles_observed"] = all(
+                (results[h].get("device") or {}).get("compiles", 0) > 0
+                for h in final_hosts)
+
+            def _churn_ok(r):
+                d = r.get("device") or {}
+                fams = ("train_step", "grad_step", "apply_step")
+                rebuilds = r.get("mesh_rebuilds", 0)
+                reshards = r.get("resharded", 0)
+                # the UNTRUNCATED bound first: per-what build counts
+                # cover every recompile (recompile_log is a bounded
+                # window, so a storm could scroll its early rebuild
+                # entries out of the visible log)
+                bw = d.get("by_what", {})
+                total = sum(max(0, bw[w]["builds"] - 1)
+                            for w in fams if w in bw)
+                if total > (rebuilds + reshards) * len(fams):
+                    return False
+                log = [e for e in d.get("recompile_log", [])
+                       if e.get("what") in fams]
+                non_shape = [e for e in log
+                             if e.get("changed") != ["shape"]]
+                shape = [e for e in log if e.get("changed") == ["shape"]]
+                return (len(non_shape) <= rebuilds * len(fams)
+                        and len(shape) <= reshards * len(fams))
+
+            checks["recompile_churn_bounded"] = all(
+                _churn_ok(results[h]) for h in final_hosts)
         # the r7 pooled transport: every worker multiplexes its requests
         # over a handful of persistent channels, so the scheduler serves
         # far more requests than it accepts connections (per-request
@@ -870,6 +939,22 @@ def main():
                     e.get("rule") == "round_wait"
                     and e.get("what") == "breach"
                     and e.get("worker") == STRAGGLE_HOST for e in hist)
+
+            # r18 device-plane timeline cross-checks: the compile
+            # observatory's counters rode the heartbeat export onto the
+            # worker tracks, the scheduler's per-host device view
+            # reached the merged summary, and the memory gauges landed
+            # in the shipped time-series (the sampler hook)
+            checks["trace_compile_observed"] = any(
+                tracks[t].get("counters", {})
+                .get("compile.compiles", 0) > 0 for t in worker_tracks)
+            checks["trace_device_section"] = bool(
+                (summary.get("device") or {}).get("workers"))
+            mtracks = (summary.get("metrics") or {}).get("tracks") or {}
+            checks["trace_device_memory"] = any(
+                any("device.host_rss_bytes" in (s.get("gauges") or {})
+                    for s in (t.get("samples") or []))
+                for k, t in mtracks.items() if k != "control-plane")
 
         # r16 flight recorder: every crash-bearing plan asserts the
         # killed/halted processes left COMPLETE bundles (the capture

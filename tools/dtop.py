@@ -216,6 +216,61 @@ def render(summary) -> str:
             parts = "  ".join(f"{k}={g[k]:.4g}" for k in sorted(g))
             lines.append(f"  {track:<20}samples={w.get('samples', 0)}"
                          f"  {parts}")
+    # r18 device board (dt_tpu/obs/device.py): per-worker compile
+    # observatory totals (+ the recompile-cause timeline folded from
+    # compile.recompile events), XLA's static memory estimate next to
+    # the measured HBM/RSS with the delta, and who is compiling NOW
+    dev = summary.get("device", {})
+    if dev.get("workers") or dev.get("recompiles_by_track"):
+        lines.append("")
+        compiling = dev.get("compiling") or []
+        lines.append("device board (compile observatory + memory)"
+                     + (f"  COMPILING: {', '.join(compiling)}"
+                        if compiling else "") + ":")
+        for host, w in sorted((dev.get("workers") or {}).items()):
+            c = w.get("compile") or {}
+            parts = [f"compiles={c.get('compiles', 0)}",
+                     f"recompiles={c.get('recompiles', 0)}",
+                     f"cache={c.get('cache_hits', 0)}h/"
+                     f"{c.get('cache_misses', 0)}m",
+                     f"compile_ms={c.get('ms_total', 0.0):.0f}"]
+            if w.get("compiling"):
+                parts.append(f"compiling={w['compiling']}")
+            lines.append(f"  {host:<20}" + "  ".join(parts))
+            mem = w.get("mem") or {}
+            est = c.get("est") or {}
+            for d in mem.get("devices", []):
+                line = (f"    hbm[{d.get('id')}]: "
+                        f"in_use={d.get('bytes_in_use', 0) / 2**20:.1f}MiB"
+                        f"  peak={d.get('peak_bytes_in_use', 0) / 2**20:.1f}"
+                        f"MiB")
+                if d.get("bytes_limit"):
+                    line += f"  limit={d['bytes_limit'] / 2**20:.0f}MiB"
+                if est.get("peak_mb"):
+                    # estimated-vs-measured: XLA's buffer-assignment
+                    # peak (the memcost static estimate) vs live HBM
+                    delta = d.get("peak_bytes_in_use", 0) / 2**20 \
+                        - est["peak_mb"]
+                    line += (f"  est_peak={est['peak_mb']:.1f}MiB"
+                             f"  delta={delta:+.1f}MiB")
+                lines.append(line)
+            if not mem.get("devices") and "host_rss_bytes" in mem:
+                line = (f"    rss={mem['host_rss_bytes'] / 2**20:.1f}MiB"
+                        " (no HBM stats: CPU backend)")
+                if est.get("peak_mb"):
+                    line += f"  est_peak={est['peak_mb']:.1f}MiB"
+                lines.append(line)
+            st = (w.get("mem") or {}).get("staging")
+            if st:
+                lines.append(f"    staging: {st.get('bytes', 0) / 2**20:.1f}"
+                             f"MiB pooled  outstanding="
+                             f"{st.get('outstanding', 0)}")
+        for track, evs in sorted(
+                (dev.get("recompiles_by_track") or {}).items()):
+            for e in evs[-6:]:
+                lines.append(f"  recompile {track}: {e.get('what')} "
+                             f"changed={e.get('changed')} "
+                             f"cache={e.get('cache', '-')}")
     causal = summary.get("causal", {})
     if causal.get("client_spans"):
         lines.append("")
@@ -409,6 +464,27 @@ def render_postmortem(bundle, manifest_rows=None, path="") -> str:
         lines.append("last SLO breaches:")
         for ts, desc in sorted(breaches)[-8:]:
             lines.append(f"  {_iso(ts)}  {desc}")
+    # r18 device plane: the bundle's device state provider (compile
+    # ledger + memory + census) and any OOM census in extra
+    devst = (bundle.get("state") or {}).get("device") or {}
+    census = (bundle.get("extra") or {}).get("census") \
+        or devst.get("census") or []
+    comp = devst.get("compile") or {}
+    if comp.get("compiles"):
+        lines.append("")
+        lines.append(
+            f"device plane: compiles={comp.get('compiles', 0)}  "
+            f"recompiles={comp.get('recompiles', 0)}  "
+            f"cache={comp.get('cache_hits', 0)}h/"
+            f"{comp.get('cache_misses', 0)}m  "
+            f"compiling={devst.get('compiling') or '-'}")
+    if census:
+        lines.append("top live buffers (shape  dtype  count  MiB  tag):")
+        for g in census[:8]:
+            lines.append(
+                f"  {g.get('shape'):<20}{g.get('dtype'):<10}"
+                f"{g.get('count'):>5}{g.get('bytes', 0) / 2**20:>9.1f}"
+                f"  {g.get('tag') or '-'}")
     sr = bundle.get("span_ring") or {}
     mr = bundle.get("metrics_ring") or {}
     lines.append("")
@@ -576,6 +652,13 @@ def main(argv=None):
                          "decomposition on every worker track (STEP "
                          "indexes each track's own recorded steps; a "
                          "restarted incarnation recounts from 0)")
+    ap.add_argument("--capture", default="", metavar="WORKER",
+                    help="queue a bounded jax.profiler capture on one "
+                         "worker via the r18 'profile_capture' command "
+                         "(needs --scheduler; the trace lands in the "
+                         "job's DT_BLACKBOX_DIR + manifest.jsonl)")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="steps the --capture trace spans (default 8)")
     ap.add_argument("--status", action="store_true",
                     help="one-screen scheduler identity/progress via "
                          "the light 'status' command (answers on a "
@@ -584,6 +667,18 @@ def main(argv=None):
                     help="the r15 SLO/gauge training-health view via "
                          "the 'health' command instead of obs_dump")
     args = ap.parse_args(argv)
+
+    if args.capture:
+        if not args.scheduler:
+            raise SystemExit("--capture needs --scheduler host:port")
+        resp = _sched_request(
+            args.scheduler,
+            {"cmd": "profile_capture", "host": f"dtop:{os.getpid()}",
+             "target": args.capture, "steps": args.steps,
+             "post_seq": int(time.time() * 1000)})
+        print(json.dumps({"queued": True, "target": args.capture,
+                          "steps": args.steps, "seq": resp.get("seq")}))
+        return 0
 
     if args.status or args.health:
         if not args.scheduler:

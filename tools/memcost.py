@@ -13,6 +13,13 @@ Writes ``MEMCOST_r04.json`` and prints one row per config.
 Run: ``DT_FORCE_CPU=1 python tools/memcost.py`` (the buffer assignment
 is computed by the same XLA pipeline on any backend; absolute bytes
 differ on TPU but the RATIOS hold).
+
+r18: this offline tool now shares its row format with the LIVE device
+plane (``dt_tpu.obs.device.memory_analysis_row``): with
+``DT_DEVICE_OBS=1`` the same XLA estimate is captured at every real
+compile and rendered on the dtop device board NEXT TO the measured HBM
+(estimated-vs-measured delta) — use this tool for offline knob sweeps,
+the board for what a running job actually holds.
 """
 
 import argparse
@@ -47,13 +54,11 @@ def measure(net, batch, size, remat, grad_accum):
     lowered = mod._train_step.lower(mod.state, jnp.asarray(x),
                                     jnp.asarray(y), rng)
     m = lowered.compile().memory_analysis()
-    return {
-        "config": f"remat={int(remat)} grad_accum={grad_accum}",
-        "temp_mb": round(m.temp_size_in_bytes / 2**20, 2),
-        "peak_mb": round(m.peak_memory_in_bytes / 2**20, 2),
-        "args_mb": round(m.argument_size_in_bytes / 2**20, 2),
-        "output_mb": round(m.output_size_in_bytes / 2**20, 2),
-    }
+    # the canonical MiB row shared with the live compile observatory
+    # (dt_tpu/obs/device.py — the dtop device board's "est" column)
+    from dt_tpu.obs import device as obs_device
+    return {"config": f"remat={int(remat)} grad_accum={grad_accum}",
+            **obs_device.memory_analysis_row(m)}
 
 
 def main():

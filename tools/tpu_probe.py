@@ -89,15 +89,26 @@ def main():
         log("devices in %.1fs: %s" % (time.time() - t0, devs))
         stage = "matmul"
         log("running 1024x1024 bf16 matmul")
+        # r18 capture discipline (ROADMAP 5): time the first compile and
+        # probe the DT_JAX_CACHE_DIR persistent cache around it, so a
+        # wedged-tunnel retry's manifest row can PROVE the cache saved
+        # the recompilation (dt_tpu/obs/device.py, jax-free helper)
+        from dt_tpu.obs import device as obs_device
+        cache = obs_device.cache_probe()
         t0 = time.time()
         x = jnp.ones((1024, 1024), jnp.bfloat16)
         y = (x @ x).block_until_ready()
-        log("matmul ok in %.1fs (sum=%s)" % (time.time() - t0,
-                                             float(jnp.sum(y))))
+        t_matmul = time.time() - t0
+        log("matmul ok in %.1fs (sum=%s, cache=%s)"
+            % (t_matmul, float(jnp.sum(y)), cache.outcome()))
         log("PROBE OK platform=%s" % devs[0].platform)
         faulthandler.cancel_dump_traceback_later()
         _row(phase="end", trigger="probe.ok", outcome="success",
              stage=stage, platform=str(devs[0].platform),
+             compile_time_s=round(t_matmul, 2),
+             cache_hits=int(cache.outcome() == "hit"),
+             cache_misses=int(cache.outcome() == "miss"),
+             compile_cache=cache.outcome(),
              duration_s=round(time.time() - t_start, 1))
     except BaseException as e:  # noqa: BLE001 — classify, record, re-raise
         # the r4/r5 lesson machine-recorded: a wedged tunnel fails
