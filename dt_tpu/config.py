@@ -78,6 +78,12 @@ ENV_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "DT_CTRL_TOKEN_TTL_S": ("300", "idempotency-token response-cache TTL (LRU cap + TTL bound scheduler memory)"),
     "DT_CTRL_ENDPOINTS": ("", "ordered scheduler endpoints host:port[,host:port] for client failover (leader first)"),
     "DT_CTRL_FAILOVER_S": ("60", "client-side wall budget for failing a request over across the endpoint list"),
+    "DT_CTRL_SNAP_KEEP": ("2", "newest snapshot sidecars retained per journal (older ones pruned on snapshot write; min 1)"),
+    # job survivability plane (r19 — coordinated fleet checkpointing,
+    # cold-restart resume, graceful drain; docs/checkpoint.md)
+    "DT_CKPT_DIR": ("", "fleet-checkpoint directory (per-worker <dir>/<host>/fleet-<step>.state blobs + manifest in the scheduler journal); empty = fleet checkpointing off"),
+    "DT_CKPT_EVERY": ("0", "global steps between coordinated fleet checkpoints (0 = only scheduler-forced epoch-boundary checkpoints)"),
+    "DT_RESUME": ("", "1 = cold-restart resume: scheduler replays the journal for the newest committed manifest; workers restore TrainState + iterator cursor and continue at the next step"),
     # observability (dt_tpu/obs)
     "DT_OBS": ("", "1 = enable dt_tpu.obs tracing (span/event ring buffer + heartbeat export)"),
     "DT_OBS_RING": (str(4096), "obs ring-buffer capacity (records per tracer; overflow drops oldest)"),

@@ -135,6 +135,14 @@ class WorkerClient:
         # re-admits this host; resume_epoch is where to rejoin
         self.recovery_pending: bool = bool(resp.get("recovery_pending"))
         self.resume_epoch: int = int(resp.get("resume_epoch", 0))
+        # r19 cold-restart resume (docs/checkpoint.md): the committed
+        # fleet-checkpoint manifest, served while a DT_RESUME scheduler
+        # boot is still short of its checkpointed epoch; fit() restores
+        # params + data cursor from it before the first step.
+        self.resume: Optional[dict] = resp.get("resume")
+        # r19 scheduler-drain flag: set by the heartbeat thread when the
+        # scheduler requests an epoch-boundary fleet checkpoint
+        self.ckpt_epoch_end: bool = False
         # r14 policy engine (dt_tpu/policy): the scheduler's applied
         # batch-share units + LR scale ride every membership-barrier
         # response; written alongside rank/workers on the caller thread
@@ -473,6 +481,12 @@ class WorkerClient:
                     self._obs_ack(payload)
                 if hm is not None:
                     self._hm_ack(hm)
+                if resp.get("ckpt_epoch_end"):
+                    # r19: a draining scheduler asks the fleet for an
+                    # epoch-boundary checkpoint; fit polls this flag at
+                    # the boundary (the free alignment point).
+                    # Monotonic write-once bool: benign unlocked.
+                    self.ckpt_epoch_end = True
                 for c in resp.get("profile_cmds", []):
                     self._apply_profile_cmd(c)
                 if dev is not None:
@@ -785,6 +799,32 @@ class WorkerClient:
 
     def num_dead_nodes(self, timeout_s: float = 60.0) -> int:
         return self._req({"cmd": "num_dead", "timeout_s": timeout_s})["count"]
+
+    # -- r19 coordinated fleet checkpointing + graceful drain ----------
+
+    def ckpt_begin(self, step: int, epoch: int) -> dict:
+        """Open (or join) the two-phase checkpoint window for ``step``.
+        Idempotent per step: whichever worker reaches the step first wins;
+        the rest get the same pending seq back."""
+        return self._req({"cmd": "ckpt_intent", "host": self.host,
+                          "step": int(step), "epoch": int(epoch)})
+
+    def ckpt_ack(self, step: int, path: str, sha256: str,
+                 cursor: Dict) -> dict:
+        """Report this host's durable save (path + content digest + data
+        cursor).  The last pinned worker's ack commits the manifest."""
+        return self._req({"cmd": "ckpt_ack", "host": self.host,
+                          "step": int(step), "path": path,
+                          "sha256": sha256, "cursor": dict(cursor)})
+
+    def ckpt_manifest(self) -> dict:
+        """Read-only committed/pending checkpoint view (dtop, tests)."""
+        return self._req({"cmd": "ckpt_manifest"})
+
+    def drain(self) -> dict:
+        """Graceful departure: journal the drain marker and leave the
+        job through the eviction machinery (no recovery window)."""
+        return self._req({"cmd": "drain", "host": self.host})
 
     def _ar_chunk_elems(self, value_size: int, itemsize: int,
                         route: Optional[int], nbytes: int,

@@ -101,6 +101,27 @@ PROTOCOL_REGISTRY: Mapping[str, Tuple[str, str, str, str]] = {
         "(r18 device plane): delivered on the target's next heartbeat, "
         "trace lands in DT_BLACKBOX_DIR + manifest.jsonl; "
         "(host, post_seq) dedups replays like 'profile'"),
+    # -- job survivability plane (r19 — fleet checkpoint / drain / resume,
+    # docs/checkpoint.md) ---------------------------------------------------
+    "ckpt_intent": (
+        "scheduler", "idempotent", "",
+        "phase 1 of the coordinated fleet checkpoint: pin (step, worker "
+        "set) via a journaled ckpt_intent op; per-step dedup makes every "
+        "replay/duplicate a no-op (first caller wins, the rest adopt)"),
+    "ckpt_ack": (
+        "scheduler", "idempotent", "",
+        "one worker's async save landed (path + sha256 + data-iterator "
+        "cursor); per-(host, step) journaled dedup, the last ack in the "
+        "pinned set triggers the journaled ckpt_commit manifest"),
+    "ckpt_manifest": (
+        "scheduler", "read_only", "exempt|passive",
+        "the newest COMMITTED checkpoint manifest + the pending-intent "
+        "view (resume bootstrap, dtop timeline, chaos gates)"),
+    "drain": (
+        "scheduler", "idempotent", "",
+        "graceful-drain notice (SIGTERM preemption): journaled drain op "
+        "drops base protection and the eviction machinery removes the "
+        "host; draining an already-draining/absent host is a no-op"),
     "shutdown": (
         "scheduler|range_server", "idempotent", "passive|external",
         "remote shutdown of the serving process (idempotent close); "

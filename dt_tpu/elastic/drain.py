@@ -1,0 +1,149 @@
+"""Graceful drain: SIGTERM → finish the current step → leave cleanly.
+
+r19 preemption plumbing (docs/checkpoint.md).  Reference gap: a
+preempted reference worker simply dies mid-collective (``van.cc`` has no
+SIGTERM path; ``elastic_training.cc:108-126`` only audits the removal
+after the fact) — survivors then eat a timeout + recovery window for
+what was a *scheduled* departure.  Here the first SIGTERM merely raises
+a flag; the training loop polls :func:`requested` between steps, sends
+the ``drain`` wire command (``elastic/commands.py``) so the scheduler
+removes the host through the journaled eviction machinery, and returns
+from ``fit`` — no collective error, no blackbox bundle, no recovery
+window.
+
+Signal-handler discipline: the handler ONLY sets an event and re-arms
+escalation — no locks, no I/O (the interrupted thread may hold the
+tracer or ring locks; see the deadlock note in ``obs/blackbox.py``).
+The manifest row and ``drain.requested`` obs event are emitted by the
+training loop via :func:`announce`, on a normal thread.  A SECOND
+SIGTERM while draining escalates to the previously installed handler
+(the blackbox fatal-bundle path when armed, else default die) so a
+wedged drain stays killable.  Forked children inherit the disposition —
+the handler PID-guards against that and dies with the default behavior
+there, or a DataLoader pool worker would swallow ``Pool.terminate()``'s
+TERM and wedge the parent's ``close()`` forever.
+
+Call :func:`install` AFTER ``obs_blackbox.install`` (WorkerClient
+construction does the latter): installation order is what makes the
+first TERM graceful and the second fatal.
+"""
+
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from dt_tpu.obs import blackbox as obs_blackbox
+from dt_tpu.obs import trace as obs_trace
+
+_LOCK = threading.Lock()
+_INSTALLED = False  # guarded-by: _LOCK
+_INSTALL_PID = 0  # guarded-by: _LOCK (read lock-free in the handler)
+_PREV_HANDLER = None  # guarded-by: _LOCK
+_REQUESTED = threading.Event()
+_REQUESTED_MS: Optional[int] = None  # stamp for announce(); write-once
+_ANNOUNCED = False  # guarded-by: _LOCK
+
+
+def install(host: Optional[str] = None) -> bool:
+    """Arm the graceful-drain SIGTERM handler (idempotent).  Returns
+    False off the main thread / unsupported platforms — the training
+    loop then simply never sees :func:`requested`."""
+    del host  # identity rides announce(); handler must stay lock-free
+    global _INSTALLED, _INSTALL_PID, _PREV_HANDLER
+    with _LOCK:
+        if _INSTALLED:
+            return True
+
+        def _handler(signum, frame):
+            del frame
+            if os.getpid() != _INSTALL_PID:
+                # forked child (e.g. a DataLoader pool worker): drain is
+                # meaningless here, and swallowing TERM makes the
+                # parent's Pool.terminate() join hang forever — die with
+                # the default disposition instead
+                try:
+                    signal.signal(signum, signal.SIG_DFL)
+                except (ValueError, OSError):
+                    pass
+                os.kill(os.getpid(), signum)
+                return
+            _mark_requested()
+            # escalation: a second TERM gets the pre-drain disposition
+            # (blackbox fatal bundle when armed, else default death)
+            try:
+                signal.signal(signum, _PREV_HANDLER or signal.SIG_DFL)
+            except (ValueError, TypeError, OSError):
+                pass
+
+        try:
+            _PREV_HANDLER = signal.signal(signal.SIGTERM, _handler)
+        except (ValueError, OSError):
+            return False  # not the main thread: leave disposition alone
+        _INSTALLED = True
+        _INSTALL_PID = os.getpid()
+        return True
+
+
+def _mark_requested() -> None:
+    """Signal-handler body: flag + timestamp, nothing that takes a
+    lock.  (time.time is a lone syscall; int boxing allocates but
+    cannot deadlock.)"""
+    global _REQUESTED_MS
+    if _REQUESTED_MS is None:
+        _REQUESTED_MS = int(time.time() * 1000)
+    _REQUESTED.set()
+
+
+def requested() -> bool:
+    """Has a drain been requested (SIGTERM seen, or :func:`request`)?"""
+    return _REQUESTED.is_set()
+
+
+def request() -> None:
+    """Programmatic drain trigger (tests / operator tooling) — same
+    observable effects as a SIGTERM."""
+    _mark_requested()
+
+
+def announce(host: Optional[str] = None) -> bool:
+    """One-time drain bookkeeping, called by the training loop when it
+    observes :func:`requested`: the ``drain.requested`` obs event and —
+    when the flight-recorder plane is armed — a ``kind="drain"`` row in
+    ``manifest.jsonl`` (a drained worker leaves a departure record, NOT
+    a crash bundle).  Returns True the first time only."""
+    global _ANNOUNCED
+    with _LOCK:
+        if _ANNOUNCED or not _REQUESTED.is_set():
+            return False
+        _ANNOUNCED = True
+    ts = _REQUESTED_MS or int(time.time() * 1000)
+    obs_trace.tracer().event("drain.requested",
+                             {"host": host, "ts_ms": ts})
+    obs_blackbox.note("drain.requested", host=host)
+    if obs_blackbox.enabled():
+        obs_blackbox.manifest_append(
+            {"kind": "drain", "ts_ms": ts, "pid": os.getpid(),
+             "host": host, "trigger": "SIGTERM", "fatal": False})
+    return True
+
+
+def _reset_for_tests() -> None:
+    """Drop module state and restore the previous SIGTERM disposition
+    (tests only — the flag and handler are process-wide)."""
+    global _INSTALLED, _INSTALL_PID, _PREV_HANDLER, _ANNOUNCED, \
+        _REQUESTED_MS
+    with _LOCK:
+        if _INSTALLED:
+            try:
+                signal.signal(signal.SIGTERM,
+                              _PREV_HANDLER or signal.SIG_DFL)
+            except (ValueError, TypeError, OSError):
+                pass
+        _INSTALLED = False
+        _INSTALL_PID = 0
+        _PREV_HANDLER = None
+        _ANNOUNCED = False
+        _REQUESTED_MS = None
+        _REQUESTED.clear()

@@ -221,10 +221,20 @@ def replay(path: str) -> Iterator[Tuple[int, str, Dict[str, Any]]]:
 # ---------------------------------------------------------------------------
 
 _SNAP_REF = "__snap_ref__"
-#: sidecar files retained (current + one predecessor: a standby lagging
-#: one snapshot behind still resolves; deeper lag degrades to "no
-#: snapshot yet", never to garbage)
-_SNAP_KEEP = 2
+
+
+def _snap_keep() -> int:
+    """Sidecar files retained (default 2: current + one predecessor — a
+    standby lagging one snapshot behind still resolves; deeper lag
+    degrades to "no snapshot yet", never to garbage).  ``DT_CTRL_SNAP_KEEP``
+    overrides; clamped to >= 1 so the just-written sidecar always
+    survives its own prune."""
+    from dt_tpu import config
+    try:
+        keep = int(config.env("DT_CTRL_SNAP_KEEP"))
+    except ValueError:
+        keep = 2
+    return max(1, keep)
 
 
 def snapshot_marker(blob: Any) -> bool:
@@ -255,7 +265,7 @@ def write_snapshot_sidecar(journal_path: str, blob: Any) -> Dict[str, str]:
             (os.path.join(d, n) for n in os.listdir(d)
              if n.startswith(prefix) and ".tmp." not in n),
             key=os.path.getmtime)
-        for old in snaps[:-_SNAP_KEEP]:
+        for old in snaps[:-_snap_keep()]:
             os.unlink(old)
     except OSError:
         pass  # GC is best-effort; an unpruned sidecar is just disk
@@ -410,6 +420,15 @@ class ControlState:
         self.policy_lr_scale: float = 1.0
         self.policy_seq = 0
         self.policy_log: List[Dict[str, Any]] = []
+        # r19 job survivability plane (docs/checkpoint.md): the two-phase
+        # fleet-checkpoint protocol journals intent → per-worker acks →
+        # commit; only ``ckpt_committed`` (the digest manifest) is ever
+        # resumed from — an uncommitted intent is garbage by design
+        self.ckpt_seq = 0
+        self.ckpt_pending: Optional[Dict[str, Any]] = None
+        self.ckpt_committed: Optional[Dict[str, Any]] = None
+        self.resume_seq = 0
+        self.draining: Set[str] = set()
         # journal path for resolving snapshot sidecar markers at replay
         # (set by the embedding scheduler and by :meth:`rebuild`)
         self.sidecar_base: Optional[str] = None
@@ -570,6 +589,93 @@ class ControlState:
             "proposals": list(proposals or [])})
         del self.policy_log[:-self.POLICY_LOG_KEEP]
 
+    def _op_ckpt_intent(self, step: int, epoch: int, seq: int,
+                        workers: List[str]) -> None:
+        """Phase 1 of the fleet checkpoint (r19): pin the step and the
+        worker set whose acks gate the commit.  ``seq`` is absolute so a
+        replayed record is a no-op; a NEWER intent supersedes a pending
+        one (the abandoned checkpoint's blobs are garbage — the previous
+        COMMITTED one still wins)."""
+        if int(seq) <= self.ckpt_seq:
+            return
+        self.ckpt_seq = int(seq)
+        self.ckpt_pending = {"step": int(step), "epoch": int(epoch),
+                             "seq": int(seq),
+                             "workers": sorted(workers), "acks": {}}
+
+    def _op_ckpt_ack(self, step: int, host: str, path: str, sha256: str,
+                     cursor: Dict[str, Any]) -> None:
+        """One worker's save landed on disk (digest + data-iterator
+        cursor recorded).  Acks for a step that is no longer pending
+        (superseded / already committed) are stale and dropped."""
+        p = self.ckpt_pending
+        if p is None or p["step"] != int(step):
+            return
+        p["acks"][host] = {"path": path, "sha256": sha256,
+                           "cursor": dict(sorted(cursor.items()))}
+
+    def _op_ckpt_commit(self, step: int, manifest: Dict[str, Any]) -> None:
+        """Phase 2: every pinned worker acked — the manifest becomes THE
+        resume point.  Commits only move forward (a replayed older commit
+        never clobbers a newer one)."""
+        p = self.ckpt_pending
+        if p is not None and p["step"] == int(step):
+            self.ckpt_pending = None
+        if self.ckpt_committed is None or \
+                int(step) > int(self.ckpt_committed["step"]):
+            self.ckpt_committed = dict(manifest)
+
+    def _op_ckpt_abort(self, step: int) -> None:
+        """Abandon a pending intent (its worker set changed before every
+        ack arrived); the blobs already written are unreferenced garbage."""
+        p = self.ckpt_pending
+        if p is not None and p["step"] == int(step):
+            self.ckpt_pending = None
+
+    def _op_drain(self, host: str, seq: int) -> None:
+        """A preemption notice (SIGTERM) started a graceful drain: the
+        host loses base protection (so the membership machinery may
+        remove it) and is marked draining so its departure reads as
+        intentional, not a failure."""
+        self.draining.add(host)
+        self.base.discard(host)
+        self.base0.discard(host)
+        self.log_seq = max(self.log_seq, int(seq))
+
+    def _op_resume(self, seq: int) -> None:
+        """Cold-restart resume (DT_RESUME): everything about the DEAD
+        incarnation — membership, barriers, recovery queues, policy
+        shares, the parameter snapshot — is reset to boot state; only the
+        committed checkpoint manifest (and the monotone sequences) carry
+        forward.  The next ``init`` re-seeds the membership from the
+        (possibly resized) host file and workers restore from the
+        manifest."""
+        if int(seq) <= self.resume_seq:
+            return
+        self.resume_seq = int(seq)
+        self.workers = []
+        self.base = set()
+        self.base0 = set()
+        self.registered = set()
+        self.pending_recovery = set()
+        self.recovered_at = {}
+        self.removed_hosts = set()
+        self.expected_workers = 0
+        self.barrier_epoch = None
+        self.barrier_arrived = set()
+        self.barrier_result = {}
+        self.last_completed_epoch = (
+            int(self.ckpt_committed["epoch"]) - 1
+            if self.ckpt_committed is not None else -1)
+        self.plain_arrived = set()
+        self.mc_partial = None
+        self.snapshot = None
+        self.policy_shares = {}
+        self.policy_streaks = {}
+        self.policy_lr_scale = 1.0
+        self.ckpt_pending = None
+        self.draining = set()
+
     def _op_snapshot(self, blob: Any) -> None:
         if snapshot_marker(blob) and self.sidecar_base:
             loaded = load_snapshot_sidecar(self.sidecar_base,
@@ -625,4 +731,12 @@ class ControlState:
             "policy_streaks": dict(sorted(self.policy_streaks.items())),
             "policy_lr_scale": self.policy_lr_scale,
             "policy_log": list(self.policy_log),
+            "ckpt_seq": self.ckpt_seq,
+            "ckpt_pending": (
+                None if self.ckpt_pending is None else
+                {**self.ckpt_pending,
+                 "acks": dict(sorted(self.ckpt_pending["acks"].items()))}),
+            "ckpt_committed": self.ckpt_committed,
+            "resume_seq": self.resume_seq,
+            "draining": sorted(self.draining),
         }

@@ -106,7 +106,8 @@ class Scheduler:
                  lease_path: Optional[str] = None,
                  lease_s: Optional[float] = None,
                  standby: bool = False,
-                 peer: Optional[tuple] = None):
+                 peer: Optional[tuple] = None,
+                 resume: bool = False):
         """``initial_workers`` seeds the base set; else the first line-set of
         ``host_worker_file`` does (``postoffice.cc:247-259`` baseline read).
         ``launch_callback(host, epoch_begin)`` starts a worker process on
@@ -178,11 +179,24 @@ class Scheduler:
                 self._journal = journal.JournalWriter(
                     self.journal_path, fence=self._incarnation,
                     lease=self._lease)
+            if resume and self.journal_path:
+                # r19 cold-restart resume (docs/checkpoint.md): the replayed
+                # journal holds the dead incarnation's fleet; the journaled
+                # resume op clears it (so `init` below re-seeds from the
+                # host file, possibly at a different size) while keeping the
+                # committed fleet-checkpoint manifest workers restore from.
+                with self._cv:
+                    self._apply("resume", seq=self._state.resume_seq + 1)
             if not self._state.workers and initial_workers:
                 with self._cv:
                     self._apply("init", workers=list(initial_workers),
                                 expected=(expected_workers
                                           or len(initial_workers)))
+
+        # r19: while a DT_RESUME boot is still rolling the fleet forward to
+        # its checkpointed epoch, _register serves the committed manifest so
+        # workers restore params + data cursors before their first barrier.
+        self._resume_boot = bool(resume)  # guarded-by: _lock
 
         self.expected_workers = (expected_workers
                                  or self._state.expected_workers
@@ -221,6 +235,20 @@ class Scheduler:
         self._obs_tracks: Dict[str, dict] = {}  # guarded-by: _obs_lock
         self._obs_cap = self._obs._cap
         self._barrier_t0 = None  # mc_barrier window span start; guarded-by: _lock
+        # r19 fleet-checkpoint timing (obs-only; the journaled truth lives
+        # in ControlState.ckpt_pending/_committed): intent/ack monotonic
+        # times feed the ckpt.commit dur_ms/spread_ms event attributes.
+        self._ckpt_times: Dict[int, dict] = {}  # guarded-by: _lock
+        # r19 scheduler drain: once set, heartbeat responses carry
+        # ckpt_epoch_end so the fleet checkpoints at the next boundary.
+        # Monotonic write-once bool: benign unlocked.
+        self._ckpt_epoch_end = False
+        if self._resume_boot and self._state.ckpt_committed is not None:
+            m = self._state.ckpt_committed
+            self._obs.event("ckpt.resume",
+                            attrs={"step": int(m["step"]),
+                                   "epoch": int(m["epoch"]),
+                                   "workers": list(m["workers"])})
         # the single-funnel data plane (allreduce rounds + dist_async
         # store), shared machinery with RangeServer (dataplane.py).  When
         # range servers register, workers route bulk data to THEM and this
@@ -1215,6 +1243,11 @@ class Scheduler:
                 out["profile_cmds"] = newer
             if caps:
                 out["capture_cmds"] = caps
+            if self._ckpt_epoch_end:
+                # r19 scheduler drain: ask the fleet for an epoch-
+                # boundary checkpoint (monotonic bool — see
+                # request_fleet_checkpoint)
+                out["ckpt_epoch_end"] = True
             return out
         if cmd == "obs_push":
             # synchronous flush (worker close / injected-crash path);
@@ -1245,12 +1278,21 @@ class Scheduler:
                     "suspect": suspect}
         if cmd == "status":
             with self._lock:
+                st = self._state
                 out = {"active": self._active.is_set(),
                        "incarnation": self._incarnation,
-                       "workers": list(self._state.workers),
+                       "workers": list(st.workers),
                        "last_completed_epoch":
-                           self._state.last_completed_epoch,
-                       "policy": self._policy_view_locked()}
+                           st.last_completed_epoch,
+                       "policy": self._policy_view_locked(),
+                       "ckpt": {
+                           "committed_step":
+                               int(st.ckpt_committed["step"])
+                               if st.ckpt_committed else None,
+                           "pending_step":
+                               int(st.ckpt_pending["step"])
+                               if st.ckpt_pending else None,
+                           "draining": sorted(st.draining)}}
             out["straggler"] = self._dp.straggler_scores()
             return out
         if cmd == "profile":
@@ -1370,6 +1412,33 @@ class Scheduler:
         if cmd == "membership":
             with self._lock:
                 return {"workers": list(self._state.workers)}
+        if cmd == "ckpt_intent":
+            return self._ckpt_intent(msg["host"], int(msg["step"]),
+                                     int(msg["epoch"]))
+        if cmd == "ckpt_ack":
+            return self._ckpt_ack(msg["host"], int(msg["step"]),
+                                  msg["path"], msg["sha256"],
+                                  msg.get("cursor") or {})
+        if cmd == "ckpt_manifest":
+            with self._lock:
+                st = self._state
+                pend = None
+                if st.ckpt_pending is not None:
+                    p = st.ckpt_pending
+                    pend = {"step": p["step"], "epoch": p["epoch"],
+                            "workers": list(p["workers"]),
+                            "acks": sorted(p["acks"])}
+                com = None
+                if st.ckpt_committed is not None:
+                    c = st.ckpt_committed
+                    com = {"step": c["step"], "epoch": c["epoch"],
+                           "workers": list(c["workers"]),
+                           "files": {h: dict(a)
+                                     for h, a in c["files"].items()}}
+                return {"committed": com, "pending": pend,
+                        "resume": bool(self._resume_boot)}
+        if cmd == "drain":
+            return self._drain(msg["host"])
         if cmd == "shutdown":
             self.close()
             return {}
@@ -1476,11 +1545,26 @@ class Scheduler:
             self._cv.notify_all()
             # profile_seq: joiners sync PAST the buffered command history
             # (don't replay a long-finished profiling session on new hosts)
-            return {"rank": st.workers.index(host),
-                    "workers": list(st.workers),
-                    "profile_seq": self._profile_seq,
-                    "fence": self._incarnation,
-                    "servers": self._server_list()}
+            out = {"rank": st.workers.index(host),
+                   "workers": list(st.workers),
+                   "profile_seq": self._profile_seq,
+                   "fence": self._incarnation,
+                   "servers": self._server_list()}
+            # r19 cold-restart resume: until the restarted fleet passes the
+            # checkpointed epoch's barrier, hand every registrant the
+            # committed manifest so it restores params + data cursor
+            # before its first step (data-parallel state is identical
+            # across workers, so any digest-verified blob restores any
+            # worker — which is what makes N±1 elastic resume work).
+            com = st.ckpt_committed
+            if self._resume_boot and com is not None and \
+                    st.last_completed_epoch < int(com["epoch"]):
+                out["resume"] = {
+                    "step": int(com["step"]), "epoch": int(com["epoch"]),
+                    "workers": list(com["workers"]),
+                    "files": {h: dict(a)
+                              for h, a in com["files"].items()}}
+            return out
 
     def wait_for_workers(self, n: Optional[int] = None, timeout: float = 120):
         """Block until n workers registered (rendezvous;
@@ -1591,11 +1675,142 @@ class Scheduler:
         # pending plain barrier
         if st.plain_arrived and live and st.plain_arrived >= live:
             self._apply("plain_release", gen=st.plain_gen + 1)
+        # r19: a pending fleet checkpoint pinned to a worker set that just
+        # lost a member can never gather its acks — abort it (the previous
+        # committed checkpoint stays authoritative; the next cadence step
+        # re-pins against the survivors)
+        if st.ckpt_pending is not None and \
+                not set(st.ckpt_pending["workers"]) <= live:
+            step = st.ckpt_pending["step"]
+            self._apply("ckpt_abort", step=step)
+            self._ckpt_times.pop(step, None)
+            self._obs.event("ckpt.abort",
+                            {"step": step, "reason": "member_lost"})
         # pending allreduce rounds finish with the survivors
         self._dp.complete_with(live, ordered=st.workers)
 
     # ------------------------------------------------------------------
-    # membership-change barrier (the heart — SURVEY.md §3.3)
+    # r19 coordinated fleet checkpointing + graceful drain
+    # (docs/checkpoint.md; reference gap: callback.py:55-100 saves one
+    # host's params locally and kvstore.py:551 cannot save dist-kvstore
+    # optimizer state at all — no coordinated, resumable fleet snapshot)
+
+    def _ckpt_intent(self, host: str, step: int, epoch: int) -> dict:
+        """First worker to reach a checkpoint step opens the two-phase
+        window; replicas of the same (step) intent are absorbed.  The
+        journaled pending record pins the worker set whose acks commit."""
+        faults.crash_point("sched.ckpt_intent", host=host)
+        with self._cv:
+            st = self._state
+            com = st.ckpt_committed
+            if com is not None and step <= int(com["step"]):
+                return {"ok": False, "reason": "already_committed"}
+            p = st.ckpt_pending
+            if p is not None and int(p["step"]) == step:
+                return {"ok": True, "seq": p["seq"]}
+            if p is not None and step < int(p["step"]):
+                return {"ok": False, "reason": "superseded"}
+            if p is not None:
+                # a newer intent supersedes a stuck window (a pinned
+                # worker died before acking and was since re-admitted)
+                old = int(p["step"])
+                self._apply("ckpt_abort", step=old)
+                self._ckpt_times.pop(old, None)
+                self._obs.event("ckpt.abort",
+                                {"step": old, "reason": "superseded"})
+            self._apply("ckpt_intent", step=step, epoch=epoch,
+                        seq=st.ckpt_seq + 1, workers=sorted(st.workers))
+            self._ckpt_times[step] = {"t0": time.monotonic(), "acks": {}}
+            self._obs.event("ckpt.intent",
+                            {"step": step, "epoch": epoch,
+                             "workers": sorted(st.workers)})
+            return {"ok": True, "seq": st.ckpt_seq}
+
+    def _ckpt_ack(self, host: str, step: int, path: str, sha256: str,
+                  cursor: dict) -> dict:
+        """Record one worker's durable save; the last pinned ack commits
+        the manifest in the SAME journaled transition stream, so a torn
+        window (crash before commit) leaves the previous committed
+        checkpoint authoritative."""
+        faults.crash_point("sched.ckpt_ack", host=host)
+        with self._cv:
+            st = self._state
+            p = st.ckpt_pending
+            if p is None or int(p["step"]) != step:
+                com = st.ckpt_committed
+                if com is not None and int(com["step"]) >= step:
+                    return {"committed": True}  # retry after commit won
+                return {"committed": False, "stale": True}
+            if host not in p["acks"]:
+                self._apply("ckpt_ack", step=step, host=host, path=path,
+                            sha256=sha256, cursor=cursor)
+                times = self._ckpt_times.get(step)
+                if times is not None:
+                    times["acks"][host] = time.monotonic()
+                self._obs.event("ckpt.ack", {"host": host, "step": step})
+            committed = False
+            if set(p["workers"]) <= set(p["acks"]):
+                # the torn-window crash site chaos kills at: every ack is
+                # journaled but the commit is not — resume must fall back
+                # to the previous committed manifest
+                faults.crash_point("sched.ckpt_commit", host=host)
+                manifest = {"step": int(p["step"]),
+                            "epoch": int(p["epoch"]),
+                            "seq": int(p["seq"]),
+                            "workers": list(p["workers"]),
+                            "files": {h: dict(a) for h, a in
+                                      sorted(p["acks"].items())}}
+                self._apply("ckpt_commit", step=step, manifest=manifest)
+                committed = True
+                times = self._ckpt_times.pop(step, None)
+                attrs = {"step": step, "epoch": manifest["epoch"],
+                         "workers": manifest["workers"]}
+                if times is not None:
+                    now = time.monotonic()
+                    ats = sorted(times["acks"].values())
+                    attrs["dur_ms"] = round((now - times["t0"]) * 1e3, 3)
+                    attrs["spread_ms"] = round(
+                        (ats[-1] - ats[0]) * 1e3, 3) if len(ats) > 1 \
+                        else 0.0
+                self._obs.event("ckpt.commit", attrs)
+                if self._metrics is not None:
+                    self._metrics.gauge("ckpt.committed_step",
+                                        float(step))
+                self._cv.notify_all()
+            return {"committed": committed}
+
+    def request_fleet_checkpoint(self) -> None:
+        """Scheduler-drain entry (SIGTERM on ``scheduler_main``): flag
+        every heartbeat response with ``ckpt_epoch_end`` so the fleet
+        cuts a coordinated checkpoint at its next epoch boundary — the
+        one point where every worker's ``state.step`` already agrees.
+        The operator (or ``scheduler_main``) watches ``status.ckpt``
+        for the commit before taking the process down."""
+        self._ckpt_epoch_end = True
+        self._obs.event("drain.requested", {"host": "scheduler"})
+
+    def _drain(self, host: str) -> dict:
+        """Graceful departure (SIGTERM → finish current step → drain):
+        journal the drain marker, then remove the host through the same
+        machinery eviction uses — survivors' in-flight collectives
+        complete with the remaining contributions, and no recovery window
+        opens for the departed worker."""
+        with self._cv:
+            st = self._state
+            if host in st.draining or host not in st.workers:
+                return {"ok": True, "already": True}
+            self._apply("drain", host=host, seq=st.log_seq + 1)
+            self._obs.event("drain.begin", {"host": host})
+            self._apply("evict", host=host, seq=st.log_seq + 1)
+            self._audit_locked("DRAINED", host)
+            self._dp.hosts_removed({host})
+            self._metrics_forget([host])
+            self._dev_forget([host])
+            self._rewrite_host_file([host])
+            self._complete_pending_locked()
+            self._cv.notify_all()
+            self._obs.event("drain.complete", {"host": host})
+            return {"ok": True}
     # ------------------------------------------------------------------
 
     def _mc_barrier(self, host: str, epoch: int, info: dict) -> dict:

@@ -84,6 +84,12 @@ def main(argv=None) -> int:
                          "completed rounds to (primary only)")
     ap.add_argument("--expected-workers", type=int, default=None)
     ap.add_argument("--auto-evict-dead-s", type=float, default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="cold-restart resume (DT_RESUME): replay the "
+                         "journal, clear the dead incarnation's fleet, "
+                         "serve the committed fleet-checkpoint manifest "
+                         "to re-registering workers "
+                         "(docs/checkpoint.md)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -91,6 +97,8 @@ def main(argv=None) -> int:
         format="%(asctime)s sched[%(process)d] %(levelname)s %(message)s")
     from dt_tpu.elastic.scheduler import Scheduler
     from dt_tpu.obs import trace as obs_trace
+
+    from dt_tpu import config as config_lib
 
     peer = _parse_addr(args.peer) if args.peer else None
     sched = Scheduler(host_worker_file=args.host_worker_file,
@@ -101,7 +109,9 @@ def main(argv=None) -> int:
                       lease_path=args.lease,
                       lease_s=args.lease_s,
                       standby=args.standby,
-                      peer=peer)
+                      peer=peer,
+                      resume=bool(args.resume
+                                  or config_lib.env("DT_RESUME")))
     if peer is not None:
         obs_trace.register_flush(lambda: _relay_obs(sched, peer))
     if args.port_file:
@@ -113,6 +123,27 @@ def main(argv=None) -> int:
     logging.getLogger("dt_tpu.elastic").info(
         "%s scheduler up on :%d (journal=%s)", role, sched.port,
         args.journal)
+
+    # r19 graceful scheduler drain: the FIRST SIGTERM asks the fleet for
+    # an epoch-boundary checkpoint (heartbeat ckpt_epoch_end flag) and
+    # keeps serving; a second TERM gets the default disposition.  Safe
+    # to run inline: Python delivers signals on the main thread, which
+    # is parked in join() below and holds no locks.
+    import signal
+
+    def _drain_sig(signum, frame):
+        del frame
+        sched.request_fleet_checkpoint()
+        try:
+            signal.signal(signum, signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+
+    try:
+        signal.signal(signal.SIGTERM, _drain_sig)
+    except (ValueError, OSError):
+        pass
+
     sched.join()  # parks until a shutdown command / close()
     return 0
 

@@ -194,7 +194,10 @@ def launch_local(num_workers: int, command: List[str],
     sched = Scheduler(host_worker_file=hostfile, initial_workers=hosts,
                       launch_callback=launch_new if elastic else None,
                       journal_path=journal, lease_path=lease,
-                      peer=("127.0.0.1", standby_port) if standby else None)
+                      peer=("127.0.0.1", standby_port) if standby else None,
+                      # r19 cold-restart resume: replay the journal, adopt
+                      # the committed fleet checkpoint (docs/checkpoint.md)
+                      resume=bool(config.env("DT_RESUME")))
     if standby:
         endpoints_env["DT_CTRL_ENDPOINTS"] = \
             f"127.0.0.1:{sched.port},127.0.0.1:{standby_port}"
@@ -328,7 +331,8 @@ def launch_ssh(num_workers: int, command: List[str], hostfile: str,
 
     sched = Scheduler(host_worker_file=hostfile, initial_workers=hosts,
                       launch_callback=launch_new if elastic else None,
-                      port=scheduler_port)
+                      port=scheduler_port,
+                      resume=bool(config.env("DT_RESUME")))
     logger.info("scheduler on %s:%d; ssh-starting %d workers", uri,
                 sched.port, num_workers)
     server_procs = {}

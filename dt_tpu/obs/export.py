@@ -305,6 +305,7 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
     failovers: List[dict] = []
     leadership: List[dict] = []
     recompiles: Dict[str, List[dict]] = {}  # r18 compile.recompile fold
+    ckpt_events: List[dict] = []  # r19 ckpt.*/drain.* timeline fold
     total_faults = 0
     for ev in chrome.get("traceEvents", ()):
         if ev.get("ph") in ("M", "s", "f", "t"):
@@ -361,6 +362,16 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
                                    "what": name.split(".", 1)[1],
                                    **{k: v for k, v in ev["args"].items()
                                       if k in ("incarnation", "reason")}})
+            if name.startswith("ckpt.") or name.startswith("drain."):
+                # r19 survivability timeline (docs/checkpoint.md):
+                # intents/acks/commits/aborts, drains, the resume event
+                # — one chronological list dtop folds into its
+                # checkpoint/drain section
+                ckpt_events.append(
+                    {"track": track, "ts": ev.get("ts"), "what": name,
+                     **{k: v for k, v in (ev.get("args") or {}).items()
+                        if k in ("step", "epoch", "host", "workers",
+                                 "reason", "dur_ms", "spread_ms")}})
 
     meta = (chrome.get("otherData") or {}).get("tracks") or {}
     out_tracks: Dict[str, Any] = {}
@@ -402,6 +413,8 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
            "leadership": sorted(leadership,
                                 key=lambda m: m.get("ts") or 0),
            "total_fault_events": total_faults,
+           "checkpoint": sorted(ckpt_events,
+                                key=lambda m: m.get("ts") or 0),
            "straggler": dict((chrome.get("otherData") or {})
                              .get("straggler") or {}),
            "policy": dict((chrome.get("otherData") or {})

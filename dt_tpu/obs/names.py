@@ -178,6 +178,37 @@ NAME_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "profile.capture": ("event", "a bounded on-demand jax.profiler "
                                  "capture finished (profile_capture "
                                  "wire command; trace dir in attrs)"),
+    # -- job survivability plane (r19 — coordinated fleet checkpointing,
+    # cold-restart resume, graceful drain; docs/checkpoint.md) -------------
+    "ckpt.save": ("span", "one worker's fleet-checkpoint save: device_get "
+                          "+ msgpack + atomic write (async tail included "
+                          "— the span closes when the blob is on disk)"),
+    "ckpt.intent": ("event", "scheduler journaled a fleet-checkpoint "
+                             "intent (attrs: step, epoch, workers)"),
+    "ckpt.ack": ("event", "scheduler recorded one worker's save ack "
+                          "(attrs: host, step)"),
+    "ckpt.commit": ("event", "all acks in — the manifest is journaled and "
+                             "the checkpoint is durable (attrs: step, "
+                             "epoch, workers, dur_ms, spread_ms)"),
+    "ckpt.abort": ("event", "a pending intent was abandoned (superseded "
+                            "or its worker set changed before commit)"),
+    "ckpt.resume": ("event", "cold-restart resume: the newest committed "
+                             "manifest was adopted (scheduler) / restored "
+                             "(worker)"),
+    "ckpt.committed_step": ("gauge", "global step of the newest committed "
+                                     "fleet checkpoint (scheduler view)"),
+    "ckpt.save_errors": ("counter", "background checkpoint writes that "
+                                    "failed (surfaced on the next save / "
+                                    "fit exit)"),
+    "drain.requested": ("event", "SIGTERM preemption notice received — "
+                                 "finish the current step, then depart "
+                                 "through the membership machinery"),
+    "drain.begin": ("event", "scheduler accepted a drain (attrs: host); "
+                             "the host leaves host_worker and the next "
+                             "barrier removes it"),
+    "drain.complete": ("event", "a draining worker departed cleanly (no "
+                                "crash bundle — the manifest carries a "
+                                "drain row instead)"),
     # -- fault injection (elastic/faults.py) -------------------------------
     "fault.*": ("event", "every APPLIED fault (fault.<kind>); the chaos "
                          "harness cross-checks these against "

@@ -1,4 +1,4 @@
-"""Checkpoint/resume: FULL TrainState, epoch-granular.
+"""Checkpoint/resume: FULL TrainState, epoch- or step-granular.
 
 Reference: ``mx.callback.do_checkpoint`` + ``mx.model.load_checkpoint``
 (``python/mxnet/callback.py:55-100``, SURVEY.md §5.4).  Deliberately better
@@ -9,26 +9,144 @@ flax msgpack, so resume is bit-exact.
 
 File layout per epoch (reference ``prefix-%04d.params`` convention kept):
 ``prefix-%04d.state`` (msgpack bytes) + ``prefix-symbol.json``-analog
-``prefix-meta.json`` (model name/config for the judge's parity check).
+``prefix-meta.json`` (model name/config for the judge's parity check; user
+keys stay at the top level — the reserved ``"checkpoints"`` key maps each
+saved tag to its content digest, byte count and optional data-iterator
+cursor, and is verified on load).  r19 fleet checkpoints (docs/checkpoint.md)
+save through this same path with the GLOBAL STEP as the tag and a cursor
+recording the data-iterator position, so a cold restart resumes mid-epoch.
+
+Failure discipline (r19): background (``async_save=True``) write errors are
+never dropped — outstanding saves are tracked, the first failure is
+re-raised on the NEXT save (or an explicit :func:`flush_saves`), and every
+failure bumps the ``ckpt.save_errors`` counter.  Torn/corrupt state files
+(``.tmp`` leftovers, zero-byte files, truncated msgpack, digest mismatch)
+raise :class:`CheckpointCorruptError` naming the file;
+:func:`load_latest_checkpoint` falls back to the previous intact tag.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
-from typing import Any, Optional, Tuple
+import threading
+from typing import Any, Dict, Optional, Tuple
 
 import flax.serialization
 import jax
 
+from dt_tpu.obs import trace as obs_trace
 from dt_tpu.training.train_state import TrainState
+
+
+class CheckpointSaveError(RuntimeError):
+    """A background (async) checkpoint write failed earlier; carries the
+    original error as ``__cause__``."""
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A state file is torn or fails its digest — the message names the
+    offending file so the operator knows exactly what to delete."""
+
+    def __init__(self, path: str, why: str):
+        super().__init__(f"corrupt checkpoint {path}: {why}")
+        self.path = path
+
+
+_track_lock = threading.Lock()
+_outstanding: set = set()  # in-flight async save Futures  # guarded-by: _track_lock
+_first_error: Optional[BaseException] = None  # guarded-by: _track_lock
+_meta_lock = threading.Lock()  # serializes prefix-meta.json read-modify-write
+
+
+def _digest(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _write_bytes(path: str, blob: bytes) -> None:
+    """Single write primitive — tests inject failures (ENOSPC et al.) by
+    monkeypatching this."""
+    with open(path, "wb") as f:
+        f.write(blob)
+
+
+def _meta_path(prefix: str) -> str:
+    return f"{prefix}-meta.json"
+
+
+def read_meta(prefix: str) -> Dict[str, Any]:
+    """The meta sidecar as a dict ({} when absent/unreadable)."""
+    try:
+        with open(_meta_path(prefix)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def checkpoint_info(prefix: str, tag: int) -> Optional[Dict[str, Any]]:
+    """The recorded entry (sha256/bytes/cursor) for one saved tag."""
+    return read_meta(prefix).get("checkpoints", {}).get(f"{tag:04d}")
+
+
+def _record_meta(prefix: str, tag: int, entry: Dict[str, Any],
+                 meta: Optional[dict]) -> None:
+    """Merge one checkpoint entry into the meta sidecar (user keys stay at
+    top level, written once; the ``checkpoints`` map accumulates)."""
+    with _meta_lock:
+        cur = read_meta(prefix)
+        if meta is not None:
+            for k, v in meta.items():
+                cur.setdefault(k, v)
+        cur.setdefault("checkpoints", {})[f"{tag:04d}"] = entry
+        mp = _meta_path(prefix)
+        tmp = mp + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cur, f, indent=2, sort_keys=True)
+        os.replace(tmp, mp)
+
+
+def _note_done(fut) -> None:
+    global _first_error
+    exc = fut.exception()
+    with _track_lock:
+        _outstanding.discard(fut)
+        if exc is not None and _first_error is None:
+            _first_error = exc
+    if exc is not None:
+        obs_trace.tracer().counter("ckpt.save_errors")
+
+
+def raise_pending_save_error() -> None:
+    """Surface (and clear) the first background save failure, if any."""
+    global _first_error
+    with _track_lock:
+        err, _first_error = _first_error, None
+    if err is not None:
+        raise CheckpointSaveError(
+            f"an earlier async checkpoint save failed: {err!r}") from err
+
+
+def flush_saves(timeout: Optional[float] = None,
+                raise_on_error: bool = True) -> None:
+    """Block until all outstanding async saves land; then surface the
+    first failure (fit's exit path calls this so a dying run never leaves
+    a silent half-written tail)."""
+    import concurrent.futures
+    with _track_lock:
+        pending = list(_outstanding)
+    if pending:
+        concurrent.futures.wait(pending, timeout=timeout)
+    if raise_on_error:
+        raise_pending_save_error()
 
 
 def save_checkpoint(prefix: str, epoch: int, state: TrainState,
                     meta: Optional[dict] = None,
-                    async_save: bool = False):
-    """Write ``prefix-%04d.state`` (+ ``prefix-meta.json`` once).
+                    async_save: bool = False,
+                    cursor: Optional[dict] = None):
+    """Write ``prefix-%04d.state`` (+ a digest row in ``prefix-meta.json``).
 
     ``async_save=True`` pulls the state to host RAM synchronously (cheap:
     DMA off HBM) and runs serialization + disk IO on a background thread
@@ -37,7 +155,10 @@ def save_checkpoint(prefix: str, epoch: int, state: TrainState,
     (``callback.py:55-100``).  Returns the path (sync) or a
     ``concurrent.futures.Future`` resolving to it (async); the write is
     still atomic (tmp + rename), so a crash mid-save never corrupts a
-    previous checkpoint."""
+    previous checkpoint.  ``cursor`` (r19 fleet checkpoints) is an
+    arbitrary JSON dict recorded alongside the digest — the data-iterator
+    position the resume path replays to."""
+    raise_pending_save_error()
     os.makedirs(os.path.dirname(os.path.abspath(prefix)) or ".", exist_ok=True)
     path = f"{prefix}-{epoch:04d}.state"
     # Pull to host before serializing (works for sharded jax.Arrays too:
@@ -54,17 +175,20 @@ def save_checkpoint(prefix: str, epoch: int, state: TrainState,
         blob = flax.serialization.msgpack_serialize(
             flax.serialization.to_state_dict(host_state))
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
+        _write_bytes(tmp, blob)
         os.replace(tmp, path)  # atomic, like the host_worker rewrite
-        meta_path = f"{prefix}-meta.json"
-        if meta is not None and not os.path.exists(meta_path):
-            with open(meta_path, "w") as f:
-                json.dump(meta, f, indent=2)
+        entry: Dict[str, Any] = {"sha256": _digest(blob), "bytes": len(blob)}
+        if cursor is not None:
+            entry["cursor"] = dict(cursor)
+        _record_meta(prefix, epoch, entry, meta)
         return path
 
     if async_save:
-        return _save_pool().submit(_write)
+        fut = _save_pool().submit(_write)
+        with _track_lock:
+            _outstanding.add(fut)
+        fut.add_done_callback(_note_done)
+        return fut
     return _write()
 
 
@@ -82,30 +206,114 @@ def _save_pool():
     return _pool
 
 
-def load_checkpoint(prefix: str, epoch: int, state: TrainState) -> TrainState:
-    """Restore into an existing (template) TrainState — shapes/treedef come
-    from the template, mirroring ``set_params`` semantics."""
+def _read_verified(prefix: str, epoch: int, verify: bool) -> bytes:
     path = f"{prefix}-{epoch:04d}.state"
     with open(path, "rb") as f:
         blob = f.read()
+    if not blob:
+        raise CheckpointCorruptError(path, "zero-byte file")
+    if verify:
+        ent = checkpoint_info(prefix, epoch)
+        if ent is not None and "sha256" in ent:
+            got = _digest(blob)
+            if got != ent["sha256"]:
+                raise CheckpointCorruptError(
+                    path, f"sha256 mismatch (file {got[:12]}… != recorded "
+                          f"{ent['sha256'][:12]}…)")
+    return blob
+
+
+def load_checkpoint(prefix: str, epoch: int, state: TrainState,
+                    verify: bool = True) -> TrainState:
+    """Restore into an existing (template) TrainState — shapes/treedef come
+    from the template, mirroring ``set_params`` semantics.  ``verify``
+    checks the recorded content digest (skipped for pre-r19 checkpoints
+    that have no entry); a torn/corrupt blob raises
+    :class:`CheckpointCorruptError` naming the file."""
+    path = f"{prefix}-{epoch:04d}.state"
+    blob = _read_verified(prefix, epoch, verify)
     template = {"step": state.step, "params": state.params,
                 "batch_stats": state.batch_stats, "opt_state": state.opt_state}
-    restored = flax.serialization.msgpack_restore(blob)
-    restored = flax.serialization.from_state_dict(template, restored)
+    try:
+        restored = flax.serialization.msgpack_restore(blob)
+        restored = flax.serialization.from_state_dict(template, restored)
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(path, f"undecodable msgpack ({e})") \
+            from e
     return state.replace(**restored)
 
 
-def latest_checkpoint(prefix: str) -> Optional[int]:
-    """Find the newest saved epoch for ``prefix`` (resume helper)."""
+def load_checkpoint_file(path: str, state: TrainState,
+                         sha256: Optional[str] = None) -> TrainState:
+    """Restore from one explicit state file, verifying against a digest
+    carried OUT-OF-BAND (the r19 fleet-checkpoint manifest journals each
+    worker's sha256, so a resuming worker can adopt ANY fleet member's
+    blob — data-parallel state is identical — without trusting the blob's
+    own sidecar)."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise CheckpointCorruptError(path, f"unreadable ({e})") from e
+    if not blob:
+        raise CheckpointCorruptError(path, "zero-byte file")
+    if sha256:
+        got = _digest(blob)
+        if got != sha256:
+            raise CheckpointCorruptError(
+                path, f"sha256 mismatch (file {got[:12]}… != manifest "
+                      f"{sha256[:12]}…)")
+    template = {"step": state.step, "params": state.params,
+                "batch_stats": state.batch_stats, "opt_state": state.opt_state}
+    try:
+        restored = flax.serialization.msgpack_restore(blob)
+        restored = flax.serialization.from_state_dict(template, restored)
+    except Exception as e:
+        raise CheckpointCorruptError(path, f"undecodable msgpack ({e})") \
+            from e
+    return state.replace(**restored)
+
+
+def _saved_tags(prefix: str):
+    """All intact-looking saved tags, ascending (``.tmp`` leftovers never
+    match the pattern; zero-byte files are torn writes and are skipped)."""
     d = os.path.dirname(os.path.abspath(prefix)) or "."
     base = os.path.basename(prefix)
-    best = None
     if not os.path.isdir(d):
-        return None
-    pat = re.compile(re.escape(base) + r"-(\d{4})\.state$")
+        return []
+    pat = re.compile(re.escape(base) + r"-(\d{4,})\.state$")
+    tags = []
     for name in os.listdir(d):
         m = pat.match(name)
-        if m:
-            e = int(m.group(1))
-            best = e if best is None else max(best, e)
-    return best
+        if not m:
+            continue
+        try:
+            if os.path.getsize(os.path.join(d, name)) == 0:
+                continue
+        except OSError:
+            continue
+        tags.append(int(m.group(1)))
+    return sorted(tags)
+
+
+def latest_checkpoint(prefix: str) -> Optional[int]:
+    """Find the newest saved epoch/step tag for ``prefix`` (resume
+    helper); ignores ``.tmp`` leftovers and zero-byte torn writes."""
+    tags = _saved_tags(prefix)
+    return tags[-1] if tags else None
+
+
+def load_latest_checkpoint(prefix: str, state: TrainState,
+                           verify: bool = True
+                           ) -> Optional[Tuple[int, TrainState]]:
+    """Restore the newest INTACT checkpoint, falling back tag by tag when
+    the newest is torn/corrupt (the previous committed one always wins).
+    Returns ``(tag, state)`` or ``None`` when nothing loadable exists."""
+    for tag in reversed(_saved_tags(prefix)):
+        try:
+            return tag, load_checkpoint(prefix, tag, state, verify=verify)
+        except CheckpointCorruptError:
+            continue
+    return None

@@ -707,6 +707,43 @@ class Module:
                            "host", None)
         _bb_dog = bb_lib.Watchdog(host=_bb_host, tracer=_obs) \
             if bb_lib.enabled() else None
+        # --- r19 survivability plane (docs/checkpoint.md): coordinated
+        # fleet checkpointing, cold-restart resume, graceful drain ---
+        from dt_tpu.elastic import drain as drain_lib
+        from dt_tpu.training import checkpoint as checkpoint_lib
+        from dt_tpu.training import fleet_ckpt
+        _ctrl = getattr(self.kv, "_controller", None)
+        _fc = fleet_ckpt.FleetCheckpointer.from_env(_ctrl, _bb_host)
+        # SIGTERM → graceful drain; installed AFTER blackbox.install
+        # (WorkerClient construction) so the FIRST term drains and the
+        # second escalates to the fatal-bundle disposition
+        drain_lib.install(_bb_host)
+        _resume_skip = 0
+        _mf = fleet_ckpt.resume_manifest(_ctrl)
+        if _mf is not None and not is_async:
+            # the injected crash-during-resume site (tests/test_ckpt.py,
+            # chaos --plan outage): dying HERE must leave the committed
+            # checkpoint reusable by the next restart
+            faults_lib.crash_point("worker.resume", host=_bb_host)
+            _new_state, _cur = fleet_ckpt.restore_state(
+                _mf, _bb_host, self.state)
+            # land restored host leaves back on the live mesh sharding
+            self.state = jax.tree_util.tree_map(
+                lambda x, ref: jax.device_put(x, ref.sharding)
+                if hasattr(ref, "sharding") else x,
+                _new_state, self.state)
+            begin_epoch = int(_mf["epoch"])
+            _resume_skip = int(_cur.get("batches_done", 0))
+            # evidence surface for the chaos --plan outage gates
+            self.resumed_from_step = int(_mf["step"])
+            # replay the completed epochs' data schedule (reset + drain,
+            # the public iterator protocol) so shuffle + ResizeIter
+            # refill state match the never-killed run exactly
+            fleet_ckpt.fast_forward(train_data, begin_epoch)
+            logger.info(
+                "cold-restart resume: step %d, epoch %d, %d batches "
+                "into the epoch", int(_mf["step"]), begin_epoch,
+                _resume_skip)
         try:
             for epoch in range(begin_epoch, num_epoch):
                 # named begin: an epoch the process dies inside shows in
@@ -782,6 +819,16 @@ class Module:
                 eval_metric.reset()
                 nbatch = 0
                 train_data.reset()
+                # steps applied this epoch — the fleet-checkpoint cursor
+                # (nbatch lags one step behind for the metric overlap)
+                applied = 0
+                if _resume_skip:
+                    # resumed mid-epoch: the checkpointed batches were
+                    # already applied before the outage — skip them (the
+                    # restored params include their updates)
+                    applied = fleet_ckpt.skip_batches(train_data,
+                                                      _resume_skip)
+                    _resume_skip = 0
                 # Metric updates run ONE STEP BEHIND: step N+1 is dispatched
                 # before step N's logits are fetched to host, so the device
                 # pipeline never drains for metrics (the async-dispatch analog
@@ -960,6 +1007,30 @@ class Module:
                             health is not None
                             and self._health_step(health, loss, epoch)):
                         break
+                    applied += 1
+                    if _fc is not None:
+                        # r19 cadence hook: state.step is identical
+                        # fleet-wide here (host-sync lockstep), so every
+                        # worker opens/joins the SAME two-phase window
+                        _fc.maybe_step(self.state, epoch, applied)
+                    if drain_lib.requested():
+                        # SIGTERM landed: this step is finished and its
+                        # update applied — leave through the membership
+                        # machinery, no collective error, no bundle
+                        drain_lib.announce(_bb_host)
+                        if _ctrl is not None:
+                            try:
+                                _ctrl.drain()
+                            except Exception as e:  # noqa: BLE001
+                                logger.warning("drain rpc failed: %s", e)
+                        if self.mesh_manager is not None:
+                            self.mesh_manager.depart(self.state)
+                        _obs.abandon(_obs_ep_t0)
+                        logger.info(
+                            "Epoch[%d] graceful drain after step %d; "
+                            "leaving the job", epoch,
+                            int(self.state.step))
+                        return eval_metric
                     # flush the PREVIOUS step's metric + its callback (its
                     # logits are ready by now; this step already runs on device)
                     if pending is not None:
@@ -1003,6 +1074,12 @@ class Module:
                 # --- epoch end: publish snapshot (store_aux_params analog,
                 # base_module.py:601-605) ---
                 self._publish_snapshot()
+                if _fc is not None:
+                    # a DRAINING scheduler flags ckpt_epoch_end on the
+                    # heartbeat channel; the boundary is the free
+                    # alignment point (same state.step fleet-wide), and
+                    # the cursor points at the NEXT epoch's first batch
+                    _fc.epoch_end(self.state, epoch + 1, 0)
                 if is_async and self.kv.rank == 0:
                     try:
                         st = self.kv.staleness_stats()
@@ -1025,6 +1102,10 @@ class Module:
                     if eval_end_callback is not None:
                         eval_end_callback(epoch, validation_metric)
 
+            # r19: drain any straggling async checkpoint write and
+            # surface the FIRST background failure before fit returns —
+            # an errored save must not vanish with the process
+            checkpoint_lib.flush_saves(timeout=120.0)
         except Exception as e:
             # r18 OOM forensics: a RESOURCE_EXHAUSTED death writes a
             # bundle carrying the live-buffer census before the

@@ -225,6 +225,9 @@ def main():
         # cleanly before a poisoned update; final_step/param_hash are
         # then the pre-fault prefix
         "health_halted": bool(getattr(mod, "health_halted", False)),
+        # r19 cold-restart resume (chaos --plan outage): the committed
+        # fleet-checkpoint step this incarnation restored from, or None
+        "resumed_from_step": getattr(mod, "resumed_from_step", None),
         # r14 policy accounting (dt_tpu/policy; chaos --plan straggler)
         "epoch_times": epoch_times,
         "sleep_by_epoch": sleep_by_epoch,

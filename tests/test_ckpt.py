@@ -1,0 +1,710 @@
+"""r19 preemption-proof training (docs/checkpoint.md).
+
+Pins the job-survivability plane:
+
+- the two-phase fleet-checkpoint protocol end to end over a real
+  in-process Scheduler + WorkerClients (intent dedup, digest manifest,
+  commit-on-last-ack, stale-ack replies, read-only manifest view);
+- the torn-protocol matrix: the journal cut during intent, during a
+  worker save (partial acks), and between the LAST ack and the commit —
+  a ``resume=True`` boot must recover to the PREVIOUS committed
+  checkpoint every time — plus a crash *during resume* (two successive
+  resume boots on one journal);
+- the ``resume`` ControlState op's state machine (dead incarnation
+  cleared, committed manifest + monotone seqs preserved, re-init into a
+  resized fleet) and its byte-replay determinism;
+- graceful drain: the ``drain`` RPC removes the host through the
+  eviction machinery, aborts a checkpoint window pinned to it, and the
+  SIGTERM module's one-shot announce leaves a ``kind="drain"`` manifest
+  row (no crash bundle);
+- checkpoint-file hardening (satellites): async-save failures surface
+  on the NEXT save, torn/corrupt state files are detected byte-for-byte
+  and fall back tag by tag, ``.tmp``/zero-byte leftovers are invisible;
+- ``DT_CTRL_SNAP_KEEP`` bounds (journal snapshot-sidecar retention);
+- cursor replay: ``fast_forward`` + ``skip_batches`` land a fresh
+  iterator on exactly the batch the checkpointed run would see next.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dt_tpu import data
+from dt_tpu.elastic import Scheduler, WorkerClient, drain, faults, journal
+from dt_tpu.elastic.journal import ControlState
+from dt_tpu.obs import blackbox as obs_blackbox
+from dt_tpu.obs import trace as obs_trace
+from dt_tpu.training import checkpoint, fleet_ckpt
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("DT_FAULT_PLAN", "DT_CTRL_ENDPOINTS", "DT_CKPT_DIR",
+                "DT_CKPT_EVERY", "DT_RESUME", "DT_BLACKBOX",
+                "DT_BLACKBOX_DIR", "DT_CTRL_SNAP_KEEP"):
+        monkeypatch.delenv(var, raising=False)
+    faults.clear()
+    drain._reset_for_tests()
+    checkpoint.raise_pending_save_error()  # drop stale cross-test errors
+    yield
+    faults.clear()
+    drain._reset_for_tests()
+    obs_blackbox._reset_for_tests()
+    obs_blackbox.set_enabled(None)
+    obs_trace.set_enabled(None)
+    try:
+        checkpoint.raise_pending_save_error()
+    except checkpoint.CheckpointSaveError:
+        pass
+
+
+def _client(port, host, **kw):
+    return WorkerClient("127.0.0.1", port, host=host,
+                        heartbeat_interval_s=30.0, **kw)
+
+
+def _write_hosts(path, hosts):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(hosts) + "\n")
+    os.replace(tmp, path)
+
+
+def _live_struct(sched):
+    with sched._lock:
+        return sched._state.struct()
+
+
+def _close_all(sched, clients):
+    for c in clients:
+        try:
+            c.close()
+        except Exception:
+            pass
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# two-phase protocol over a real scheduler
+# ---------------------------------------------------------------------------
+
+def test_two_phase_commit_flow(tmp_path):
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1"])
+    jp = str(tmp_path / "ctrl.journal")
+    sched = Scheduler(host_worker_file=hw, journal_path=jp)
+    cs = []
+    try:
+        cs = [_client(sched.port, h) for h in ("w0", "w1")]
+        c0, c1 = cs
+
+        r0 = c0.ckpt_begin(8, 1)
+        assert r0["ok"]
+        # the second worker JOINS the same window (same seq back)
+        r1 = c1.ckpt_begin(8, 1)
+        assert r1["ok"] and r1["seq"] == r0["seq"]
+        # an older step can never open a window behind the pending one
+        assert not c0.ckpt_begin(4, 0)["ok"]
+
+        cur = {"batches_done": 3, "epoch": 1, "step": 8}
+        a0 = c0.ckpt_ack(8, "/d/w0/fleet-0008.state", "aa" * 32, cur)
+        assert a0 == {"committed": False}
+        st = _live_struct(sched)
+        assert st["ckpt_pending"]["step"] == 8
+        assert sorted(st["ckpt_pending"]["acks"]) == ["w0"]
+
+        a1 = c1.ckpt_ack(8, "/d/w1/fleet-0008.state", "bb" * 32, cur)
+        assert a1 == {"committed": True}
+        st = _live_struct(sched)
+        assert st["ckpt_pending"] is None
+        com = st["ckpt_committed"]
+        assert com["step"] == 8 and com["epoch"] == 1
+        assert sorted(com["files"]) == ["w0", "w1"]
+        assert com["files"]["w0"]["sha256"] == "aa" * 32
+        assert com["files"]["w0"]["cursor"]["batches_done"] == 3
+
+        # replayed ack after the commit reports success (idempotent)
+        assert c0.ckpt_ack(8, "/d/w0/fleet-0008.state", "aa" * 32,
+                           cur)["committed"]
+        # a later intent for an ALREADY COMMITTED step is refused
+        assert c1.ckpt_begin(8, 1)["reason"] == "already_committed"
+        # the read-only manifest view serves both sides
+        view = c0.ckpt_manifest()
+        assert view["committed"]["step"] == 8
+        assert view["pending"] is None
+
+        # the journal replays to exactly the live state (DT013 bar)
+        assert ControlState.rebuild(
+            jp).struct() == _live_struct(sched)
+    finally:
+        _close_all(sched, cs)
+
+
+def test_newer_intent_supersedes_stuck_window(tmp_path):
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1"])
+    sched = Scheduler(host_worker_file=hw,
+                      journal_path=str(tmp_path / "j"))
+    cs = []
+    try:
+        cs = [_client(sched.port, h) for h in ("w0", "w1")]
+        c0, c1 = cs
+        assert c0.ckpt_begin(8, 1)["ok"]
+        c0.ckpt_ack(8, "/d/w0-8", "aa", {})
+        # w1 never saved; the fleet reaches the next cadence step
+        assert c1.ckpt_begin(16, 2)["ok"]
+        st = _live_struct(sched)
+        assert st["ckpt_pending"]["step"] == 16
+        assert st["ckpt_committed"] is None
+        # the torn window's late ack is stale, not resurrected
+        assert c0.ckpt_ack(8, "/d/w0-8", "aa", {}) == {
+            "committed": False, "stale": True}
+    finally:
+        _close_all(sched, cs)
+
+
+# ---------------------------------------------------------------------------
+# torn-protocol matrix: crash at every stage, previous commit wins
+# ---------------------------------------------------------------------------
+
+def _journal_with(tmp_path, ops):
+    """Author a journal as the dead incarnation would have left it."""
+    jp = str(tmp_path / "ctrl.journal")
+    w = journal.JournalWriter(jp, fence=1)
+    for op, kw in ops:
+        w.append(op, kw)
+    w.close()
+    return jp
+
+
+_PREV_COMMIT = {"step": 8, "epoch": 1, "seq": 1, "workers": ["w0", "w1"],
+                "files": {"w0": {"path": "/d/w0-8", "sha256": "aa",
+                                 "cursor": {"batches_done": 3, "epoch": 1,
+                                            "step": 8}},
+                          "w1": {"path": "/d/w1-8", "sha256": "bb",
+                                 "cursor": {"batches_done": 3, "epoch": 1,
+                                            "step": 8}}}}
+
+
+def _base_ops():
+    return [
+        ("init", {"workers": ["w0", "w1"], "expected": 2}),
+        ("worker_add", {"host": "w0", "base": True}),
+        ("worker_add", {"host": "w1", "base": True}),
+        ("ckpt_intent", {"step": 8, "epoch": 1, "seq": 1,
+                         "workers": ["w0", "w1"]}),
+        ("ckpt_ack", {"step": 8, "host": "w0", "path": "/d/w0-8",
+                      "sha256": "aa", "cursor": {"batches_done": 3,
+                                                 "epoch": 1, "step": 8}}),
+        ("ckpt_ack", {"step": 8, "host": "w1", "path": "/d/w1-8",
+                      "sha256": "bb", "cursor": {"batches_done": 3,
+                                                 "epoch": 1, "step": 8}}),
+        ("ckpt_commit", {"step": 8, "manifest": _PREV_COMMIT}),
+    ]
+
+
+@pytest.mark.parametrize("torn_tail", [
+    # crash right after the NEXT window's intent was journaled
+    [("ckpt_intent", {"step": 16, "epoch": 2, "seq": 2,
+                      "workers": ["w0", "w1"]})],
+    # crash while workers were saving (one ack journaled)
+    [("ckpt_intent", {"step": 16, "epoch": 2, "seq": 2,
+                      "workers": ["w0", "w1"]}),
+     ("ckpt_ack", {"step": 16, "host": "w0", "path": "/d/w0-16",
+                   "sha256": "cc", "cursor": {"batches_done": 2,
+                                              "epoch": 2, "step": 16}})],
+    # crash between the LAST ack and the commit (every ack journaled)
+    [("ckpt_intent", {"step": 16, "epoch": 2, "seq": 2,
+                      "workers": ["w0", "w1"]}),
+     ("ckpt_ack", {"step": 16, "host": "w0", "path": "/d/w0-16",
+                   "sha256": "cc", "cursor": {"batches_done": 2,
+                                              "epoch": 2, "step": 16}}),
+     ("ckpt_ack", {"step": 16, "host": "w1", "path": "/d/w1-16",
+                   "sha256": "dd", "cursor": {"batches_done": 2,
+                                              "epoch": 2, "step": 16}})],
+], ids=["torn_at_intent", "torn_mid_save", "torn_before_commit"])
+def test_torn_window_recovers_to_previous_commit(tmp_path, torn_tail):
+    jp = _journal_with(tmp_path, _base_ops() + torn_tail)
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1"])
+    sched = Scheduler(host_worker_file=hw, journal_path=jp, resume=True)
+    c = None
+    try:
+        st = _live_struct(sched)
+        # the torn step-16 window is GARBAGE; step 8 is the resume point
+        assert st["ckpt_pending"] is None
+        assert st["ckpt_committed"]["step"] == 8
+        assert st["last_completed_epoch"] == 0  # resume epoch = 1
+        assert st["workers"] == ["w0", "w1"]  # re-seeded from host file
+        # a registering worker is handed the step-8 manifest
+        c = _client(sched.port, "w0")
+        assert c.resume["step"] == 8 and c.resume["epoch"] == 1
+        assert c.resume["files"]["w0"]["sha256"] == "aa"
+        # replay == live, including the resume transition (DT013 bar)
+        assert ControlState.rebuild(
+            jp).struct() == _live_struct(sched)
+    finally:
+        _close_all(sched, [c] if c else [])
+
+
+def test_torn_with_no_prior_commit_resumes_fresh(tmp_path):
+    ops = _base_ops()[:-1]  # intent + both acks, commit never journaled
+    jp = _journal_with(tmp_path, ops)
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1"])
+    sched = Scheduler(host_worker_file=hw, journal_path=jp, resume=True)
+    c = None
+    try:
+        st = _live_struct(sched)
+        assert st["ckpt_committed"] is None
+        assert st["ckpt_pending"] is None
+        assert st["last_completed_epoch"] == -1  # from epoch 0, scratch
+        c = _client(sched.port, "w0")
+        assert c.resume is None  # nothing to resume from
+    finally:
+        _close_all(sched, [c] if c else [])
+
+
+def test_crash_during_resume_boots_again(tmp_path):
+    """A resume boot that itself dies leaves a journal the NEXT resume
+    boot replays to the same committed manifest (resume is re-runnable:
+    absolute seqs, forward-only commits)."""
+    jp = _journal_with(tmp_path, _base_ops())
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1"])
+    s1 = Scheduler(host_worker_file=hw, journal_path=jp, resume=True)
+    assert _live_struct(s1)["resume_seq"] == 1
+    s1.close()  # "crash" mid-resume: workers never came back
+    s2 = Scheduler(host_worker_file=hw, journal_path=jp, resume=True)
+    c = None
+    try:
+        st = _live_struct(s2)
+        assert st["resume_seq"] == 2  # second resume op, same outcome
+        assert st["ckpt_committed"]["step"] == 8
+        c = _client(s2.port, "w1")
+        assert c.resume["step"] == 8
+        assert ControlState.rebuild(
+            jp).struct() == _live_struct(s2)
+    finally:
+        _close_all(s2, [c] if c else [])
+
+
+def test_elastic_resume_resized_fleet(tmp_path):
+    """Resume into N±1 workers: the host file (not the dead
+    incarnation's membership) seeds the fleet, and a NEW worker with no
+    blob of its own still gets the manifest (it adopts any member's
+    identical data-parallel state)."""
+    jp = _journal_with(tmp_path, _base_ops())
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1", "w2"])  # grew by one across the outage
+    sched = Scheduler(host_worker_file=hw, journal_path=jp, resume=True)
+    c = None
+    try:
+        st = _live_struct(sched)
+        assert st["workers"] == ["w0", "w1", "w2"]
+        c = _client(sched.port, "w2")
+        assert c.resume["step"] == 8
+        assert "w2" not in c.resume["files"]  # adopts a donor blob
+    finally:
+        _close_all(sched, [c] if c else [])
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+def test_drain_rpc_removes_host_and_aborts_pinned_window(tmp_path):
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0", "w1"])
+    jp = str(tmp_path / "j")
+    sched = Scheduler(host_worker_file=hw, journal_path=jp)
+    cs = []
+    try:
+        cs = [_client(sched.port, h) for h in ("w0", "w1")]
+        c0, c1 = cs
+        assert c0.ckpt_begin(8, 1)["ok"]  # window pinned to {w0, w1}
+        assert c1.drain()["ok"]
+        st = _live_struct(sched)
+        assert st["workers"] == ["w0"]
+        assert st["draining"] == ["w1"]
+        # the checkpoint window pinned to the departed worker aborted;
+        # nothing was committed
+        assert st["ckpt_pending"] is None
+        assert st["ckpt_committed"] is None
+        # drain is idempotent (client retry after a lost response)
+        assert c1.drain()["already"]
+        # the drained host left the host file (no resurrection at the
+        # next barrier diff)
+        with open(hw) as f:
+            assert f.read().split() == ["w0"]
+        assert ControlState.rebuild(
+            jp).struct() == _live_struct(sched)
+    finally:
+        _close_all(sched, cs)
+
+
+def test_drain_module_sigterm_flow(tmp_path, monkeypatch):
+    monkeypatch.setenv("DT_BLACKBOX_DIR", str(tmp_path / "bb"))
+    obs_blackbox._reset_for_tests()
+    obs_blackbox.set_enabled(True)  # enabled() caches the env read
+    assert not drain.requested()
+    assert drain.install("w1")
+    drain.request()  # the programmatic stand-in for a delivered SIGTERM
+    assert drain.requested()
+    # one-shot announce: manifest drain row, no bundle
+    assert drain.announce("w1")
+    assert not drain.announce("w1")  # second call is a no-op
+    rows = obs_blackbox.read_manifest(str(tmp_path / "bb"))
+    drains = [r for r in rows if r.get("kind") == "drain"]
+    assert len(drains) == 1
+    assert drains[0]["host"] == "w1" and drains[0]["fatal"] is False
+    assert not [r for r in rows if r.get("kind") == "bundle"]
+
+
+def _busy_sleep(sec):
+    import time as _t
+    _t.sleep(sec)
+    return sec
+
+
+def test_drain_handler_not_inherited_by_forked_pool():
+    # Regression: forked multiprocessing children inherit the parent's
+    # SIGTERM disposition.  A pool worker BUSY in a task when close()
+    # fires is the DataLoader shape: terminate()'s drain step can eat
+    # the exit sentinels, so p.terminate()'s SIGTERM is the only thing
+    # standing between a busy worker and a forever-blocked parent
+    # join() — and without the PID guard the inherited drain handler
+    # swallows it (sets the parent's flag, sleeps on).
+    import multiprocessing
+    import threading
+    import time
+
+    assert drain.install("w0")
+    ctx = multiprocessing.get_context("fork")
+    pool = ctx.Pool(2)
+    procs = list(pool._pool)
+    try:
+        for _ in range(2):
+            pool.apply_async(_busy_sleep, (600,))
+        time.sleep(0.5)  # both workers mid-task
+        # terminate() joins the workers internally — on regression it is
+        # the call that wedges, so it runs on a watchdogged thread
+        closer = threading.Thread(
+            target=lambda: (pool.terminate(), pool.join()), daemon=True)
+        closer.start()
+        closer.join(timeout=20)
+        hung = closer.is_alive()
+    finally:
+        for p in procs:  # unwedge a failed run so pytest can exit
+            if p.is_alive():
+                p.kill()
+    assert not hung, \
+        "Pool.terminate() hung: drain handler leaked into child"
+    # the children dying from TERM must not mark the PARENT draining
+    assert not drain.requested()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-file hardening (satellites)
+# ---------------------------------------------------------------------------
+
+def _tiny_state():
+    import jax
+    import jax.numpy as jnp
+    from dt_tpu import models, optim
+    from dt_tpu.training import TrainState
+    model = models.create("mlp", num_classes=3, hidden=(8,))
+    x = jnp.ones((2, 4, 4, 1))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x,
+                           training=False)
+    tx = optim.create("sgd", learning_rate=0.1, momentum=0.9)
+    return TrainState.create(model.apply, variables["params"], tx)
+
+
+def test_async_save_failure_surfaces_on_next_save(tmp_path, monkeypatch):
+    state = _tiny_state()
+    prefix = str(tmp_path / "ckpt")
+    boom = OSError(28, "No space left on device")
+
+    def _fail(path, blob):
+        raise boom
+
+    before = obs_trace.tracer().counters().get("ckpt.save_errors", 0)
+    monkeypatch.setattr(checkpoint, "_write_bytes", _fail)
+    fut = checkpoint.save_checkpoint(prefix, 1, state, async_save=True)
+    with pytest.raises(OSError):
+        fut.result(timeout=30)
+    monkeypatch.undo()
+    # the NEXT save surfaces the failure loudly instead of dropping it
+    with pytest.raises(checkpoint.CheckpointSaveError) as ei:
+        checkpoint.save_checkpoint(prefix, 2, state, async_save=True)
+    assert ei.value.__cause__ is boom
+    assert obs_trace.tracer().counters()["ckpt.save_errors"] == before + 1
+    # the error is cleared once raised; saves work again
+    p = checkpoint.save_checkpoint(prefix, 3, state)
+    assert os.path.exists(p)
+    checkpoint.flush_saves(timeout=30)
+
+
+def test_flush_saves_surfaces_failure(tmp_path, monkeypatch):
+    state = _tiny_state()
+    monkeypatch.setattr(checkpoint, "_write_bytes",
+                        lambda p, b: (_ for _ in ()).throw(OSError("io")))
+    fut = checkpoint.save_checkpoint(str(tmp_path / "c"), 1, state,
+                                     async_save=True)
+    with pytest.raises(checkpoint.CheckpointSaveError):
+        checkpoint.flush_saves(timeout=30)
+    assert fut.done()
+
+
+def test_corrupt_state_file_detected_at_offsets(tmp_path):
+    state = _tiny_state()
+    prefix = str(tmp_path / "ckpt")
+    path = checkpoint.save_checkpoint(prefix, 5, state)
+    blob = open(path, "rb").read()
+    for cut in (0, 1, len(blob) // 2, len(blob) - 1):
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        with pytest.raises(checkpoint.CheckpointCorruptError) as ei:
+            checkpoint.load_checkpoint(prefix, 5, state)
+        assert path in str(ei.value)
+    # flipped bytes (same length) fail the recorded digest
+    with open(path, "wb") as f:
+        f.write(blob[:-8] + bytes(8))
+    with pytest.raises(checkpoint.CheckpointCorruptError) as ei:
+        checkpoint.load_checkpoint(prefix, 5, state)
+    assert "sha256 mismatch" in str(ei.value)
+    # restore the good bytes: loads again
+    with open(path, "wb") as f:
+        f.write(blob)
+    checkpoint.load_checkpoint(prefix, 5, state)
+
+
+def test_load_latest_falls_back_past_corrupt_newest(tmp_path):
+    state = _tiny_state()
+    prefix = str(tmp_path / "ckpt")
+    checkpoint.save_checkpoint(prefix, 1, state)
+    p2 = checkpoint.save_checkpoint(prefix, 2, state)
+    with open(p2, "r+b") as f:  # tear the newest
+        f.truncate(7)
+    got = checkpoint.load_latest_checkpoint(prefix, state)
+    assert got is not None and got[0] == 1
+
+
+def test_saved_tags_ignore_tmp_and_zero_byte(tmp_path):
+    state = _tiny_state()
+    prefix = str(tmp_path / "ckpt")
+    checkpoint.save_checkpoint(prefix, 1, state)
+    open(f"{prefix}-0002.state.tmp", "wb").write(b"half")
+    open(f"{prefix}-0003.state", "wb").close()  # zero-byte torn write
+    assert checkpoint.latest_checkpoint(prefix) == 1
+
+
+def test_load_checkpoint_file_manifest_digest(tmp_path):
+    state = _tiny_state()
+    prefix = str(tmp_path / "ckpt")
+    path = checkpoint.save_checkpoint(prefix, 8, state)
+    sha = checkpoint.checkpoint_info(prefix, 8)["sha256"]
+    restored = checkpoint.load_checkpoint_file(path, state, sha256=sha)
+    assert int(restored.step) == int(state.step)
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.load_checkpoint_file(path, state, sha256="00" * 32)
+
+
+def test_step_tags_beyond_four_digits(tmp_path):
+    """Fleet checkpoints tag by GLOBAL STEP, which outgrows 4 digits."""
+    state = _tiny_state()
+    prefix = str(tmp_path / "ckpt")
+    checkpoint.save_checkpoint(prefix, 12000, state)
+    assert checkpoint.latest_checkpoint(prefix) == 12000
+    got = checkpoint.load_latest_checkpoint(prefix, state)
+    assert got is not None and got[0] == 12000
+
+
+# ---------------------------------------------------------------------------
+# DT_CTRL_SNAP_KEEP (satellite: the promoted _SNAP_KEEP constant)
+# ---------------------------------------------------------------------------
+
+def test_snap_keep_env_bounds(monkeypatch):
+    assert journal._snap_keep() == 2  # registry default
+    monkeypatch.setenv("DT_CTRL_SNAP_KEEP", "5")
+    assert journal._snap_keep() == 5
+    monkeypatch.setenv("DT_CTRL_SNAP_KEEP", "0")
+    assert journal._snap_keep() == 1  # the fresh sidecar must survive
+    monkeypatch.setenv("DT_CTRL_SNAP_KEEP", "junk")
+    assert journal._snap_keep() == 2  # unparseable -> default
+
+
+def test_snap_keep_prunes_sidecars(tmp_path, monkeypatch):
+    monkeypatch.setenv("DT_CTRL_SNAP_KEEP", "1")
+    jp = str(tmp_path / "ctrl.journal")
+    for i in range(3):
+        journal.write_snapshot_sidecar(jp, {"epoch": i})
+    snaps = [n for n in os.listdir(tmp_path)
+             if n.startswith("ctrl.journal.snap.")]
+    assert len(snaps) == 1  # only the newest survives keep=1
+
+
+# ---------------------------------------------------------------------------
+# cursor replay: the resumed data schedule is the never-killed schedule
+# ---------------------------------------------------------------------------
+
+def _consume(it):
+    out = []
+    try:
+        while True:
+            out.append(np.asarray(it.next().data).copy())
+    except StopIteration:
+        return out
+
+
+def _make_iter(seed=7):
+    rng = np.random.RandomState(0)
+    x = rng.rand(23, 4).astype(np.float32)
+    y = np.arange(23) % 3
+    return data.NDArrayIter(x, y, batch_size=4, shuffle=True, seed=seed)
+
+
+def test_fast_forward_and_skip_replay_exactly():
+    # the original run: two full epochs, then 3 batches into epoch 2
+    orig = _make_iter()
+    for _ in range(2):
+        orig.reset()
+        _consume(orig)
+    orig.reset()
+    for _ in range(3):
+        orig.next()
+    expect_next = np.asarray(orig.next().data).copy()  # batch index 3
+
+    # the resumed run: fresh iterator, cursor {epoch: 2, batches_done: 3}
+    res = _make_iter()
+    fleet_ckpt.fast_forward(res, 2)
+    res.reset()  # fit's own per-epoch reset
+    assert fleet_ckpt.skip_batches(res, 3) == 3
+    np.testing.assert_array_equal(np.asarray(res.next().data), expect_next)
+
+
+def test_skip_batches_tolerates_short_epoch():
+    it = _make_iter()
+    it.reset()
+    n_total = len(_consume(it))
+    it.reset()
+    assert fleet_ckpt.skip_batches(it, n_total + 5) == n_total
+
+
+# ---------------------------------------------------------------------------
+# FleetCheckpointer wiring
+# ---------------------------------------------------------------------------
+
+def test_fleet_checkpointer_from_env(monkeypatch, tmp_path):
+    assert fleet_ckpt.FleetCheckpointer.from_env(object(), "w0") is None
+    monkeypatch.setenv("DT_CKPT_DIR", str(tmp_path))
+    assert fleet_ckpt.FleetCheckpointer.from_env(None, "w0") is None
+    monkeypatch.setenv("DT_CKPT_EVERY", "8")
+    fc = fleet_ckpt.FleetCheckpointer.from_env(object(), "w0")
+    assert fc is not None and fc.every == 8
+    assert fc.prefix == os.path.join(str(tmp_path), "w0", "fleet")
+
+
+def test_fleet_checkpoint_round_trip_via_scheduler(tmp_path, monkeypatch):
+    """One real two-phase round driven by FleetCheckpointer against a
+    real scheduler, then a restore through the committed manifest."""
+    monkeypatch.setenv("DT_CKPT_DIR", str(tmp_path / "fleet"))
+    monkeypatch.setenv("DT_CKPT_EVERY", "1")
+    hw = str(tmp_path / "hosts")
+    _write_hosts(hw, ["w0"])
+    jp = str(tmp_path / "j")
+    sched = Scheduler(host_worker_file=hw, journal_path=jp)
+    cs = []
+    try:
+        c0 = _client(sched.port, "w0")
+        cs = [c0]
+        state = _tiny_state()
+        import jax.numpy as jnp
+        state = state.replace(step=jnp.asarray(8))
+        fc = fleet_ckpt.FleetCheckpointer.from_env(c0, "w0")
+        fc.maybe_step(state, 1, 3)
+        checkpoint.flush_saves(timeout=30)
+        deadline = __import__("time").time() + 30
+        while __import__("time").time() < deadline:
+            st = _live_struct(sched)
+            if st["ckpt_committed"] is not None:
+                break
+            __import__("time").sleep(0.05)
+        com = _live_struct(sched)["ckpt_committed"]
+        assert com is not None and com["step"] == 8
+        ent = com["files"]["w0"]
+        assert ent["cursor"] == {"batches_done": 3, "epoch": 1, "step": 8}
+        # restore via the manifest path (digest checked out-of-band)
+        restored, cur = fleet_ckpt.restore_state(com, "w0", _tiny_state())
+        assert int(restored.step) == 8
+        assert cur["batches_done"] == 3
+        # determinism bar: the manifest is byte-stable json
+        js = json.dumps(com, sort_keys=True)
+        assert json.loads(js) == com
+    finally:
+        _close_all(sched, cs)
+
+
+# ---------------------------------------------------------------------------
+# dtop checkpoint/drain timeline golden (render contract, like the
+# device-board golden)
+# ---------------------------------------------------------------------------
+
+
+def _ckpt_job():
+    """A pinned control-plane track whose ckpt.*/drain.* instants cover
+    every row kind the dtop timeline renders."""
+    def rec(seq, name, ts, attrs):
+        return ["i", seq, name, ts, None, 1, None, None, attrs]
+    records = [
+        rec(1, "ckpt.intent", 1000,
+            {"step": 8, "epoch": 1, "workers": ["w0", "w1"]}),
+        rec(2, "ckpt.ack", 1500, {"host": "w0", "step": 8}),
+        rec(3, "ckpt.commit", 2000,
+            {"step": 8, "dur_ms": 12.5, "spread_ms": 3.25}),
+        rec(4, "drain.requested", 2500, {"host": "w1"}),
+        rec(5, "ckpt.abort", 3000,
+            {"step": 16, "reason": "member_lost:w1"}),
+        rec(6, "drain.complete", 3500, {"host": "w1"}),
+        rec(7, "ckpt.resume", 4000,
+            {"step": 8, "epoch": 1, "workers": ["w0", "w1"]}),
+    ]
+    return {"tracks": {"control-plane#1": {
+        "records": records, "counters": {}, "dropped": 0}}}
+
+
+def test_export_folds_ckpt_timeline():
+    from dt_tpu.obs import export as obs_export
+    chrome = obs_export.chrome_trace(_ckpt_job())
+    tl = obs_export.summarize_chrome(chrome)["checkpoint"]
+    assert [e["what"] for e in tl] == [
+        "ckpt.intent", "ckpt.ack", "ckpt.commit", "drain.requested",
+        "ckpt.abort", "drain.complete", "ckpt.resume"]
+    assert tl[2]["dur_ms"] == 12.5 and tl[2]["spread_ms"] == 3.25
+    assert tl[4]["reason"] == "member_lost:w1"
+    # attrs outside the schema (seq, sid, ...) must not leak through
+    assert "seq" not in tl[0]
+
+
+def test_dtop_checkpoint_timeline_golden(tmp_path):
+    import subprocess
+    import sys
+
+    from dt_tpu.obs import export as obs_export
+    chrome = obs_export.chrome_trace(_ckpt_job())
+    trace = str(tmp_path / "t.json")
+    with open(trace, "w") as f:
+        json.dump(chrome, f)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "dtop.py"), trace],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    start = r.stdout.index("checkpoint/drain timeline")
+    section = r.stdout[start:].split("\n\n")[0] + "\n"
+    golden = os.path.join(repo, "tests", "fixtures",
+                          "ckpt_timeline.golden")
+    assert section == open(golden).read(), section

@@ -89,6 +89,33 @@ renders the report from the bundle dir alone.  The crash-bearing plans
 (``default``, ``scheduler_kill*``, ``nan``) additionally assert a
 schema-complete bundle per killed/halted process.
 
+**Preemption plans (r19 survivability plane, docs/checkpoint.md):**
+``--plan preempt`` SIGTERMs one worker mid-epoch: the drain handler
+finishes the current step, sends the ``drain`` wire command, and leaves
+through the journaled eviction machinery — no collective error, no
+recovery window, no crash bundle; the departure is a ``kind="drain"``
+manifest row.  Success adds: every worker (including the drained one)
+exits 0, survivors hold bit-identical params, membership converged to
+the survivors, and the drained host left a drain row but NO fatal
+bundle.  ``--plan outage`` is the full preemption: the scheduler runs
+as a REAL process with a seeded ``sched.allreduce`` crash rule while
+workers cut coordinated fleet checkpoints every ``OUTAGE_CKPT_EVERY``
+steps (``DT_CKPT_DIR``/``DT_CKPT_EVERY``); when the scheduler dies 137
+the harness SIGKILLs every worker (a preemption takes the whole job),
+then restarts the fleet cold — an in-process scheduler with
+``resume=True`` on the SAME journal plus fresh workers with
+``DT_RESUME=1`` — and the job continues from the committed manifest to
+completion.  Success adds: a checkpoint committed before the kill,
+every resumed worker restored from the SAME committed step, final
+params bit-identical across the fleet and (via ``--expect-param-hash``
+against ``--plan none``) bit-identical to a never-killed run, the
+phase-2 journal replays to the live state, checkpointing advanced past
+the restored step, and recompile churn stayed bounded.
+``--resume-workers 2`` / ``--resume-workers 4`` resume the SAME
+checkpoint into a shrunk/grown fleet (elastic cold restart; no
+baseline bit-identity then — the partitioning changed — but the run
+must complete with churn bounded).
+
 Usage::
 
     python tools/chaos_run.py --seed 0 --plan default
@@ -97,6 +124,8 @@ Usage::
     python tools/chaos_run.py --plan straggler     # policy-engine drill
     python tools/chaos_run.py --plan nan           # health-sentinel drill
     python tools/chaos_run.py --plan hang          # flight-recorder drill
+    python tools/chaos_run.py --plan preempt       # graceful-drain drill
+    python tools/chaos_run.py --plan outage        # kill + resume drill
 
 Prints one JSON summary line and exits non-zero on any failed check.
 """
@@ -105,6 +134,7 @@ import argparse
 import json
 import math
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -143,6 +173,19 @@ HANG_S = 2.0
 #: slack on the watchdog's reported stall age: poll period (hang_s/4)
 #: plus CPU scheduling noise on a loaded box
 HANG_SLACK_S = 3.0
+#: r19 preempt plan: the worker the harness SIGTERMs mid-epoch — it
+#: must leave through the graceful-drain path, not die
+DRAIN_HOST = "w2"
+#: r19 outage plan: fleet-checkpoint cadence (steps).  8 steps/epoch
+#: puts every other checkpoint MID-epoch, so the resume exercises the
+#: data-cursor replay, not just the epoch boundary
+OUTAGE_CKPT_EVERY = 5
+#: the seeded kill for the outage plan — same timing as the proven
+#: scheduler_kill site (w0's ~16 allreduce receipts/epoch put after=25
+#: mid-epoch-2, with the step-5 and step-10 checkpoint commits behind)
+OUTAGE_KILL_SITE = dict(site="sched.allreduce", host="w0", after=25)
+#: the grown fleet for --resume-workers 4 draws the extra host here
+EXTRA_HOSTS = ["w3"]
 #: r15 health plane: metrics on, with the round_wait SLO threshold
 #: lowered to the straggler probe's scale through the declarative
 #: DT_SLO_RULES override (docs/observability.md)
@@ -163,6 +206,30 @@ SCHED_KILL_SITES = {
                                    host="w1", after=2),
     "scheduler_kill_mc": dict(site="sched.membership_change", after=2),
 }
+
+
+def _churn_ok(r):
+    """The r18 recompile-churn invariant over one worker's result dict:
+    the only recompiles allowed are the program rebuilds fit performed
+    (mesh_rebuilds) and the shape recompiles its reshards legitimately
+    imply — a silent recompile storm fails here by name."""
+    d = r.get("device") or {}
+    fams = ("train_step", "grad_step", "apply_step")
+    rebuilds = r.get("mesh_rebuilds", 0)
+    reshards = r.get("resharded", 0)
+    # the UNTRUNCATED bound first: per-what build counts cover every
+    # recompile (recompile_log is a bounded window, so a storm could
+    # scroll its early rebuild entries out of the visible log)
+    bw = d.get("by_what", {})
+    total = sum(max(0, bw[w]["builds"] - 1) for w in fams if w in bw)
+    if total > (rebuilds + reshards) * len(fams):
+        return False
+    log = [e for e in d.get("recompile_log", [])
+           if e.get("what") in fams]
+    non_shape = [e for e in log if e.get("changed") != ["shape"]]
+    shape = [e for e in log if e.get("changed") == ["shape"]]
+    return (len(non_shape) <= rebuilds * len(fams)
+            and len(shape) <= reshards * len(fams))
 
 
 def _await_port_file(path, timeout_s=30.0):
@@ -228,6 +295,10 @@ def _plans(num_epoch):
         "hang": ([FaultRule("stall", site="worker.step",
                             host=STRAGGLE_HOST, after=HANG_AFTER,
                             times=1)], []),
+        # the r19 graceful-drain drill: clean transport — the fault is
+        # the SIGTERM the harness itself delivers mid-epoch, and the
+        # gate is that it does NOT look like a fault afterwards
+        "preempt": ([], []),
     }
     # scheduler-kill plans: clean worker transport (the fault under test
     # is the CONTROL PLANE dying, and bit-identity vs --plan none is an
@@ -377,13 +448,211 @@ def _hang_checks(args, sched, procs, bb_dir, checks):
     return 0 if ok else 1
 
 
+def _outage_run(args, tmp, bb_dir):
+    """The ``--plan outage`` drill: kill the ENTIRE job mid-epoch, then
+    cold-restart it from the committed fleet checkpoint.
+
+    Phase 1 runs the scheduler as a real process (scheduler_main) with
+    the seeded ``sched.allreduce`` crash rule while workers cut
+    coordinated checkpoints every OUTAGE_CKPT_EVERY steps; when the
+    scheduler dies 137 the harness SIGKILLs every worker — a preemption
+    takes the whole job, and SIGKILL (not TERM) keeps the graceful-drain
+    path out of this drill.  Phase 2 boots an in-process scheduler with
+    ``resume=True`` on the SAME journal plus fresh workers carrying
+    ``DT_RESUME=1``; they restore the committed TrainState + data
+    cursor and train to completion.  ``--resume-workers N`` resizes the
+    phase-2 fleet (elastic cold restart)."""
+    from dt_tpu.elastic import Scheduler
+    from dt_tpu.elastic import journal as ctrl_journal
+    from dt_tpu.elastic.faults import FaultPlan, FaultRule
+    from dt_tpu.obs import blackbox as obs_blackbox
+
+    checks = {}
+    journal = os.path.join(tmp, "ctrl.journal")
+    hw = os.path.join(tmp, "host_worker")
+    with open(hw, "w") as f:
+        f.write("\n".join(HOSTS) + "\n")
+    ckpt_env = {"DT_CKPT_DIR": os.path.join(tmp, "fleet_ckpt"),
+                "DT_CKPT_EVERY": str(OUTAGE_CKPT_EVERY)}
+
+    # ---- phase 1: the doomed incarnation -------------------------------
+    kill_plan = FaultPlan([FaultRule("crash", action="exit",
+                                     **OUTAGE_KILL_SITE)], seed=args.seed)
+    sched_env = dict(os.environ)
+    sched_env.pop("XLA_FLAGS", None)
+    sched_env["DT_FAULT_PLAN"] = kill_plan.to_json()
+    port_file = os.path.join(tmp, "primary.port")
+    sched_log = open(os.path.join(tmp, "scheduler.log"), "w")
+    primary = subprocess.Popen(
+        [sys.executable, "-m", "dt_tpu.elastic.scheduler_main",
+         "--host-worker-file", hw, "--journal", journal,
+         "--port-file", port_file, "--auto-evict-dead-s", "30"],
+        env=sched_env, stdout=sched_log, stderr=subprocess.STDOUT)
+    port = _await_port_file(port_file)
+    outs1 = {h: os.path.join(tmp, f"{h}.phase1.json") for h in HOSTS}
+    procs1 = {h: _spawn(port, h, outs1[h], args.num_epoch, "",
+                        extra_env=ckpt_env) for h in HOSTS}
+    sched = None
+    procs2 = {}
+    try:
+        deadline = time.time() + args.timeout_s
+        while primary.poll() is None and time.time() < deadline:
+            if any(p.poll() not in (None, 0) for p in procs1.values()):
+                break  # a worker died before the kill: fail fast below
+            time.sleep(0.2)
+        checks["outage_sched_killed"] = primary.poll() == 137
+        # the preemption takes the whole job: SIGKILL every worker (NOT
+        # SIGTERM — the graceful-drain path is --plan preempt's job)
+        for p in procs1.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs1.values():
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                pass
+        dead_struct = ctrl_journal.ControlState.rebuild(journal).struct()
+        committed1 = dead_struct["ckpt_committed"]
+        checks["ckpt_committed_before_kill"] = committed1 is not None
+        print(f"# phase 1 down: scheduler rc={primary.poll()}, committed "
+              f"checkpoint={committed1 and committed1['step']}",
+              file=sys.stderr)
+
+        # ---- phase 2: cold restart from the committed manifest ---------
+        resume_hosts = (HOSTS + EXTRA_HOSTS)[:args.resume_workers]
+        with open(hw, "w") as f:
+            f.write("\n".join(resume_hosts) + "\n")
+        sched = Scheduler(host_worker_file=hw, auto_evict_dead_s=30.0,
+                          journal_path=journal, resume=True)
+        outs = {h: os.path.join(tmp, f"{h}.json") for h in resume_hosts}
+        env2 = {**ckpt_env, "DT_RESUME": "1"}
+        procs2 = {h: _spawn(sched.port, h, outs[h], args.num_epoch, "",
+                            extra_env=env2) for h in resume_hosts}
+        pending = dict(procs2)
+        ok_rcs = True
+        while pending and time.time() < deadline:
+            for h, p in list(pending.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del pending[h]
+                if rc != 0:
+                    try:
+                        tail = open(outs[h] + ".log").read()[-2000:]
+                    except OSError:
+                        tail = "(no log)"
+                    print(f"# {h} FAILED rc={rc}:\n{tail}",
+                          file=sys.stderr)
+                    ok_rcs = False
+            time.sleep(0.2)
+        if pending:
+            print(f"# timed out waiting for {sorted(pending)}",
+                  file=sys.stderr)
+        checks["worker_rcs"] = ok_rcs and not pending
+
+        results = {}
+        for h in resume_hosts:
+            try:
+                results[h] = json.load(open(outs[h]))
+            except (OSError, ValueError):
+                checks[f"result_{h}"] = False
+        param_hash = None
+        resumed_step = None
+        if len(results) == len(resume_hosts):
+            losses = [r["final_loss"] for r in results.values()]
+            checks["loss_finite"] = all(math.isfinite(l) for l in losses)
+            # every resumed worker restored from the SAME committed step
+            # — the one phase 1's journal holds (a fresh-start worker
+            # would carry None here and fail by name)
+            steps = {r.get("resumed_from_step") for r in results.values()}
+            checks["resumed_from_committed"] = (
+                committed1 is not None
+                and steps == {committed1["step"]})
+            resumed_step = committed1["step"] if committed1 else None
+            checks["params_identical"] = \
+                len({r["param_hash"] for r in results.values()}) == 1
+            if checks["params_identical"]:
+                param_hash = results[resume_hosts[0]]["param_hash"]
+            if args.expect_param_hash:
+                # THE tentpole gate: the killed-and-resumed job lands on
+                # params bit-identical to a never-killed --plan none run
+                checks["params_match_baseline"] = \
+                    repr(param_hash) == args.expect_param_hash
+            checks["steps_identical"] = \
+                len({r["final_step"] for r in results.values()}) == 1
+            checks["membership_converged"] = (
+                sorted(sched._workers) == sorted(resume_hosts)
+                and all(r["num_workers_at_end"] == len(resume_hosts)
+                        for r in results.values()))
+            checks["device_compiles_observed"] = all(
+                (r.get("device") or {}).get("compiles", 0) > 0
+                for r in results.values())
+            checks["recompile_churn_bounded"] = all(
+                _churn_ok(r) for r in results.values())
+
+        # the survivability plane kept working after the resume: a LATER
+        # checkpoint committed past the restored one
+        with sched._lock:
+            live_struct = sched._state.struct()
+        com2 = live_struct["ckpt_committed"]
+        checks["ckpt_advanced_after_resume"] = (
+            com2 is not None and committed1 is not None
+            and com2["step"] > committed1["step"])
+        checks["journal_replay_matches"] = \
+            ctrl_journal.ControlState.rebuild(journal).struct() \
+            == live_struct
+        tstats = sched.transport_stats()
+        checks["pooled_connections"] = \
+            tstats["requests"] > 2 * tstats["connections"]
+
+        # the killed scheduler process serialized its black box first
+        bb_rows = [r for r in obs_blackbox.read_manifest(bb_dir)
+                   if r.get("kind") == "bundle"]
+        checks["sched_crash_bundle"] = any(
+            str(r.get("trigger", "")).startswith("crash.sched")
+            and r.get("pid") == primary.pid for r in bb_rows)
+
+        if args.trace:
+            from dt_tpu.obs import export as obs_export
+            summary = obs_export.write(args.trace, sched.obs_dump())
+            json.load(open(args.trace))  # the trace must reload as JSON
+            checks["trace_tracks"] = \
+                "control-plane" in summary["tracks"]
+        ok = bool(checks) and all(checks.values())
+        print(json.dumps({
+            "ok": ok, "plan": "outage", "seed": args.seed,
+            "num_epoch": args.num_epoch,
+            "resume_workers": args.resume_workers, "checks": checks,
+            "param_hash": param_hash,
+            "resumed_from_step": resumed_step,
+            "committed_step_final": com2 and com2["step"],
+            "transport": tstats,
+            "final_loss": {h: r.get("final_loss")
+                           for h, r in results.items()},
+            "trace": args.trace or None,
+            "blackbox_dir": bb_dir, "workdir": tmp}))
+        return 0 if ok else 1
+    finally:
+        if sched is not None:
+            sched.close()
+        for p in list(procs1.values()) + list(procs2.values()) \
+                + [primary]:
+            if p.poll() is None:
+                p.kill()
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--plan", default="default",
                     choices=["default", "noise", "crash-only", "none",
-                             "straggler", "nan", "hang"]
+                             "straggler", "nan", "hang", "preempt",
+                             "outage"]
                     + sorted(SCHED_KILL_SITES))
+    ap.add_argument("--resume-workers", type=int, default=len(HOSTS),
+                    help="outage plan: phase-2 fleet size (2/4 = the "
+                         "elastic cold-restart variants; the committed "
+                         "checkpoint restores into the resized fleet)")
     ap.add_argument("--num-epoch", type=int, default=8)
     ap.add_argument("--timeout-s", type=float, default=1200.0)
     ap.add_argument("--trace", default="",
@@ -446,6 +715,7 @@ def main():
     policy_plan = args.plan == "straggler"
     nan_plan = args.plan == "nan"
     hang_plan = args.plan == "hang"
+    preempt_plan = args.plan == "preempt"
     # r16 flight recorder: EVERY plan runs with the black box armed
     # (default-on in chaos, per docs/observability.md) — crash-bearing
     # plans then gate that each killed/halted process left a complete
@@ -488,6 +758,10 @@ def main():
     from dt_tpu.elastic import Scheduler, faults
     from dt_tpu.elastic.faults import FaultPlan, FaultRule
     from dt_tpu.obs import blackbox as obs_blackbox
+
+    if args.plan == "outage":
+        # its own two-phase flow (kill the whole job, cold-restart it)
+        return _outage_run(args, tmp, bb_dir)
 
     worker_rules, sched_rules = _plans(args.num_epoch)[args.plan]
     worker_plan = FaultPlan(worker_rules, seed=args.seed)
@@ -582,7 +856,19 @@ def main():
             return _hang_checks(args, sched, procs, bb_dir, checks)
         # reap, playing the restart wrapper for the injected crash
         pending = dict(procs)
+        preempted = False
         while pending and time.time() < deadline:
+            if preempt_plan and not preempted:
+                # r19: SIGTERM one worker mid-epoch once the job is
+                # demonstrably past its first epoch barrier — the drain
+                # handler must turn the signal into a clean departure
+                with sched._lock:
+                    lce = sched._state.last_completed_epoch
+                if lce >= 1 and procs[DRAIN_HOST].poll() is None:
+                    print(f"# SIGTERM {DRAIN_HOST} mid-epoch "
+                          f"{lce + 2} (graceful drain)", file=sys.stderr)
+                    procs[DRAIN_HOST].send_signal(signal.SIGTERM)
+                    preempted = True
             for h, p in list(pending.items()):
                 rc = p.poll()
                 if rc is None:
@@ -621,11 +907,13 @@ def main():
             except (OSError, ValueError):
                 checks[f"result_{h}"] = False
         param_hash = None
-        # the straggler plan EVICTS the probe host by design: the
-        # bit-identity / lockstep / membership checks cover the
-        # survivors (the evictee's params froze at its removal epoch)
+        # the straggler plan EVICTS the probe host by design, and the
+        # preempt plan DRAINS one: the bit-identity / lockstep /
+        # membership checks cover the survivors (the departed worker's
+        # params froze at its removal step)
         final_hosts = [h for h in HOSTS
-                       if not (policy_plan and h == STRAGGLE_HOST)]
+                       if not (policy_plan and h == STRAGGLE_HOST)
+                       and not (preempt_plan and h == DRAIN_HOST)]
         if len(results) == len(HOSTS):
             losses = [r["final_loss"] for r in results.values()]
             checks["loss_finite"] = all(math.isfinite(l) for l in losses)
@@ -662,29 +950,6 @@ def main():
             checks["device_compiles_observed"] = all(
                 (results[h].get("device") or {}).get("compiles", 0) > 0
                 for h in final_hosts)
-
-            def _churn_ok(r):
-                d = r.get("device") or {}
-                fams = ("train_step", "grad_step", "apply_step")
-                rebuilds = r.get("mesh_rebuilds", 0)
-                reshards = r.get("resharded", 0)
-                # the UNTRUNCATED bound first: per-what build counts
-                # cover every recompile (recompile_log is a bounded
-                # window, so a storm could scroll its early rebuild
-                # entries out of the visible log)
-                bw = d.get("by_what", {})
-                total = sum(max(0, bw[w]["builds"] - 1)
-                            for w in fams if w in bw)
-                if total > (rebuilds + reshards) * len(fams):
-                    return False
-                log = [e for e in d.get("recompile_log", [])
-                       if e.get("what") in fams]
-                non_shape = [e for e in log
-                             if e.get("changed") != ["shape"]]
-                shape = [e for e in log if e.get("changed") == ["shape"]]
-                return (len(non_shape) <= rebuilds * len(fams)
-                        and len(shape) <= reshards * len(fams))
-
             checks["recompile_churn_bounded"] = all(
                 _churn_ok(results[h]) for h in final_hosts)
         # the r7 pooled transport: every worker multiplexes its requests
@@ -775,6 +1040,29 @@ def main():
                 "rate_fault_free_est_steps_per_s":
                     round(rate_base, 3) if rate_base else None,
                 "straggler_scores": sched._dp.straggler_scores()}
+
+        if preempt_plan:
+            # the SIGTERM was a clean departure, not a fault: the worker
+            # exited 0 (worker_rcs above covers it) after FEWER steps
+            # than the survivors, left a kind="drain" manifest row, and
+            # wrote NO crash/hang bundle
+            checks["preempt_signaled"] = preempted
+            rows = obs_blackbox.read_manifest(bb_dir)
+            drains = [r for r in rows if r.get("kind") == "drain"
+                      and r.get("host") == DRAIN_HOST]
+            checks["drain_manifest_row"] = (
+                len(drains) == 1
+                and drains[0].get("trigger") == "SIGTERM"
+                and drains[0].get("fatal") is False)
+            checks["no_drain_bundle"] = not any(
+                r.get("kind") == "bundle"
+                and r.get("host") == DRAIN_HOST for r in rows)
+            drained = results.get(DRAIN_HOST, {})
+            surv = results.get(final_hosts[0], {}) if final_hosts else {}
+            checks["drained_left_early"] = (
+                drained.get("final_step") is not None
+                and surv.get("final_step") is not None
+                and drained["final_step"] < surv["final_step"])
 
         if nan_plan and len(results) == len(HOSTS):
             # the sentinel caught the poisoned gradient and the fleet

@@ -279,6 +279,35 @@ def render(summary) -> str:
             f"client requests linked to server spans "
             f"({causal['orphans']} orphaned, "
             f"{causal['server_unmatched']} server-only)")
+    # r19 checkpoint/drain timeline (docs/checkpoint.md): committed
+    # fleet checkpoints with commit latency + per-worker ack spread,
+    # aborted windows with the reason, graceful drains, and the
+    # cold-restart resume event — intent/ack/begin events are folded
+    # into their outcome rows
+    ckpt = summary.get("checkpoint", [])
+    if ckpt:
+        commits = sum(1 for e in ckpt if e.get("what") == "ckpt.commit")
+        lines.append("")
+        lines.append(f"checkpoint/drain timeline ({commits} commit(s)):")
+        for e in ckpt:
+            what = e.get("what")
+            if what == "ckpt.commit":
+                lines.append(
+                    f"  commit step {e.get('step')}: "
+                    f"dur={e.get('dur_ms', 0.0):.1f}ms  "
+                    f"ack_spread={e.get('spread_ms', 0.0):.1f}ms")
+            elif what == "ckpt.abort":
+                lines.append(f"  abort step {e.get('step')}: "
+                             f"{e.get('reason', '-')}")
+            elif what == "ckpt.resume":
+                lines.append(
+                    f"  RESUME from step {e.get('step')} "
+                    f"(epoch {e.get('epoch')}, "
+                    f"{len(e.get('workers') or [])} blob(s))")
+            elif what == "drain.requested":
+                lines.append(f"  drain requested: {e.get('host') or '-'}")
+            elif what == "drain.complete":
+                lines.append(f"  drained: {e.get('host') or '-'}")
     mem = summary.get("membership_changes", [])
     lines.append("")
     lines.append(f"membership changes: {len(mem)}")
