@@ -228,7 +228,8 @@ def preflight():
     dog = _arm_blackbox("bench-preflight")
     import jax
     import jax.numpy as jnp
-    v = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.bfloat16))
+    probe = jax.jit(lambda a: (a @ a).sum())
+    v = probe(jnp.ones((128, 128), jnp.bfloat16))
     jax.block_until_ready(v)
     print(f"# preflight ok: backend={jax.default_backend()} "
           f"devices={len(jax.devices())} v={float(v):.1f}", file=sys.stderr)
@@ -399,10 +400,10 @@ def measure_tier(net, batch, size):
     # individually over the axon tunnel (minutes of RTT for ResNet-152);
     # one compiled program pays the cost once
     phase(f"compiling init ({net}, batch {batch})")
-    variables = jax.jit(
+    init_fn = jax.jit(
         lambda k: model.init({"params": k, "dropout": k}, x,
-                             training=False))(
-        jax.random.PRNGKey(0))
+                             training=False))
+    variables = init_fn(jax.random.PRNGKey(0))
     jax.block_until_ready(variables)
     phase("init done")
     tx = optim.create("sgd", learning_rate=0.1, momentum=0.9,
@@ -428,16 +429,21 @@ def measure_tier(net, batch, size):
             state.params)
         return state.apply_gradients(grads).replace(batch_stats=stats), loss
 
-    step = jax.jit(train_step, donate_argnums=(0,))
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    step = jax.jit(train_step, donate_argnums=donate)
 
     # AOT compile: cost_analysis must read the program BEFORE the first
     # donating call deletes the input buffers, and AOT avoids lowering
     # twice
     phase("compiling train step")
     from dt_tpu.obs import device as obs_device
+    from dt_tpu.obs import trace as obs_trace
     cache = obs_device.cache_probe()
     t_compile = time.perf_counter()
+    _tr = obs_trace.tracer()
+    _tc0 = _tr.begin("compile.bench_step")
     compiled = step.lower(state, x, y).compile()
+    _tr.complete_span("compile.bench_step", _tc0, {"tier": net})
     step_flops = _compiled_flops(compiled)
     step = compiled
     state, loss = step(state, x, y)
@@ -577,9 +583,9 @@ def measure_tier_lm():
         0, vocab, (batch, seq)), jnp.int32)
 
     phase(f"compiling LM init (seq {seq}, attn {attn or 'full'})")
-    variables = jax.jit(
-        lambda k: model.init({"params": k}, toks, training=False))(
-        jax.random.PRNGKey(0))
+    init_fn = jax.jit(
+        lambda k: model.init({"params": k}, toks, training=False))
+    variables = init_fn(jax.random.PRNGKey(0))
     jax.block_until_ready(variables)
     tx = optim.create("sgd", learning_rate=0.1, momentum=0.9)
     state = TrainState.create(model.apply, variables["params"], tx, {})
@@ -593,12 +599,17 @@ def measure_tier_lm():
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         return state.apply_gradients(grads), loss
 
-    step = jax.jit(train_step, donate_argnums=(0,))
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    step = jax.jit(train_step, donate_argnums=donate)
     phase("compiling LM train step")
     from dt_tpu.obs import device as obs_device
+    from dt_tpu.obs import trace as obs_trace
     cache = obs_device.cache_probe()
     t_compile = time.perf_counter()
+    _tr = obs_trace.tracer()
+    _tc0 = _tr.begin("compile.bench_step")
     compiled = step.lower(state, toks).compile()
+    _tr.complete_span("compile.bench_step", _tc0, {"tier": "lm"})
     step_flops = _compiled_flops(compiled)
     state, loss = compiled(state, toks)
     jax.block_until_ready((state, loss))

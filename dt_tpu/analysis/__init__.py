@@ -18,6 +18,11 @@ are TPU-shaped, so they get a bespoke rule engine instead:
 - DT012 wire-contract    — send sites vs handler arms vs PROTOCOL_REGISTRY
 - DT013 retry-discipline — idempotency class vs _TOKEN_EXEMPT vs handlers
 - DT014 replay-determinism — clocks/RNG/set-order on deterministic surfaces
+- DT015 compile-boundary — jit/pjit outside a caching boundary; bare
+  lower().compile() outside a compile.* span; unhashable static args
+- DT016 transfer-discipline — implicit synchronous D2H on the hot path
+- DT017 donation-safety — use-after-donate / async-capture / unguarded
+  donation, flow-checked
 
 DT008-DT010 (``rules_flow`` over the ``flow`` substrate) are
 flow-sensitive: they track held-lock sets through ``with`` blocks and
@@ -40,7 +45,7 @@ from dt_tpu.analysis.engine import (Baseline, FileContext, Finding,
 def all_rules() -> List[Rule]:
     """One fresh instance of every registered rule, id order."""
     from dt_tpu.analysis import (rules_flow, rules_project, rules_proto,
-                                 rules_tpu)
+                                 rules_tpu, rules_xla)
     rules = [rules_tpu.PallasTiling(), rules_tpu.Bf16Downcast(),
              rules_tpu.CpuDonate(), rules_tpu.PartialBlock(),
              rules_project.EnvRegistry(), rules_project.LockDiscipline(),
@@ -48,7 +53,10 @@ def all_rules() -> List[Rule]:
              rules_project.ObsNameRegistry(), rules_flow.RaceInference(),
              rules_flow.LockOrder(), rules_flow.JournalDiscipline(),
              rules_proto.WireContract(), rules_proto.RetryDiscipline(),
-             rules_proto.ReplayDeterminism()]
+             rules_proto.ReplayDeterminism(),
+             rules_xla.CompileBoundary(),
+             rules_xla.TransferDiscipline(),
+             rules_xla.DonationSafety()]
     return sorted(rules, key=lambda r: r.id)
 
 

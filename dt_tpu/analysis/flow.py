@@ -504,3 +504,560 @@ def collect_edges(model: ClassModel
 def build_class_models(tree: ast.AST, lines: List[str]) -> List[ClassModel]:
     return [ClassModel(node, lines) for node in ast.walk(tree)
             if isinstance(node, ast.ClassDef)]
+
+
+# ---------------------------------------------------------------------------
+# dtxla substrate (r20, DT015-DT017): jax value typing + donation dataflow
+# ---------------------------------------------------------------------------
+
+#: callables that CONSTRUCT a compiled wrapper (a fresh trace cache each
+#: construction): ``jax.jit``, ``pjit.pjit``, bare ``jit`` imports
+_JIT_CTOR_NAMES = {"jit", "pjit"}
+
+#: ``jax.<x>`` members whose results live on the HOST (or are plain
+#: python handles) — ``np.asarray(jax.device_get(g))`` is the sanctioned
+#: explicit D2H, not an implicit sync on a device value
+_JAX_HOST_ATTRS = {"device_get", "default_backend", "devices",
+                   "local_devices", "device_count", "local_device_count",
+                   "process_index", "process_count", "eval_shape",
+                   "tree_structure", "tree_util", "tree_flatten",
+                   "tree_leaves", "jit", "pjit", "config", "debug",
+                   "profiler", "named_scope", "make_jaxpr", "clear_caches"}
+
+#: ``jnp.<x>`` predicates/metadata returning plain python values — no
+#: device computation, no sync
+_JNP_HOST_ATTRS = {"issubdtype", "isdtype", "result_type",
+                   "promote_types", "dtype", "shape", "ndim", "size",
+                   "iscomplexobj", "can_cast"}
+
+#: array METADATA attributes (python ints/objects on the wrapper — a
+#: ``flat_g.size`` read never touches the device)
+_ARRAY_META_ATTRS = {"size", "shape", "ndim", "dtype", "itemsize",
+                     "nbytes", "sharding", "device"}
+
+#: comparison ops that compute ON the array (an ``if a > b`` on device
+#: values forces a sync); ``is``/``in`` compare python identities
+_ARITH_CMPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE, ast.Eq, ast.NotEq)
+
+
+def dotted(node: ast.AST) -> List[str]:
+    """``jax.tree_util.tree_map`` -> ["jax", "tree_util", "tree_map"];
+    [] when the expression is not a pure dotted name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def is_jit_ctor(node: ast.AST) -> bool:
+    """A call that constructs a jit/pjit wrapper (``jax.jit(f, ...)``)."""
+    return isinstance(node, ast.Call) and \
+        _attr_name(node.func) in _JIT_CTOR_NAMES
+
+
+def unwrap_instrument(node: ast.AST) -> Optional[ast.Call]:
+    """``obs_device.instrument("what", jax.jit(f), meta)`` -> the inner
+    jit ctor call (the r18 observatory wrapper is itself a cache)."""
+    if isinstance(node, ast.Call) and \
+            _attr_name(node.func) == "instrument":
+        for a in node.args:
+            if is_jit_ctor(a):
+                return a
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class JitBinding:
+    """One jit-wrapper binding (``self._step = jax.jit(...)`` or a
+    local/module ``step = jax.jit(...)``) with its donation contract."""
+    donate: FrozenSet[int]   # resolved donated positional indices
+    symbolic: bool           # donate kw present but not resolvable
+    guarded: bool            # donation value data-depends on
+    line: int                # jax.default_backend()
+
+
+def _donate_value(value: ast.AST, scope: Optional[ast.AST],
+                  depth: int = 0) -> Tuple[Set[int], bool, bool]:
+    """Possible donated positions of a ``donate_argnums=`` value ->
+    (positions, symbolic, guarded).  Resolves literal ints/tuples, one
+    conditional (``(0,) if jax.default_backend() != "cpu" else ()``),
+    and Names through assignments in ``scope``."""
+    pos: Set[int] = set()
+    symbolic = False
+    guarded = "default_backend" in ast.dump(value)
+    for v in _value_exprs(value):
+        if isinstance(v, ast.Constant):
+            if isinstance(v.value, int) and not isinstance(v.value, bool):
+                pos.add(v.value)
+            continue
+        if isinstance(v, (ast.Tuple, ast.List)):
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, int):
+                    pos.add(e.value)
+                else:
+                    symbolic = True
+            continue
+        if isinstance(v, ast.Name) and scope is not None and depth < 2:
+            found = False
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == v.id:
+                            p2, s2, g2 = _donate_value(
+                                node.value, scope, depth + 1)
+                            pos |= p2
+                            symbolic |= s2
+                            guarded |= g2
+                            found = True
+            if not found:
+                symbolic = True
+            continue
+        symbolic = True
+    return pos, symbolic, guarded
+
+
+def resolve_donate(call: ast.Call,
+                   scope: Optional[ast.AST]) -> JitBinding:
+    """Donation contract of one jit ctor call.  ``scope`` (enclosing
+    function, or the module tree) resolves Name-valued donate kwargs."""
+    donate: Set[int] = set()
+    symbolic = False
+    guarded = False
+    for kw in call.keywords:
+        if kw.arg == "donate_argnames":
+            symbolic = True
+            guarded |= "default_backend" in ast.dump(kw.value)
+        elif kw.arg == "donate_argnums":
+            p, s, g = _donate_value(kw.value, scope)
+            donate |= p
+            symbolic |= s
+            guarded |= g
+    return JitBinding(frozenset(donate), symbolic, guarded, call.lineno)
+
+
+def _assigns_with_scope(tree: ast.AST):
+    """Yield ``(enclosing_function_or_None, Assign|AnnAssign)`` over the
+    whole tree (None = module scope), never descending into lambdas."""
+    def rec(node, fn):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from rec(child, child)
+                continue
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                yield fn, child
+            yield from rec(child, fn)
+    yield from rec(tree, None)
+
+
+def collect_jit_attrs(tree: ast.AST) -> Dict[str, JitBinding]:
+    """``self.<attr> = jax.jit(...)`` (possibly through
+    ``obs.device.instrument``) anywhere in the file -> attr name to its
+    :class:`JitBinding` — the Module/Trainer cached-step idiom."""
+    out: Dict[str, JitBinding] = {}
+    for fn, stmt in _assigns_with_scope(tree):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for v in _value_exprs(stmt.value):
+            call = unwrap_instrument(v) or v
+            if not is_jit_ctor(call):
+                continue
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out[attr] = resolve_donate(call, fn or tree)
+    return out
+
+
+def collect_module_jits(tree: ast.AST) -> Dict[str, JitBinding]:
+    """Module-level ``step = jax.jit(...)`` Name bindings."""
+    out: Dict[str, JitBinding] = {}
+    for fn, stmt in _assigns_with_scope(tree):
+        if fn is not None or not isinstance(stmt, ast.Assign):
+            continue
+        for v in _value_exprs(stmt.value):
+            call = unwrap_instrument(v) or v
+            if not is_jit_ctor(call):
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = resolve_donate(call, tree)
+    return out
+
+
+def collect_traced_names(tree: ast.AST) -> Set[str]:
+    """Function names handed to jax transforms (``jax.jit(step)``,
+    ``lax.cond(..., do, ...)``, ``@jax.jit`` decorations): their bodies
+    are TRACED code — device-side by construction, exempt from host
+    transfer-discipline analysis."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            parts = dotted(node.func)
+            if (parts and parts[0] in ("jax", "jnp", "lax")) or \
+                    is_jit_ctor(node):
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        out.add(a.id)
+                for kw in node.keywords:
+                    if isinstance(kw.value, ast.Name):
+                        out.add(kw.value.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                tail = _attr_name(d.func if isinstance(d, ast.Call)
+                                  else d)
+                if tail in _JIT_CTOR_NAMES or "jit" in ast.dump(d):
+                    out.add(node.name)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSync:
+    """One implicit synchronous D2H site (DT016)."""
+    line: int
+    kind: str    # "float(...)" | ".item()" | "np.asarray" | "truthiness"
+    expr: str    # short rendering of the offending expression
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationUse:
+    """One donated-buffer misuse site (DT017)."""
+    line: int
+    var: str     # the donated binding ("st", "self.state")
+    callee: str  # the donating callable's rendering
+    donated_line: int
+    kind: str    # "use-after-donate" | "async-capture"
+
+
+class JaxDataflow:
+    """Statement-ordered intraprocedural analysis of ONE function body:
+    infers which local names hold jax device values (calls rooted at
+    ``jnp``/``lax``/``jax.*`` minus the HOST set, calls of jit-bound
+    attrs/names, propagation through attribute/subscript/arith/method
+    chains and tuple unpacks), then records
+
+    - implicit synchronous D2H sites on typed values (``float``/``int``/
+      ``bool``, ``.item()``/``.tolist()``, ``np.asarray``/``np.array``,
+      truthiness tests, device-value comparisons in branch conditions) —
+      the DT016 surface;
+    - use-after-donate and pending-``copy_to_host_async``-then-donate
+      flows against the file's jit donation contracts — DT017.
+
+    Deliberately conservative: parameters are untyped (the sanctioned
+    sentinel fetches stay silent), list comprehensions don't propagate
+    (StagingPool slice staging stays silent), and a rebind from a
+    non-jax RHS clears the type.
+    """
+
+    def __init__(self, func_body, jit_attrs: Dict[str, JitBinding],
+                 module_jits: Optional[Dict[str, JitBinding]] = None):
+        self.jit_attrs = jit_attrs
+        self.typed: Set[str] = set()
+        self.local_jits: Dict[str, JitBinding] = dict(module_jits or {})
+        self.donated: Dict[str, Tuple[int, str]] = {}
+        self.pending_async: Dict[str, int] = {}
+        self.syncs: List[HostSync] = []
+        self.donation_uses: List[DonationUse] = []
+        for stmt in func_body:
+            self._stmt(stmt)
+
+    # -- naming ------------------------------------------------------------
+
+    @staticmethod
+    def _key(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        attr = _self_attr(node)
+        if attr is not None:
+            return "self." + attr
+        return None
+
+    # -- typing ------------------------------------------------------------
+
+    def _is_jax(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.typed
+        if isinstance(node, ast.Call):
+            f = node.func
+            parts = dotted(f)
+            if parts:
+                if parts[0] in ("jnp", "lax"):
+                    return parts[-1] not in _JNP_HOST_ATTRS
+                if parts[0] == "jax":
+                    return len(parts) < 2 or \
+                        parts[1] not in _JAX_HOST_ATTRS
+                if parts[0] == "self" and len(parts) == 2 and \
+                        parts[1] in self.jit_attrs:
+                    return True
+                if len(parts) == 1 and parts[0] in self.local_jits:
+                    return True
+            if isinstance(f, ast.Attribute):
+                # method call on a typed value: x.astype(...), x.sum()
+                return self._is_jax(f.value)
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _ARRAY_META_ATTRS:
+                return False
+            sa = _self_attr(node)
+            if sa is not None:
+                return ("self." + sa) in self.typed
+            return self._is_jax(node.value)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self._is_jax(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._is_jax(node.left) or self._is_jax(node.right)
+        if isinstance(node, ast.UnaryOp) and \
+                not isinstance(node.op, ast.Not):
+            return self._is_jax(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self._is_jax(node.body) or self._is_jax(node.orelse)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, _ARITH_CMPS) for op in node.ops):
+                return self._is_jax(node.left) or \
+                    any(self._is_jax(c) for c in node.comparators)
+            return False
+        return False
+
+    # -- sinks -------------------------------------------------------------
+
+    def _sync(self, node: ast.AST, kind: str) -> None:
+        self.syncs.append(HostSync(
+            node.lineno, kind,
+            ast.unparse(node)[:60] if hasattr(ast, "unparse") else kind))
+
+    def _truth(self, test: ast.AST) -> None:
+        if self._is_jax(test):
+            self._sync(test, "truthiness")
+
+    # -- expression walk ---------------------------------------------------
+
+    def _read(self, key: Optional[str], node: ast.AST) -> None:
+        if key is None:
+            return
+        hit = self.donated.pop(key, None)
+        if hit is not None:
+            self.donation_uses.append(DonationUse(
+                node.lineno, key, hit[1], hit[0], "use-after-donate"))
+
+    def _donate_positions(self, func: ast.AST) -> Tuple[FrozenSet[int],
+                                                        str]:
+        sa = _self_attr(func)
+        if sa is not None and sa in self.jit_attrs:
+            return self.jit_attrs[sa].donate, "self." + sa
+        if isinstance(func, ast.Name) and func.id in self.local_jits:
+            return self.local_jits[func.id].donate, func.id
+        return frozenset(), ""
+
+    def _call_effects(self, node: ast.Call) -> None:
+        f = node.func
+        fname = _attr_name(f)
+        # pending async D2H: v.copy_to_host_async()
+        if fname == "copy_to_host_async" and \
+                isinstance(f, ast.Attribute):
+            key = self._key(f.value)
+            if key is not None:
+                self.pending_async[key] = node.lineno
+            return
+        # implicit-sync sinks
+        if isinstance(f, ast.Name) and f.id in ("float", "int", "bool") \
+                and len(node.args) == 1 and self._is_jax(node.args[0]):
+            self._sync(node, f"{f.id}(...)")
+        elif fname in ("item", "tolist") and \
+                isinstance(f, ast.Attribute) and self._is_jax(f.value):
+            self._sync(node, f".{fname}()")
+        elif dotted(f)[:1] in (["np"], ["numpy"]) and \
+                fname in ("asarray", "array", "copyto") and node.args:
+            # np.copyto(dst, src) reads src; asarray/array read arg 0
+            src = node.args[1] if fname == "copyto" and \
+                len(node.args) > 1 else node.args[0]
+            if self._is_jax(src):
+                self._sync(node, f"np.{fname}(...)")
+        # donation
+        positions, callee = self._donate_positions(f)
+        for p in sorted(positions):
+            if p >= len(node.args):
+                continue
+            key = self._key(node.args[p])
+            if key is None:
+                continue
+            if key in self.pending_async:
+                self.donation_uses.append(DonationUse(
+                    node.lineno, key, callee,
+                    self.pending_async[key], "async-capture"))
+            self.donated[key] = (node.lineno, callee)
+
+    def _expr(self, node: Optional[ast.AST]) -> None:
+        if node is None or isinstance(node, (ast.Constant, ast.Lambda,
+                                             ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                self._expr(node.func.value)
+            for a in node.args:
+                self._expr(a)
+            for kw in node.keywords:
+                self._expr(kw.value)
+            self._call_effects(node)
+            return
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            key = self._key(node)
+            if key is not None:
+                if isinstance(getattr(node, "ctx", ast.Load()),
+                              ast.Load):
+                    self._read(key, node)
+                return
+            if isinstance(node, ast.Attribute):
+                self._expr(node.value)
+            return
+        if isinstance(node, ast.IfExp):
+            self._truth(node.test)
+            self._expr(node.test)
+            self._expr(node.body)
+            self._expr(node.orelse)
+            return
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self._truth(v)
+                self._expr(v)
+            return
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            self._truth(node.operand)
+            self._expr(node.operand)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+
+    # -- statement walk ----------------------------------------------------
+
+    def _clear(self, key: str) -> None:
+        self.typed.discard(key)
+        self.local_jits.pop(key, None)
+        self.donated.pop(key, None)
+        self.pending_async.pop(key, None)
+
+    def _bind_target(self, t: ast.AST, value: Optional[ast.AST],
+                     is_jax_val: bool, jit: Optional[JitBinding]) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._bind_target(e, None, is_jax_val, None)
+            return
+        if isinstance(t, ast.Starred):
+            self._bind_target(t.value, None, False, None)
+            return
+        key = self._key(t)
+        if key is None:
+            return
+        self._clear(key)
+        if jit is not None and isinstance(t, ast.Name):
+            self.local_jits[t.id] = jit
+        elif is_jax_val:
+            self.typed.add(key)
+
+    def _assign(self, targets, value: Optional[ast.AST]) -> None:
+        jit = None
+        if value is not None:
+            v = unwrap_instrument(value) or value
+            if is_jit_ctor(v):
+                jit = resolve_donate(v, None)
+        is_jax_val = value is not None and jit is None and \
+            self._is_jax(value)
+        for t in targets:
+            self._bind_target(t, value, is_jax_val, jit)
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            self._clear(node.name)
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            self._assign(node.targets, node.value)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._expr(node.value)
+                self._assign([node.target], node.value)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value)
+            key = self._key(node.target)
+            if key is not None:
+                self._read(key, node.target)   # augassign reads first
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            self._truth(node.test)
+            self._expr(node.test)
+            for b in node.body:
+                self._stmt(b)
+            for b in node.orelse:
+                self._stmt(b)
+            return
+        if isinstance(node, ast.For):
+            self._expr(node.iter)
+            if self._is_jax(node.iter):
+                self._sync(node.iter, "iteration")
+            self._assign([node.target], None)
+            for b in node.body:
+                self._stmt(b)
+            for b in node.orelse:
+                self._stmt(b)
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign([item.optional_vars], None)
+            for b in node.body:
+                self._stmt(b)
+            return
+        if isinstance(node, ast.Try):
+            for part in (node.body, *[h.body for h in node.handlers],
+                         node.orelse, node.finalbody):
+                for b in part:
+                    self._stmt(b)
+            return
+        if isinstance(node, ast.Assert):
+            self._truth(node.test)
+            self._expr(node.test)
+            return
+        if isinstance(node, ast.Return):
+            self._expr(node.value)
+            return
+        if isinstance(node, ast.Expr):
+            self._expr(node.value)
+            return
+        if isinstance(node, (ast.Delete,)):
+            for t in node.targets:
+                key = self._key(t)
+                if key is not None:
+                    self._clear(key)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+
+def analyzable_functions(tree: ast.AST):
+    """``(func_node, body)`` for every function whose body runs on the
+    HOST: every def except those traced by a jax transform (their bodies
+    are device code), plus the module body itself as ``(None, stmts)``."""
+    traced = collect_traced_names(tree)
+    yield None, [s for s in tree.body
+                 if not isinstance(s, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef))]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name not in traced:
+            yield node, node.body

@@ -99,6 +99,14 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence[np.ndarray],
                             names=(f"grad[{ai}]", "numeric"))
 
 
+@functools.lru_cache(maxsize=64)
+def _jitted(fn: Callable):
+    """One cached jit wrapper per callable: repeated consistency checks
+    over the same op reuse its trace cache (DT015 compile boundary)."""
+    import jax
+    return jax.jit(fn)
+
+
 def check_consistency(fn: Callable, inputs: Sequence[np.ndarray],
                       dtypes=("float32", "bfloat16"),
                       jit_check: bool = True):
@@ -115,7 +123,7 @@ def check_consistency(fn: Callable, inputs: Sequence[np.ndarray],
                 for x in inputs]
         results[dt] = np.asarray(fn(*args), np.float64)
         if jit_check:
-            jitted = np.asarray(jax.jit(fn)(*args), np.float64)
+            jitted = np.asarray(_jitted(fn)(*args), np.float64)
             r, a = default_rtol_atol(dt)
             assert_almost_equal(results[dt], jitted, r, a,
                                 names=(f"eager[{dt}]", f"jit[{dt}]"))

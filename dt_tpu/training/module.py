@@ -881,7 +881,10 @@ class Module:
                             # server-side master weights + optimizer slots
                             nonfinite = int(g_host.size
                                             - np.isfinite(g_host).sum())
-                            lv = float(np.asarray(loss))
+                            # sentinel gate: this async-push path has no
+                            # fused post-sync check to ride; the host read
+                            # IS the guard (reasoned DT016 exception)
+                            lv = float(np.asarray(loss))  # dtlint: ignore[DT016]
                             if obs_metrics.enabled():
                                 reg = obs_metrics.registry()
                                 reg.gauge("train.loss", lv)
@@ -935,8 +938,12 @@ class Module:
                             # path) when the policy engine is off
                             flat_g = flat_g * grad_scale
                         gc = self.kv._gradient_compression
+                        # deliberate pre-send sync (reasoned DT016
+                        # exception): quantization would launder the NaN
+                        # (see below), so this ONE host read keeps the
+                        # fleet-wide halt invariant
                         if gc is not None and self._sentinel and \
-                                not bool(jnp.isfinite(flat_g).all()):
+                                not bool(jnp.isfinite(flat_g).all()):  # dtlint: ignore[DT016]
                             # 2-bit quantization LAUNDERS non-finite values
                             # (NaN fails both threshold comparisons and
                             # encodes as code 0, lodging in the error-
@@ -1029,7 +1036,7 @@ class Module:
                         logger.info(
                             "Epoch[%d] graceful drain after step %d; "
                             "leaving the job", epoch,
-                            int(self.state.step))
+                            int(jax.device_get(self.state.step)))
                         return eval_metric
                     # flush the PREVIOUS step's metric + its callback (its
                     # logits are ready by now; this step already runs on device)
@@ -1058,7 +1065,7 @@ class Module:
                     bb_lib.write_bundle(
                         "health.halt", host=_bb_host, fatal=False,
                         extra={"epoch": epoch,
-                               "step": int(self.state.step)})
+                               "step": int(jax.device_get(self.state.step))})
                     logger.warning(
                         "Epoch[%d] training halted by the health sentinel "
                         "(non-finite gradient; update not applied)", epoch)

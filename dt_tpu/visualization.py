@@ -15,6 +15,7 @@ Raw compiler dumps remain available via ``dump_hlo``.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import jax
@@ -190,11 +191,22 @@ def plot_network(model_or_fn, *example_args, title: str = "plot",
     return dot
 
 
+@functools.lru_cache(maxsize=32)
+def _jitted(fn):
+    """Cached jit wrapper per dumped callable (DT015 compile boundary)."""
+    return jax.jit(fn)
+
+
 def dump_hlo(fn, *example_args, stage: str = "stablehlo") -> str:
     """Compiled-graph dump (the plot_network analog for XLA).
 
     ``stage``: 'stablehlo' (lowered) or 'optimized' (post-XLA-passes)."""
-    lowered = jax.jit(fn).lower(*example_args)
+    lowered = _jitted(fn).lower(*example_args)
     if stage == "optimized":
-        return lowered.compile().as_text()
+        from dt_tpu.obs import trace as obs_trace
+        tr = obs_trace.tracer()
+        t0 = tr.begin("compile.dump_hlo")
+        compiled = lowered.compile()
+        tr.complete_span("compile.dump_hlo", t0, {"stage": stage})
+        return compiled.as_text()
     return lowered.as_text()

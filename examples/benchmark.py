@@ -72,9 +72,9 @@ def main():
         x = jax.device_put(x, xsh)
         y = jax.device_put(y, NamedSharding(mesh, P("data")))
 
-        variables = jax.jit(
-            lambda kk: model.init({"params": kk}, x, training=False))(
-            jax.random.PRNGKey(0))
+        init_fn = jax.jit(
+            lambda kk: model.init({"params": kk}, x, training=False))
+        variables = init_fn(jax.random.PRNGKey(0))
         tx = optim.create("sgd", learning_rate=0.1, momentum=0.9)
         state = TrainState.create(model.apply, variables["params"], tx,
                                   variables.get("batch_stats"))

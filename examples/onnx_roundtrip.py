@@ -54,7 +54,8 @@ def main():
           f"{len(m['initializers'])} opset={m['opset']}")
 
     fn, params = donnx.import_onnx(args.out)
-    got = jax.jit(fn)(params, x)
+    jit_fn = jax.jit(fn)
+    got = jit_fn(params, x)
     want = model.apply(variables, x, training=False)
     err = float(jnp.abs(got - want).max())
     print(f"re-imported; max |onnx - native| = {err:.2e}")

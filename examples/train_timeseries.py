@@ -124,7 +124,8 @@ def main():
             tot += float(loss)
         print(f"epoch {epoch}: mse {tot / steps:.4f}", flush=True)
 
-    pred = np.asarray(jax.jit(forecast)(params, jnp.asarray(Xv)))
+    jit_forecast = jax.jit(forecast)
+    pred = np.asarray(jit_forecast(params, jnp.asarray(Xv)))
     rmse = float(np.sqrt(np.mean((pred - Yv) ** 2)))
     naive = float(np.sqrt(np.mean((Xv[:, -1, :] - Yv) ** 2)))
     print(f"held-out RMSE {rmse:.4f} vs persistence {naive:.4f} "

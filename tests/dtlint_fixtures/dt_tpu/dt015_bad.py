@@ -1,0 +1,35 @@
+"""DT015 fixture (bad): jit constructed per call / per iteration /
+uncached in library code, an unhashable static arg, and a bare AOT
+compile outside a compile.* span."""
+import jax
+
+
+def per_call(fn, x):
+    # the trace cache keys on the wrapper object: retrace every call
+    return jax.jit(fn)(x)
+
+
+def per_iteration(fn, xs):
+    tot = 0.0
+    for x in xs:
+        step = jax.jit(fn)  # fresh trace cache every iteration
+        tot = tot + step(x)
+    return tot
+
+
+def uncached(fn, x):
+    step = jax.jit(fn)  # in-body, no caching boundary
+    return step(x)
+
+
+def bad_static(fn, x):
+    f = jax.jit(fn, static_argnums=(1,))
+    return f(x, [8, 128])  # list is unhashable: TypeError at dispatch
+
+
+def aot(x):
+    lowered = _step.lower(x)
+    return lowered.compile()  # invisible to the hang watchdog
+
+
+_step = jax.jit(lambda x: x * 2)

@@ -1,0 +1,43 @@
+"""DT015 fixture (good): every sanctioned compile boundary — module
+level, cached self.<attr> (via instrument), lru_cache, a factory
+return, the _build idiom, and a spanned AOT compile."""
+import functools
+
+import jax
+
+from dt_tpu.obs import device as obs_device
+from dt_tpu.obs import trace as obs_trace
+
+_step = jax.jit(lambda x: x * 2)  # module level: one construction
+_static = jax.jit(lambda x, n: x[:n], static_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=8)
+def cached_wrapper(fn):
+    return jax.jit(fn)  # the lru_cache owns the boundary
+
+
+def make_step(fn):
+    return jax.jit(fn)  # factory return: the caller owns the cache
+
+
+class Runner:
+    def _build_step(self, fn):
+        # cached attr, routed through the compile observatory
+        self._fn = obs_device.instrument("runner_step", jax.jit(fn))
+
+    def run(self, x):
+        return self._fn(x)
+
+
+def hashable_static(x):
+    return _static(x, 128)  # hashable static arg
+
+
+def spanned_aot(x):
+    tr = obs_trace.tracer()
+    t0 = tr.begin("compile.fixture")
+    lowered = _step.lower(x)
+    compiled = lowered.compile()
+    tr.complete_span("compile.fixture", t0)
+    return compiled

@@ -1,0 +1,17 @@
+"""DT017 fixture (bad): use-after-donate, donate of a buffer with a
+pending async D2H, and an unconditional donate tuple (segfaults on XLA
+CPU with multi-device collectives)."""
+import jax
+
+_step = jax.jit(lambda s, x: (s, x.sum()), donate_argnums=(0,))
+
+
+def use_after_donate(state, x):
+    new_state, loss = _step(state, x)
+    return state, loss  # 'state' was donated: buffer deleted on TPU
+
+
+def async_capture(state, x):
+    state.copy_to_host_async()
+    new_state, loss = _step(state, x)  # pending D2H reads freed memory
+    return new_state, loss

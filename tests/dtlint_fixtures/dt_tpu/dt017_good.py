@@ -1,0 +1,19 @@
+"""DT017 fixture (good): the donated name is rebound in the SAME
+statement (no live alias survives the call) and the donate tuple itself
+is conditional on the backend."""
+import jax
+
+_DONATE = (0,) if jax.default_backend() != "cpu" else ()
+_step = jax.jit(lambda s, x: (s, x.sum()), donate_argnums=_DONATE)
+
+
+def train(state, x):
+    state, loss = _step(state, x)  # sanctioned same-statement rebind
+    return state, loss
+
+
+def build_and_step(fn, state, x):
+    step = jax.jit(fn, donate_argnums=(0,)
+                   if jax.default_backend() != "cpu" else ())
+    state, loss = step(state, x)
+    return state, loss

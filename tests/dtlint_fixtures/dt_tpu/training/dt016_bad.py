@@ -1,0 +1,18 @@
+"""DT016 fixture (bad): implicit synchronous D2H inside the step loop —
+every one of these blocks the dispatch queue mid-step."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_step = jax.jit(lambda s, x: (s, (x * x).sum()))
+
+
+def train_loop(state, batches):
+    total = 0.0
+    for x in batches:
+        state, loss = _step(state, jnp.asarray(x))
+        total += float(loss)        # float() on a device value
+        if loss > 0.5:              # truthiness forces a sync
+            total += loss.item()    # .item() is a blocking D2H
+        np.asarray(loss)            # implicit transfer
+    return total

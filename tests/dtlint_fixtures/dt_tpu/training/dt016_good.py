@@ -1,0 +1,17 @@
+"""DT016 fixture (good): values stay on device inside the loop; the one
+host read goes through the explicit jax.device_get boundary, and shape
+metadata reads cost nothing."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_step = jax.jit(lambda s, x: (s, (x * x).sum()))
+
+
+def train_loop(state, batches):
+    loss = jnp.zeros(())
+    for x in batches:
+        state, loss = _step(state, jnp.asarray(x))  # stays on device
+    host = np.asarray(jax.device_get(loss))  # explicit, sanctioned D2H
+    n = int(loss.size)  # array metadata: a host attribute, no sync
+    return state, float(host), n

@@ -72,6 +72,10 @@ _PAIRS = [
     ("DT011", "dt_tpu/dt011_bad.py", "dt_tpu/dt011_good.py"),
     ("DT013", "dt_tpu/dt013_bad.py", "dt_tpu/dt013_good.py"),
     ("DT014", "dt_tpu/dt014_bad.py", "dt_tpu/dt014_good.py"),
+    ("DT015", "dt_tpu/dt015_bad.py", "dt_tpu/dt015_good.py"),
+    ("DT016", "dt_tpu/training/dt016_bad.py",
+     "dt_tpu/training/dt016_good.py"),
+    ("DT017", "dt_tpu/dt017_bad.py", "dt_tpu/dt017_good.py"),
 ]
 
 
@@ -877,6 +881,162 @@ def test_repo_baseline_entries_are_reasoned_and_known():
 
 
 # ---------------------------------------------------------------------------
+# DT015-DT017 (dtxla, r20): arm coverage on the fixture pairs +
+# acceptance on copies of the REAL hot-path files (pristine clean; each
+# injected defect flips exactly its rule)
+# ---------------------------------------------------------------------------
+
+
+def test_dt015_flags_every_arm():
+    msgs = [f.message for f in _lint(["dt_tpu/dt015_bad.py"],
+                                     select="DT015")]
+    for marker in ("immediately used", "inside a loop",
+                   "in-body jit construction", "unhashable argument",
+                   "bare lower().compile()"):
+        assert any(marker in m for m in msgs), (marker, msgs)
+
+
+def test_dt016_flags_every_sink_kind():
+    msgs = [f.message for f in _lint(
+        ["dt_tpu/training/dt016_bad.py"], select="DT016")]
+    for marker in ("float(...)", "truthiness", ".item()",
+                   "np.asarray(...)"):
+        assert any(marker in m for m in msgs), (marker, msgs)
+
+
+def test_dt017_flags_every_arm():
+    msgs = [f.message for f in _lint(["dt_tpu/dt017_bad.py"],
+                                     select="DT017")]
+    assert any("use after donate" in m for m in msgs), msgs
+    assert any("copy_to_host_async pending" in m for m in msgs), msgs
+    assert any("default_backend() guard" in m for m in msgs), msgs
+
+
+_XLA = {"DT015", "DT016", "DT017"}
+
+
+def test_xla_pristine_module_copy_clean(tmp_path):
+    root, _ = _copy_into(tmp_path, "dt_tpu/training/module.py")
+    findings = run(str(root), paths=["dt_tpu"], select=_XLA)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_dt015_module_copy_detects_in_body_jit(tmp_path):
+    rel = "dt_tpu/training/module.py"
+    anchor = '_obs.complete_span("step", _obs_st_t0, {"epoch": epoch})'
+    _, src = _copy_into(tmp_path, rel)
+    assert anchor in src
+    broken = src.replace(
+        anchor,
+        "extra = jax.jit(lambda s: s)(self.state)\n"
+        "                    " + anchor)
+    root, _ = _copy_into(tmp_path, rel, broken)
+    findings = run(str(root), paths=["dt_tpu"], select=_XLA)
+    assert findings and all(f.rule == "DT015" for f in findings), \
+        [f.render() for f in findings]
+    assert any("immediately used" in f.message for f in findings)
+
+
+def test_dt016_module_copy_detects_step_loop_sync(tmp_path):
+    rel = "dt_tpu/training/module.py"
+    anchor = '_obs.complete_span("step", _obs_st_t0, {"epoch": epoch})'
+    _, src = _copy_into(tmp_path, rel)
+    broken = src.replace(
+        anchor,
+        anchor + "\n                    lv_probe = float(loss)")
+    assert broken != src
+    root, _ = _copy_into(tmp_path, rel, broken)
+    findings = run(str(root), paths=["dt_tpu"], select=_XLA)
+    assert findings and all(f.rule == "DT016" for f in findings), \
+        [f.render() for f in findings]
+    assert any("float(...)" in f.message for f in findings)
+
+
+def test_dt017_module_copy_detects_read_after_donate(tmp_path):
+    rel = "dt_tpu/training/module.py"
+    _, src = _copy_into(tmp_path, rel)
+    broken = src.replace(
+        "    def fit(",
+        "    def _poke_donated(self, data, labels, rng):\n"
+        "        st = self.state\n"
+        "        out = self._train_step(st, data, labels, rng)\n"
+        "        return st\n\n"
+        "    def fit(")
+    assert broken != src
+    root, _ = _copy_into(tmp_path, rel, broken)
+    findings = run(str(root), paths=["dt_tpu"], select=_XLA)
+    assert findings and all(f.rule == "DT017" for f in findings), \
+        [f.render() for f in findings]
+    assert any("use after donate" in f.message and "'st'" in f.message
+               for f in findings)
+
+
+def test_dt016_overlap_copy_detects_bucket_sync(tmp_path):
+    rel = "dt_tpu/training/overlap.py"
+    _, src = _copy_into(tmp_path, rel)
+    clean_root, _ = _copy_into(tmp_path, rel)
+    clean = run(str(clean_root), paths=["dt_tpu"], select=_XLA)
+    assert not clean, "\n".join(f.render() for f in clean)
+    broken = src.replace(
+        "        avg_dev = out_dev[0] if nb == 1 else "
+        "jnp.concatenate(out_dev)\n"
+        "        return avg_dev, stats_avg",
+        "        avg_dev = out_dev[0] if nb == 1 else "
+        "jnp.concatenate(out_dev)\n"
+        "        chk = float(avg_dev[0])\n"
+        "        return avg_dev, stats_avg")
+    assert broken != src
+    root, _ = _copy_into(tmp_path, rel, broken)
+    findings = run(str(root), paths=["dt_tpu"], select=_XLA)
+    assert findings and all(f.rule == "DT016" for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_xla_pristine_client_copy_clean(tmp_path):
+    root, _ = _copy_into(tmp_path, "dt_tpu/elastic/client.py")
+    findings = run(str(root), paths=["dt_tpu"], select=_XLA)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# --explain (r20 CLI satellite)
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "dtlint.py"),
+         *args], capture_output=True, text=True, env=env,
+        timeout=timeout)
+
+
+def test_explain_prints_catalog_entry_and_fixture_pair():
+    out = _run_cli("--explain", "DT016")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "## DT016" in out.stdout
+    assert "dt016_bad.py" in out.stdout
+    assert "dt016_good.py" in out.stdout
+    # the fixture SOURCE is inlined, not just the path
+    assert "implicit synchronous D2H" in out.stdout.lower() or \
+        "device" in out.stdout
+
+
+def test_explain_unknown_rule_exits_2():
+    out = _run_cli("--explain", "DT999")
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "DT999" in out.stderr
+
+
+def test_explain_unions_with_select():
+    out = _run_cli("--explain", "DT015", "--select", "DT017")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "## DT015" in out.stdout
+    assert "## DT017" in out.stdout
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline round-trip
 # ---------------------------------------------------------------------------
 
@@ -924,7 +1084,7 @@ def test_baseline_requires_reason(tmp_path):
 def test_rule_ids_unique_and_documented():
     rules = all_rules()
     ids = [r.id for r in rules]
-    assert len(set(ids)) == len(ids) == 14
+    assert len(set(ids)) == len(ids) == 17
     catalog = open(os.path.join(ROOT, "docs", "dtlint_rules.md")).read()
     for r in rules:
         assert r.id in catalog, f"{r.id} missing from docs/dtlint_rules.md"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Local mirror of .github/workflows/dtlint.yml (r20): the full-scope
+# dtlint gate with a SARIF log, then the linter's own tier-1 tests.
+# Run from anywhere; exits non-zero on the first failing stage.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+
+SARIF="${DTLINT_SARIF:-dtlint.sarif}"
+
+echo "== dtlint (full scope -> ${SARIF}) =="
+python tools/dtlint.py --no-cache --sarif "$SARIF"
+
+echo "== linter tier-1 tests =="
+python -m pytest tests/test_dtlint.py -q

@@ -17,6 +17,7 @@ Usage::
     python tools/dtlint.py --fix-annotations  # insert DT008's guarded-by
     python tools/dtlint.py --sarif out.sarif  # CI diff-annotation output
     python tools/dtlint.py --list-rules
+    python tools/dtlint.py --explain DT016  # catalog entry + fixture pair
 
 Exit codes: 0 clean (after baseline), 1 findings (or stale baseline
 entries), 2 usage/internal error.  Per-line suppression:
@@ -268,6 +269,52 @@ def _write_sarif(path, analysis, reported):
         json.dump(doc, fh, indent=2, sort_keys=True)
 
 
+def _explain(root, analysis, ids):
+    """Print each rule's ``docs/dtlint_rules.md`` catalog entry followed
+    by its checked-in bad/good fixture pair — the offline "why is this
+    flagged, what does the fix look like" card.  Unknown ids exit 2;
+    missing docs/fixtures degrade to a note (a pruned tree — e.g. a
+    tests/-less deployment — still explains from the rule docstring)."""
+    import glob
+    rules = {r.id: r for r in analysis.all_rules()}
+    unknown = [i for i in ids if i not in rules]
+    if unknown:
+        print(f"dtlint: unknown rule id(s): {', '.join(sorted(unknown))} "
+              f"(see --list-rules)", file=sys.stderr)
+        return 2
+    sections = {}
+    doc_path = os.path.join(root, "docs", "dtlint_rules.md")
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            text = f.read()
+        for m in re.finditer(r"(?ms)^## (DT\d+)[^\n]*\n.*?(?=^## |\Z)",
+                             text):
+            sections[m.group(1)] = m.group(0).rstrip()
+    except OSError:
+        pass
+    for rid in sorted(ids):
+        r = rules[rid]
+        print(f"{r.id} {r.name}: "
+              f"{(r.__doc__ or '').strip().splitlines()[0]}\n")
+        print(sections.get(rid,
+                           f"(no catalog entry for {rid} in {doc_path})"))
+        for kind in ("bad", "good"):
+            pat = os.path.join(root, "tests", "dtlint_fixtures", "**",
+                               f"{rid.lower()}_{kind}.py")
+            hits = sorted(glob.glob(pat, recursive=True))
+            if not hits:
+                print(f"\n--- {kind} example: (no fixture "
+                      f"{rid.lower()}_{kind}.py in this tree) ---")
+                continue
+            for p in hits:
+                print(f"\n--- {kind} example: {os.path.relpath(p, root)} "
+                      f"---")
+                with open(p, encoding="utf-8") as f:
+                    print(f.read().rstrip())
+        print()
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="dtlint", description=__doc__,
@@ -293,6 +340,11 @@ def main(argv=None):
                     help="insert the '# guarded-by:' comments DT008 "
                          "suggests (idempotent), then exit")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", action="append", default=None,
+                    metavar="RULE",
+                    help="print the rule's docs-catalog entry + its "
+                         "bad/good fixture pair, then exit (repeatable; "
+                         "unions with --select; exit 2 on unknown ids)")
     ap.add_argument("--sarif", default=None, metavar="PATH",
                     help="also write the post-baseline findings as a "
                          "SARIF 2.1.0 log (CI diff annotation); exit "
@@ -310,6 +362,9 @@ def main(argv=None):
         return 0
 
     root = os.path.abspath(args.root)
+    if args.explain:
+        ids = list(dict.fromkeys(args.explain + (args.select or [])))
+        return _explain(root, analysis, ids)
     paths = args.paths or None
     if args.changed and args.paths:
         print("dtlint: --changed and explicit paths are mutually "

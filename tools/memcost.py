@@ -53,7 +53,13 @@ def measure(net, batch, size, remat, grad_accum):
     rng = jax.random.PRNGKey(0)
     lowered = mod._train_step.lower(mod.state, jnp.asarray(x),
                                     jnp.asarray(y), rng)
-    m = lowered.compile().memory_analysis()
+    from dt_tpu.obs import trace as obs_trace
+    tr = obs_trace.tracer()
+    t0 = tr.begin("compile.memcost")
+    compiled = lowered.compile()
+    tr.complete_span("compile.memcost", t0,
+                     {"config": f"remat={int(remat)} accum={grad_accum}"})
+    m = compiled.memory_analysis()
     # the canonical MiB row shared with the live compile observatory
     # (dt_tpu/obs/device.py — the dtop device board's "est" column)
     from dt_tpu.obs import device as obs_device

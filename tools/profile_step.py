@@ -36,9 +36,10 @@ def build_step(net, batch, size):
     x = jnp.asarray(np.random.RandomState(0)
                     .uniform(-1, 1, (batch, size, size, 3)), jnp.bfloat16)
     y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)))
-    variables = jax.jit(
+    init_fn = jax.jit(
         lambda k: model.init({"params": k, "dropout": k}, x,
-                             training=False))(jax.random.PRNGKey(0))
+                             training=False))
+    variables = init_fn(jax.random.PRNGKey(0))
     tx = optim.create("sgd", learning_rate=0.1, momentum=0.9,
                       weight_decay=1e-4)
     state = TrainState.create(model.apply, variables["params"], tx,
