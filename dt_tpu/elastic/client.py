@@ -55,6 +55,11 @@ def _parse_endpoints(spec: str) -> List[Tuple[str, int]]:
     return out
 
 
+#: public name — the serving plane (dt_tpu/serve) parses the same
+#: ``DT_CTRL_ENDPOINTS`` spec for its own failover rotation
+parse_endpoints = _parse_endpoints
+
+
 def _row_bounds(n: int, r: int) -> List[int]:
     """Split points of ``np.array_split(arr, r, axis=0)`` for n rows: the
     contiguous key-range → server partition (``kvstore_dist.h:547-589``

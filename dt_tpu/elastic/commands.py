@@ -123,7 +123,7 @@ PROTOCOL_REGISTRY: Mapping[str, Tuple[str, str, str, str]] = {
         "drops base protection and the eviction machinery removes the "
         "host; draining an already-draining/absent host is a no-op"),
     "shutdown": (
-        "scheduler|range_server", "idempotent", "passive|external",
+        "scheduler|range_server|replica", "idempotent", "passive|external",
         "remote shutdown of the serving process (idempotent close); "
         "sent by operator tooling and the test harness, not by workers"),
     # -- observability / health (scheduler) --------------------------------
@@ -174,6 +174,44 @@ PROTOCOL_REGISTRY: Mapping[str, Tuple[str, str, str, str]] = {
     "async_stats": (
         "scheduler|range_server", "read_only", "exempt",
         "dist_async staleness metrics (VERDICT r4 weak 7)"),
+    # -- serving plane (r21 — dt_tpu/serve: inference gateway replicas +
+    # scheduler-side serve control; docs/serving.md) ------------------------
+    "infer": (
+        "replica", "once", "",
+        "one inference request (rows ride the pooled zero-copy wire into "
+        "the gateway's dynamic batcher); mutates queue/latency state with "
+        "no self-dedup, so the response is token-cached — a retry that "
+        "crosses a scheduler failover is served the SAME answer"),
+    "infer_result": (
+        "replica", "read_only", "exempt",
+        "poll a queued async infer (wait=false) by rid: done/not-yet view "
+        "over the gateway's bounded result window"),
+    "serve_stats": (
+        "replica", "read_only", "exempt",
+        "gateway introspection: queue depth, shed/served counters, "
+        "latency percentiles, weights step (serve_bench + dtop + chaos "
+        "read gates from here)"),
+    "weight_refresh": (
+        "replica", "idempotent", "exempt",
+        "rolling-refresh drain-then-swap: adopt the committed fleet-"
+        "checkpoint manifest step (r19 ckpt_manifest); keyed by step — "
+        "re-applying the step already being served is a no-op"),
+    "serve_register": (
+        "scheduler", "idempotent", "exempt",
+        "serving-replica registration: host + gateway addr into the "
+        "scheduler's in-memory serve table (re-registering overwrites "
+        "with identical state; replicas re-register after a failover "
+        "exactly like worker reattach)"),
+    "serve_heartbeat": (
+        "scheduler", "idempotent", "exempt",
+        "replica liveness + live serve gauges (queue_depth/p99/qps/shed) "
+        "feeding the r14 policy engine's serving mode; superseded by the "
+        "next beat, response carries the drain flag on scale-down"),
+    "serve_endpoints": (
+        "scheduler", "read_only", "exempt",
+        "the live serving view: replica addrs + gauges + the serving "
+        "policy decision log (loadgen discovery, rolling refresher, "
+        "serve_bench gates)"),
     # -- range-server local ------------------------------------------------
     "host_reset": (
         "range_server", "idempotent", "",
@@ -189,7 +227,7 @@ PROTOCOL_REGISTRY: Mapping[str, Tuple[str, str, str, str]] = {
         "load-balance evidence)"),
 }
 
-_ROLES = frozenset({"scheduler", "range_server"})
+_ROLES = frozenset({"scheduler", "range_server", "replica"})
 _CLASSES = frozenset({"read_only", "idempotent", "once"})
 _FLAGS = frozenset({"exempt", "passive", "external"})
 

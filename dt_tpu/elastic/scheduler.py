@@ -280,6 +280,26 @@ class Scheduler:
         self._capture_cmds: List[dict] = []  # guarded-by: _lock
         self._capture_seq = 0  # guarded-by: _lock
         self._capture_posted: Dict[tuple, int] = {}  # guarded-by: _lock
+        # r21 serving plane (dt_tpu/serve): the live replica table —
+        # host -> {addr, ts, gauges, weights_step, refreshes, draining}.
+        # EPHEMERAL like _dev_tracks, deliberately NOT ControlState:
+        # replicas re-register within one heartbeat interval after a
+        # failover (serve_heartbeat answers registered=false), so
+        # journaling the table would only add replay surface.  The
+        # ServePolicy autoscaler evaluates on each heartbeat; only
+        # non-hold decisions enter _serve_decisions (log determinism:
+        # a function of the load pattern, not of heartbeat timing).
+        self._serve_lock = threading.Lock()
+        self._serve_replicas: Dict[str, dict] = {}  # guarded-by: _serve_lock
+        self._serve_order: List[str] = []  # guarded-by: _serve_lock
+        self._serve_policy = policy_lib.ServePolicy.from_env() \
+            if policy_lib.serving_enabled() else None
+        self._serve_hi = 0  # guarded-by: _serve_lock
+        self._serve_lo = 0  # guarded-by: _serve_lock
+        self._serve_want: Optional[int] = None  # guarded-by: _serve_lock
+        self._serve_decisions: List[dict] = []  # guarded-by: _serve_lock
+        self._serve_ttl = 3.0  # stale-heartbeat prune horizon (s)
+        self._serve_last_eval = 0.0  # guarded-by: _serve_lock
         # idempotency-token response cache (protocol.request reliable
         # mode); TTL + LRU bound its memory on a long-running scheduler
         self._tokens = protocol.TokenCache(
@@ -912,6 +932,12 @@ class Scheduler:
             # export threads it through otherData to .metrics.json and
             # dtop's device board
             out["device"] = dev
+        srv = self._serve_view()
+        if srv["replicas"] or srv["decisions"]:
+            # the r21 serving section rides the dump the same way —
+            # dtop's serving board (QPS/p99/queue/shed per replica +
+            # the autoscale decision log) needs no second command
+            out["serving"] = srv
         if self._metrics is not None:
             # the r15 time-series + health sections ride the dump so
             # export.write lands them in .metrics.json and dtop's health
@@ -1013,6 +1039,137 @@ class Scheduler:
         return {"workers": workers,
                 "compiling": sorted(h for h, v in workers.items()
                                     if v.get("compiling"))}
+
+    # ------------------------------------------------------------------
+    # serving plane (dt_tpu/serve, r21)
+    # ------------------------------------------------------------------
+
+    def _serve_register(self, host: str, addr, weights_step: int) -> dict:
+        """Admit (or re-admit after a failover) a serving replica.  A
+        re-registration preserves the draining flag: a replica the
+        autoscaler already chose to drain must not launder itself back
+        into rotation by reconnecting."""
+        with self._serve_lock:
+            prev = self._serve_replicas.get(host)
+            self._serve_replicas[host] = {
+                "addr": (str(addr[0]), int(addr[1])),
+                "ts": time.monotonic(),
+                "gauges": dict(prev["gauges"]) if prev else {},
+                "weights_step": int(weights_step),
+                "refreshes": int(prev["refreshes"]) if prev else 0,
+                "draining": bool(prev["draining"]) if prev else False,
+            }
+            if host not in self._serve_order:
+                self._serve_order.append(host)
+            live = sum(1 for e in self._serve_replicas.values()
+                       if not e["draining"])
+            # want tracks the largest fleet ever launched at it: the
+            # initial registrations and a scale-up launch both settle
+            # live == want; a drained replica re-registering keeps its
+            # flag and cannot inflate the target
+            self._serve_want = live if self._serve_want is None \
+                else max(self._serve_want, live)
+            n = len(self._serve_replicas)
+        self._obs.event("serve.scale", {"kind": "register", "host": host,
+                                        "replicas": n})
+        obs_metrics.registry().gauge("serve.replicas", float(n))
+        return {"registered": True}
+
+    def _serve_heartbeat(self, host: str, gauges: dict,
+                         weights_step: int, refreshes: int) -> dict:
+        """Fold one replica's liveness + gauges in, prune stale
+        replicas, and run one autoscale evaluation.  An unknown host
+        (a standby promoted with an empty table) answers
+        ``registered: false`` so the ServeClient re-registers — the
+        serving view reconverges without journaling it."""
+        now = time.monotonic()
+        with self._serve_lock:
+            ent = self._serve_replicas.get(host)
+            if ent is None:
+                return {"registered": False, "drain": False}
+            ent["ts"] = now
+            ent["gauges"] = dict(gauges)
+            ent["weights_step"] = int(weights_step)
+            ent["refreshes"] = int(refreshes)
+            drain = bool(ent["draining"])
+            dead = [h for h, e in self._serve_replicas.items()
+                    if now - e["ts"] > self._serve_ttl]
+            for h in dead:
+                del self._serve_replicas[h]
+            n = len(self._serve_replicas)
+            decision = self._serve_decide_locked()
+        for h in dead:
+            logger.warning("serving replica %s lost (stale heartbeat)",
+                           h)
+            self._obs.event("serve.scale", {"kind": "lost", "host": h,
+                                            "replicas": n})
+        if dead:
+            obs_metrics.registry().gauge("serve.replicas", float(n))
+        if decision is not None:
+            self._obs.event("serve.scale",
+                            {"kind": decision["kind"],
+                             "host": decision.get("host"),
+                             "replicas": decision["n_after"]})
+        return {"registered": True, "drain": drain}
+
+    def _serve_decide_locked(self):
+        """One ServePolicy evaluation (serve heartbeat cadence).  Only
+        evaluates when the live fleet matches the current want — while
+        a scale-up launch or a drain is still in flight, another
+        decision would double-fire on the same pressure.  Rate-limited
+        to one evaluation per 0.25 s — every replica's heartbeat lands
+        here, so un-throttled streaks would scale with fleet size and
+        heartbeat cadence instead of with seconds of sustained
+        pressure.  Returns the appended decision-log row for event
+        emission, or None."""
+        if self._serve_policy is None:
+            return None
+        now = time.monotonic()
+        if now - self._serve_last_eval < 0.25:
+            return None
+        self._serve_last_eval = now
+        live = sorted(h for h, e in self._serve_replicas.items()
+                      if not e["draining"])
+        if self._serve_want is None or len(live) != self._serve_want \
+                or not live:
+            return None
+        base = set(self._serve_order[:self._serve_policy.min_replicas])
+        depths = {h: float(self._serve_replicas[h]["gauges"]
+                           .get("serve.queue_depth", 0.0))
+                  for h in live}
+        d = self._serve_policy.decide(live, base, depths,
+                                      self._serve_hi, self._serve_lo)
+        self._serve_hi, self._serve_lo = d.hi_streak, d.lo_streak
+        if d.action == "hold":
+            return None
+        row = {"seq": len(self._serve_decisions), "kind": d.action,
+               "n_before": len(live)}
+        if d.action == "scale_up":
+            self._serve_want = len(live) + d.want
+            row["n_after"] = self._serve_want
+        else:
+            self._serve_want = len(live) - 1
+            self._serve_replicas[d.host]["draining"] = True
+            row["n_after"] = self._serve_want
+            row["host"] = d.host
+        self._serve_decisions.append(row)
+        logger.info("serve policy: %s -> want %d (%s)", d.action,
+                    self._serve_want, row.get("host", ""))
+        return row
+
+    def _serve_view(self) -> dict:
+        """The obs_dump/status serving section."""
+        with self._serve_lock:
+            reps = {h: {"addr": list(e["addr"]),
+                        "gauges": dict(e["gauges"]),
+                        "weights_step": int(e["weights_step"]),
+                        "refreshes": int(e["refreshes"]),
+                        "draining": bool(e["draining"])}
+                    for h, e in self._serve_replicas.items()}
+            return {"enabled": self._serve_policy is not None,
+                    "replicas": reps, "want": self._serve_want,
+                    "decisions": [dict(d)
+                                  for d in self._serve_decisions]}
 
     def _metrics_forget(self, hosts) -> None:
         """Membership removals scrub the per-worker metrics state (the
@@ -1294,6 +1451,11 @@ class Scheduler:
                                if st.ckpt_pending else None,
                            "draining": sorted(st.draining)}}
             out["straggler"] = self._dp.straggler_scores()
+            srv = self._serve_view()
+            if srv["replicas"] or srv["decisions"]:
+                out["serving"] = {"replicas": sorted(srv["replicas"]),
+                                  "want": srv["want"],
+                                  "decisions": len(srv["decisions"])}
             return out
         if cmd == "profile":
             # rank-0-drives-all profiling (kvstore_dist_server.h:275-322):
@@ -1360,6 +1522,29 @@ class Scheduler:
                 faults.crash_point("sched.allreduce",
                                    host=msg.get("host"))
             return self._dp.dispatch(msg)
+        if cmd == "serve_register":
+            return self._serve_register(msg["host"], msg["addr"],
+                                        int(msg.get("weights_step", 0)))
+        if cmd == "serve_heartbeat":
+            return self._serve_heartbeat(msg["host"],
+                                         msg.get("gauges") or {},
+                                         int(msg.get("weights_step", 0)),
+                                         int(msg.get("refreshes", 0)))
+        if cmd == "serve_endpoints":
+            # read-only serving view (replica addrs + freshest gauges +
+            # the autoscale want/decision log) — the InferClient's
+            # discovery, the refresher's walk order, and the bench's
+            # scale-to-want signal all read from here
+            with self._serve_lock:
+                reps = {h: {"addr": list(e["addr"]),
+                            "gauges": dict(e["gauges"]),
+                            "weights_step": int(e["weights_step"]),
+                            "refreshes": int(e["refreshes"]),
+                            "draining": bool(e["draining"])}
+                        for h, e in self._serve_replicas.items()}
+                return {"replicas": reps, "want": self._serve_want,
+                        "decisions": [dict(d)
+                                      for d in self._serve_decisions]}
         if cmd == "register_server":
             with self._servers_lock:
                 self._servers[int(msg["index"])] = (msg["host"],

@@ -91,6 +91,11 @@ def chrome_trace(job: Dict[str, Any]) -> Dict[str, Any]:
         # the export like policy/health: dtop's device board and the
         # chaos compile/memory cross-checks read it from the summary
         other["device"] = dict(job["device"] or {})
+    if "serving" in job:
+        # r21 serving plane (replica table + autoscale decision log)
+        # rides the export the same way — dtop's serving board and the
+        # serve chaos checks read it from the summary
+        other["serving"] = dict(job["serving"] or {})
     # pass 1: index every id-carrying span by (track, sid) so pass 2 can
     # bind flow starts to the exact client slice
     span_at: Dict[tuple, dict] = {}
@@ -306,6 +311,7 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
     leadership: List[dict] = []
     recompiles: Dict[str, List[dict]] = {}  # r18 compile.recompile fold
     ckpt_events: List[dict] = []  # r19 ckpt.*/drain.* timeline fold
+    serve_events: List[dict] = []  # r21 serve.refresh/scale timeline
     total_faults = 0
     for ev in chrome.get("traceEvents", ()):
         if ev.get("ph") in ("M", "s", "f", "t"):
@@ -372,6 +378,14 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
                      **{k: v for k, v in (ev.get("args") or {}).items()
                         if k in ("step", "epoch", "host", "workers",
                                  "reason", "dur_ms", "spread_ms")}})
+            if name in ("serve.refresh", "serve.scale"):
+                # r21 serving timeline (docs/serving.md): rolling
+                # refresh waves + fleet scale events, folded into
+                # dtop's serving board
+                serve_events.append(
+                    {"track": track, "ts": ev.get("ts"), "what": name,
+                     **{k: v for k, v in (ev.get("args") or {}).items()
+                        if k in ("step", "kind", "host", "replicas")}})
 
     meta = (chrome.get("otherData") or {}).get("tracks") or {}
     out_tracks: Dict[str, Any] = {}
@@ -413,6 +427,8 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
            "leadership": sorted(leadership,
                                 key=lambda m: m.get("ts") or 0),
            "total_fault_events": total_faults,
+           "serve_events": sorted(serve_events,
+                                  key=lambda m: m.get("ts") or 0),
            "checkpoint": sorted(ckpt_events,
                                 key=lambda m: m.get("ts") or 0),
            "straggler": dict((chrome.get("otherData") or {})
@@ -429,6 +445,10 @@ def summarize_chrome(chrome: Dict[str, Any]) -> Dict[str, Any]:
             t: sorted(v, key=lambda e: e.get("ts") or 0)
             for t, v in sorted(recompiles.items())}
     out["device"] = device
+    # r21 serving section: replica gauges + autoscale decisions
+    # (otherData passthrough, like policy — dtop's serving board)
+    out["serving"] = dict((chrome.get("otherData") or {})
+                          .get("serving") or {})
     # r15 health plane: thread the scheduler's SLO/gauge state + the
     # per-track time-series through, then run the post-hoc SLO pass over
     # export-derived inputs (the causal join only exists here — the

@@ -209,6 +209,39 @@ NAME_REGISTRY: Mapping[str, Tuple[str, str]] = {
     "drain.complete": ("event", "a draining worker departed cleanly (no "
                                 "crash bundle — the manifest carries a "
                                 "drain row instead)"),
+    # -- serving plane (dt_tpu/serve, r21 — docs/serving.md) ---------------
+    "serve.batch": ("span", "one coalesced dynamic batch through the "
+                            "Predictor (attrs: bucket, rows, reqs, "
+                            "weights_step)"),
+    "serve.requests": ("counter", "infer requests admitted by the gateway"),
+    "serve.rows": ("counter", "rows admitted by the gateway"),
+    "serve.batches": ("counter", "dynamic batches executed"),
+    "serve.shed": ("counter", "requests shed by admission control "
+                              "(queue-row cap DT_SERVE_QUEUE_ROWS)"),
+    "serve.queue_depth": ("gauge", "requests queued in the gateway "
+                                   "batcher right now (the ServePolicy "
+                                   "autoscale signal)"),
+    "serve.p99_ms": ("gauge", "rolling p99 gateway latency "
+                              "(enqueue -> reply) over the last window"),
+    "serve.qps": ("gauge", "rolling requests/s over the last window"),
+    "serve.latency_ms": ("histogram", "per-request gateway latency "
+                                      "(enqueue -> reply)"),
+    "serve.refresh": ("event", "rolling weight refresh: this replica "
+                               "swapped to a new committed manifest "
+                               "(attrs: step)"),
+    "serve.scale": ("event", "a serving-policy decision was applied "
+                             "(attrs: kind, host, replicas)"),
+    "serve.replicas": ("gauge", "registered live serving replicas "
+                                "(scheduler view)"),
+    # -- predictor (dt_tpu/predictor.py — the obs face of the old ad-hoc
+    # Predictor.stats dict; the dict stays as a per-instance view) ---------
+    "predict.requests": ("counter", "Predictor.predict calls served"),
+    "predict.rows": ("counter", "rows served through Predictor.predict"),
+    "predict.compiles": ("counter", "bucket programs compiled outside "
+                                    "warmup (a live request paid a "
+                                    "compile)"),
+    "predict.ms": ("histogram", "one Predictor.predict wall-clock "
+                                "(pad + dispatch + device_get)"),
     # -- fault injection (elastic/faults.py) -------------------------------
     "fault.*": ("event", "every APPLIED fault (fault.<kind>); the chaos "
                          "harness cross-checks these against "

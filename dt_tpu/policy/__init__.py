@@ -17,4 +17,7 @@ jax-free by design: the scheduler and jax-free operator tools
 from dt_tpu.policy import rescale as rescale
 from dt_tpu.policy.engine import (Decision as Decision,
                                   PolicyEngine as PolicyEngine,
-                                  enabled as enabled)
+                                  ServeDecision as ServeDecision,
+                                  ServePolicy as ServePolicy,
+                                  enabled as enabled,
+                                  serving_enabled as serving_enabled)

@@ -170,3 +170,116 @@ def enabled() -> bool:
     """Whether the policy engine is on for this process (``DT_POLICY=1``
     in ``dt_tpu.config.ENV_REGISTRY``)."""
     return config.env("DT_POLICY").strip().lower() in ("1", "true")
+
+
+# ---------------------------------------------------------------------------
+# Serving mode (r21 — dt_tpu/serve): the same closed elastic loop, inputs
+# repointed from round-lag EWMAs to the live serve gauges the replicas
+# heartbeat in (queue depth / p99 / qps), outputs repointed from batch
+# shares to replica-set scaling.  docs/serving.md.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeDecision:
+    """One serving-policy evaluation (pure data).  ``action`` is
+    ``"hold"`` / ``"scale_up"`` / ``"scale_down"``; only non-hold
+    decisions enter the scheduler's decision log (so the log's sha256 is
+    a function of the LOAD PATTERN, not of heartbeat timing)."""
+
+    action: str
+    #: replicas whose queue gauge breached DT_SERVE_QHI this evaluation
+    breached: List[str]
+    #: post-decision (hi, lo) consecutive-evaluation streaks
+    hi_streak: int
+    lo_streak: int
+    #: scale_down only: the replica to drain (highest-sorted non-base —
+    #: last to join a conventionally-named fleet leaves first)
+    host: Optional[str] = None
+    #: scale_up only: replicas to add (always 1 per decision — scaling
+    #: re-evaluates against the grown fleet instead of overshooting)
+    want: int = 0
+
+
+class ServePolicy:
+    """Deterministic replica-autoscale rules over the serve gauges.
+
+    ``q_hi``/``q_lo``: mean queued requests per replica above/below
+    which an overload/idle streak accrues; ``up_after``/``down_after``: streak
+    lengths (consecutive evaluations) before a decision fires;
+    ``min_replicas``/``max_replicas``: the fleet bounds.  Like
+    :class:`PolicyEngine`, the decision function is PURE — same inputs,
+    same decision — so the chaos load-step drill can gate a
+    bit-identical decision log across runs at one seed."""
+
+    def __init__(self, q_hi: float = 8.0, q_lo: float = 0.5,
+                 up_after: int = 3, down_after: int = 6,
+                 min_replicas: int = 1, max_replicas: int = 8):
+        self.q_hi = float(q_hi)
+        self.q_lo = float(q_lo)
+        self.up_after = max(int(up_after), 1)
+        self.down_after = max(int(down_after), 1)
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = max(int(max_replicas), self.min_replicas)
+
+    @classmethod
+    def from_env(cls) -> "ServePolicy":
+        """Build from the ``DT_SERVE_*`` registry rows
+        (``dt_tpu.config.ENV_REGISTRY``)."""
+        return cls(
+            q_hi=float(config.env("DT_SERVE_QHI")),
+            q_lo=float(config.env("DT_SERVE_QLO")),
+            up_after=int(config.env("DT_SERVE_UP_AFTER")),
+            down_after=int(config.env("DT_SERVE_DOWN_AFTER")),
+            min_replicas=int(config.env("DT_SERVE_MIN_REPLICAS")),
+            max_replicas=int(config.env("DT_SERVE_MAX_REPLICAS")))
+
+    # deterministic: replay — decision-log sha256 identity across runs
+    def decide(self, replicas: Sequence[str], base: Set[str],
+               queue_depths: Mapping[str, float], hi_streak: int,
+               lo_streak: int) -> ServeDecision:
+        """Pure decision for one evaluation.  ``replicas`` is the
+        sorted live (non-draining) replica set; ``queue_depths`` the
+        freshest heartbeat ``serve.queue_depth`` gauge per replica.
+        Overload = fleet MEAN queue depth at/above ``q_hi`` (one hot
+        replica behind a balanced load generator means the fleet is
+        undersized, not that one replica is slow — the training plane's
+        per-worker straggler logic stays with :class:`PolicyEngine`);
+        idle = mean at/below ``q_lo``.  Base replicas are never chosen
+        for drain (the reference's base protection, README.md:54-61)."""
+        replicas = sorted(replicas)
+        mean_q = (sum(float(queue_depths.get(h, 0.0)) for h in replicas)
+                  / len(replicas)) if replicas else 0.0
+        breached = sorted(h for h in replicas
+                          if float(queue_depths.get(h, 0.0)) >= self.q_hi)
+        if mean_q >= self.q_hi:
+            hi_streak, lo_streak = hi_streak + 1, 0
+        elif mean_q <= self.q_lo:
+            hi_streak, lo_streak = 0, lo_streak + 1
+        else:
+            hi_streak = lo_streak = 0
+        # streaks saturate at their thresholds (the PolicyEngine cap
+        # rationale): past the firing point a bigger number carries no
+        # information, and an un-capped streak would re-fire every
+        # evaluation while the fleet is already at its bound
+        hi_streak = min(hi_streak, self.up_after)
+        lo_streak = min(lo_streak, self.down_after)
+        if hi_streak >= self.up_after and \
+                len(replicas) < self.max_replicas:
+            return ServeDecision(action="scale_up", breached=breached,
+                                 hi_streak=0, lo_streak=0, want=1)
+        if lo_streak >= self.down_after and \
+                len(replicas) > self.min_replicas:
+            cands = [h for h in replicas if h not in base]
+            if cands:
+                return ServeDecision(action="scale_down",
+                                     breached=breached, hi_streak=0,
+                                     lo_streak=0, host=cands[-1])
+        return ServeDecision(action="hold", breached=breached,
+                             hi_streak=hi_streak, lo_streak=lo_streak)
+
+
+def serving_enabled() -> bool:
+    """Whether the serving autoscale mode is on (``DT_SERVE_POLICY=1``
+    in ``dt_tpu.config.ENV_REGISTRY``)."""
+    return config.env("DT_SERVE_POLICY").strip().lower() in ("1", "true")

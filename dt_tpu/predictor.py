@@ -14,8 +14,17 @@ and jit the eval forward.  TPU-first differences:
   MXPredReshape way would be pathological on TPU.
 - ``warmup()`` pre-compiles the buckets before traffic.
 - ``from_onnx`` serves a model imported through :mod:`dt_tpu.onnx`
-  (the C predict API's load-a-foreign-artifact role).
-- ``stats`` exposes request/compile counters for capacity planning.
+  (the C predict API's load-a-foreign-artifact role); ``from_fn`` serves
+  any ``(params, batch_stats, x) -> y`` forward (the dt_tpu.serve toy
+  replicas and tests ride it).
+- ``stats`` exposes request/compile counters for capacity planning —
+  since r21 they are a view over the ``predict.*`` obs counters
+  (``dt_tpu/obs/names.py``), so dtop and the Prometheus export see the
+  same numbers instead of a dead per-instance dict.
+- ``swap_params`` is the rolling-weight-refresh seam (``dt_tpu/serve/
+  refresh.py``): replace the served parameters atomically between
+  batches — compiled bucket programs are keyed by shape, so a same-
+  shape swap never recompiles.
 """
 
 from __future__ import annotations
@@ -28,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dt_tpu import models as models_lib
+from dt_tpu.obs import metrics as obs_metrics
+from dt_tpu.obs import trace as obs_trace
 from dt_tpu.training import checkpoint as ckpt_lib
 from dt_tpu.training.train_state import TrainState
 
@@ -87,6 +98,11 @@ class Predictor:
         self._fwd = obs_device.instrument("predictor", jax.jit(fwd))
         self.batch_buckets = sorted(batch_buckets) if batch_buckets \
             else _default_buckets(max_batch)
+        # per-instance counters kept for the historical `stats` dict
+        # view; every increment ALSO lands on the process obs plane
+        # (predict.* counters + the predict.ms histogram) so dtop and
+        # the Prometheus export see serving load without reaching into
+        # instances
         self.stats = {"requests": 0, "rows": 0, "compiles": 0,
                       "serve_s": 0.0}
         self._compiled = set()
@@ -115,7 +131,37 @@ class Predictor:
         self._init_serving(fwd, batch_buckets, max_batch)
         return self
 
+    @classmethod
+    def from_fn(cls, fn, params, dtype=jnp.float32,
+                batch_buckets: Optional[Sequence[int]] = None,
+                max_batch: int = 256) -> "Predictor":
+        """Serve an arbitrary ``(params, batch_stats, x) -> y`` forward
+        with the same bucketed pipeline — the seam the dt_tpu.serve
+        replicas and tests use to stand up a gateway without a
+        checkpoint on disk."""
+        self = cls.__new__(cls)
+        self.model = None
+        self.state = None
+        self.dtype = dtype
+        self._onnx_params = params
+        self._init_serving(fn, batch_buckets, max_batch)
+        return self
+
     # ------------------------------------------------------------------
+
+    def swap_params(self, params, batch_stats=None) -> None:
+        """Atomically replace the served parameters (rolling weight
+        refresh, ``dt_tpu/serve/refresh.py``).  The assignment is a
+        single reference swap: an in-flight ``predict`` keeps the
+        snapshot it read in ``_params_stats`` — every request is served
+        entirely by old or entirely by new weights, never a torn mix."""
+        if self.state is not None:
+            self.state = self.state.replace(
+                params=params,
+                batch_stats=self.state.batch_stats
+                if batch_stats is None else batch_stats)
+        else:
+            self._onnx_params = params
 
     def _params_stats(self):
         if self.state is not None:
@@ -163,6 +209,7 @@ class Predictor:
                 self._compiled.add(key)
                 if not _warmup:
                     self.stats["compiles"] += 1
+                    obs_trace.tracer().counter("predict.compiles")
             if len(part) < b:  # pad up to the bucket, slice back after
                 pad = np.zeros((b - len(part),) + part.shape[1:],
                                part.dtype)
@@ -177,9 +224,14 @@ class Predictor:
         chunks = [np.asarray(jax.device_get(o))[:keep]
                   for o, keep in dev_outs]
         if not _warmup:
+            dt = time.perf_counter() - t0
             self.stats["requests"] += 1
             self.stats["rows"] += n
-            self.stats["serve_s"] += time.perf_counter() - t0
+            self.stats["serve_s"] += dt
+            tr = obs_trace.tracer()
+            tr.counter("predict.requests")
+            tr.counter("predict.rows", n)
+            obs_metrics.registry().observe("predict.ms", dt * 1000.0)
         return chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
 
     def predict_proba(self, x: np.ndarray) -> np.ndarray:

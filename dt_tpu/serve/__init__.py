@@ -1,0 +1,34 @@
+"""dt_tpu.serve — elastic dynamic-batched inference on the dt_tpu fleet.
+
+Reference: the C predict API (``src/c_api/c_predict_api.cc:278,339,461``)
+is a single-process, fixed-shape, one-request-at-a-time surface — no
+batching, no fleet, re-bind per shape.  This package is its fleet-scale
+successor on the existing elastic machinery:
+
+- :mod:`dt_tpu.serve.gateway` — per-replica request server over the
+  pooled zero-copy transport (``elastic/protocol.py``): deadline-aware
+  dynamic batching into :class:`~dt_tpu.predictor.Predictor`'s compiled
+  batch buckets, bounded admission (counted shed, never an unbounded
+  queue), idempotent ``infer`` (token-cached answers survive retries).
+- :mod:`dt_tpu.serve.replica` — gateway + Predictor + the control-plane
+  client that registers with the Scheduler and ships live serve gauges
+  through the r15 metrics plane; survives scheduler failover via
+  ``DT_CTRL_ENDPOINTS`` rotation.
+- :mod:`dt_tpu.serve.refresh` — rolling weight refresh from the r19
+  committed fleet-checkpoint manifest, one replica at a time,
+  drain-then-swap (every answer is entirely old or entirely new
+  weights, never a torn mix).
+- :mod:`dt_tpu.serve.client` — the request side (``InferClient``):
+  endpoint discovery via ``serve_endpoints``, retry-with-same-token
+  across replica kills.
+
+Autoscaling policy lives with the training policy engine
+(:class:`dt_tpu.policy.engine.ServePolicy`); the scheduler evaluates it
+on serve heartbeats and the decision log is byte-deterministic at one
+seed (``docs/serving.md``).
+"""
+
+from dt_tpu.serve.client import InferClient  # noqa: F401
+from dt_tpu.serve.gateway import DynamicBatcher, Gateway  # noqa: F401
+from dt_tpu.serve.refresh import RollingRefresher  # noqa: F401
+from dt_tpu.serve.replica import Replica, ServeClient  # noqa: F401

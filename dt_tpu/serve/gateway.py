@@ -1,0 +1,462 @@
+"""Serving gateway — deadline-aware dynamic batching over the pooled
+transport.
+
+Reference: ``src/c_api/c_predict_api.cc:461`` (``MXPredForward``) runs
+ONE request at a time on a predictor bound at a fixed shape (``:278``),
+re-binding on every shape change (``MXPredReshape``, ``:339``).  On TPU
+that contract inverts: compiles are the expensive axis, so the gateway
+coalesces concurrent requests into :class:`~dt_tpu.predictor.Predictor`'s
+pre-compiled batch buckets instead of ever re-binding per request.
+
+Two-level structure:
+
+- :class:`DynamicBatcher` — the pure batching math, fake-clock testable
+  (tests/test_serve.py pins its numbers): launch a batch the moment the
+  queue can fill the largest bucket; otherwise wait at most HALF the
+  ``DT_SERVE_DEADLINE_MS`` budget from the oldest enqueue (the other
+  half is execution headroom) and launch into the smallest bucket that
+  fits.  Admission is bounded by ``DT_SERVE_QUEUE_ROWS``: over the cap
+  a request is SHED with a counted ``serve.shed`` and an explicit
+  ``{"shed": true}`` answer — never an unbounded queue.
+- :class:`Gateway` — the server plumbing, structurally the range
+  server's (``elastic/range_server.py``): persistent connections via
+  ``protocol.serve_connection``, the r13 ``rpc.<cmd>`` causal span via
+  ``protocol.traced_handle``, and the r17 at-least-once contract via
+  ``protocol.TokenCache`` — ``infer`` is registry class ``once``
+  (``elastic/commands.py``), so a retried request (including one that
+  crosses a scheduler failover — the data plane never touches the
+  scheduler) is served the SAME cached answer instead of recomputed.
+
+A single executor thread drains the queue; ``weight_refresh`` swaps
+parameters under the same execution lock, so a swap waits for the
+in-flight batch and every answer is served entirely by old or entirely
+by new weights (drain-then-swap; ``serve/refresh.py``).  Every ``infer``
+answer carries ``weights_step`` so the never-torn property is testable.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import random
+import socket
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dt_tpu import config
+from dt_tpu.elastic import commands, faults, protocol
+from dt_tpu.obs import metrics as obs_metrics
+from dt_tpu.obs import trace as obs_trace
+
+logger = logging.getLogger("dt_tpu.serve")
+_drop_rng = random.Random(0x5EED)  # deterministic fault injection
+
+#: responses never token-cached (read-only / idempotent-by-key);
+#: derived view over the PROTOCOL_REGISTRY — dtlint DT013 pins it to
+#: handler reality, exactly like the scheduler's and range server's
+_TOKEN_EXEMPT = commands.token_exempt("replica")
+
+
+class DynamicBatcher:
+    """Pure deadline/bucket batching math — no clock, no threads.
+
+    ``plan(pending, now_ms)`` with ``pending`` an ordered list of
+    ``(rows, enqueue_ms)`` returns how many requests to launch NOW
+    (0 = keep waiting):
+
+    - take the longest FIFO prefix whose total rows fit the largest
+      bucket (requests are never split — a single request larger than
+      the max bucket is rejected at admission);
+    - launch immediately when that prefix is as full as it can get
+      (total == max bucket, or a request is already waiting behind it);
+    - otherwise launch once ``now_ms`` reaches the oldest request's
+      enqueue time plus HALF the deadline budget — the remaining half
+      is headroom for the forward pass itself, keeping end-to-end p99
+      under ``deadline_ms`` at moderate load.
+    """
+
+    def __init__(self, buckets: Sequence[int], deadline_ms: float,
+                 queue_rows: int):
+        self.buckets = sorted(int(b) for b in buckets)
+        self.deadline_ms = float(deadline_ms)
+        self.queue_rows = int(queue_rows)
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_of(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def admit(self, queued_rows: int, n: int) -> bool:
+        """Bounded admission: one request never exceeds the max bucket,
+        and the queue never exceeds ``queue_rows`` rows."""
+        return 0 < n <= self.max_batch and \
+            queued_rows + n <= self.queue_rows
+
+    def plan(self, pending: Sequence[Tuple[int, float]],
+             now_ms: float) -> int:
+        if not pending:
+            return 0
+        take, total = 0, 0
+        for rows, _ in pending:
+            if total + rows > self.max_batch:
+                break
+            take += 1
+            total += rows
+        if total == self.max_batch or take < len(pending):
+            return take  # the batch cannot get any fuller: launch
+        if now_ms - pending[0][1] >= self.deadline_ms / 2.0:
+            return take  # half the budget spent waiting: launch partial
+        return 0
+
+    def next_wakeup_ms(self, oldest_enqueue_ms: float) -> float:
+        """Absolute time the oldest request's wait budget expires."""
+        return oldest_enqueue_ms + self.deadline_ms / 2.0
+
+
+class _Pending:
+    __slots__ = ("rid", "x", "enq_ms", "event", "result")
+
+    def __init__(self, rid, x, enq_ms):
+        self.rid = rid
+        self.x = x
+        self.enq_ms = enq_ms
+        self.event = threading.Event()
+        self.result = None
+
+
+class Gateway:
+    """One replica's request server: Predictor behind a dynamic batcher.
+
+    ``refresh_loader(step, manifest) -> params | (params, batch_stats)
+    | None`` resolves a ``weight_refresh`` request to new parameters
+    (``serve/refresh.py`` supplies the committed-manifest loader; toy
+    replicas derive params from the step directly).
+    """
+
+    #: async results retained for ``infer_result`` polls (LRU-capped)
+    _RESULT_CAP = 1024
+
+    def __init__(self, predictor, port: int = 0, name: str = "gateway",
+                 deadline_ms: Optional[float] = None,
+                 queue_rows: Optional[int] = None,
+                 refresh_loader: Optional[Callable] = None):
+        self._predictor = predictor
+        self._batcher = DynamicBatcher(
+            predictor.batch_buckets,
+            float(config.env("DT_SERVE_DEADLINE_MS"))
+            if deadline_ms is None else deadline_ms,
+            int(config.env("DT_SERVE_QUEUE_ROWS"))
+            if queue_rows is None else queue_rows)
+        self._refresh_loader = refresh_loader
+        self._obs = obs_trace.Tracer(name=name)
+        self._tokens = protocol.TokenCache(
+            ttl_s=float(config.env("DT_CTRL_TOKEN_TTL_S")))
+
+        self._cond = threading.Condition()
+        self._pending: List[_Pending] = []  # guarded-by: _cond
+        self._queued_rows = 0  # guarded-by: _cond
+        self._draining = False  # guarded-by: _cond
+        # swap-vs-batch serialization: weight_refresh takes this lock,
+        # so a swap waits out the in-flight batch (drain-then-swap)
+        self._exec_lock = threading.Lock()
+        self._weights_step = 0  # guarded-by: _exec_lock
+        self._refreshes = 0  # guarded-by: _exec_lock
+        self._results = collections.OrderedDict()  # guarded-by: _results_lock
+        self._results_lock = threading.Lock()
+        # (done_monotonic_s, latency_ms) ring for p50/p99/qps
+        self._lat = collections.deque(maxlen=2048)  # guarded-by: _lat_lock
+        self._lat_lock = threading.Lock()
+        # sync infers give the executor generous headroom before giving
+        # up (the batching deadline is a TARGET, not an execution bound)
+        self._wait_s = max(5.0, self._batcher.deadline_ms / 10.0)
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((protocol.bind_interface(), port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._exec_thread = threading.Thread(target=self._exec_loop,
+                                             daemon=True)
+        self._exec_thread.start()
+        logger.info("serve gateway %s listening on :%d", name, self.port)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    @property
+    def weights_step(self) -> int:
+        with self._exec_lock:
+            return self._weights_step
+
+    def _lat_view(self) -> Tuple[float, float, float]:
+        """(p50_ms, p99_ms, qps) over the recent-completion ring; qps is
+        the answer rate over the trailing 5 s window."""
+        with self._lat_lock:
+            ring = list(self._lat)
+        if not ring:
+            return 0.0, 0.0, 0.0
+        lats = sorted(ms for _, ms in ring)
+        p50 = lats[len(lats) // 2]
+        p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+        now = time.monotonic()
+        recent = sum(1 for ts, _ in ring if now - ts <= 5.0)
+        return p50, p99, recent / 5.0
+
+    def stats(self) -> dict:
+        """Gateway introspection (the ``serve_stats`` arm) — pure read."""
+        with self._cond:
+            depth = len(self._pending)
+            rows = self._queued_rows
+            draining = self._draining
+        with self._exec_lock:
+            step = self._weights_step
+            refreshes = self._refreshes
+        p50, p99, qps = self._lat_view()
+        return {"queue_depth": depth, "queued_rows": rows,
+                "draining": draining, "weights_step": step,
+                "refreshes": refreshes, "p50_ms": p50, "p99_ms": p99,
+                "qps": qps,
+                "requests": self._obs.get_counter("serve.requests"),
+                "rows": self._obs.get_counter("serve.rows"),
+                "batches": self._obs.get_counter("serve.batches"),
+                "shed": self._obs.get_counter("serve.shed")}
+
+    def gauges(self) -> dict:
+        """Publish the live serve gauges on the process metrics plane
+        and return them — the replica heartbeat ships this dict to the
+        scheduler, where the autoscaling policy reads queue depth."""
+        with self._cond:
+            depth = float(len(self._pending))
+        _, p99, qps = self._lat_view()
+        reg = obs_metrics.registry()
+        reg.gauge("serve.queue_depth", depth)
+        reg.gauge("serve.p99_ms", p99)
+        reg.gauge("serve.qps", qps)
+        return {"serve.queue_depth": depth, "serve.p99_ms": p99,
+                "serve.qps": qps}
+
+    # ------------------------------------------------------------------
+    # drain (scale-down / rolling shutdown)
+    # ------------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Stop admitting; queued requests still complete.  New infers
+        are answered ``{"error": "draining"}`` — an error answer is
+        never token-cached, so the client's retry lands on another
+        replica with the SAME token and the answer stays exactly-once."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify()
+
+    def drained(self) -> bool:
+        with self._cond:
+            return self._draining and not self._pending
+
+    # ------------------------------------------------------------------
+    # server plumbing (same shape as the range server's)
+    # ------------------------------------------------------------------
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _handle_conn(self, conn: socket.socket):
+        protocol.serve_connection(conn, self._handle_one)
+
+    def _handle_one(self, msg: dict) -> Optional[dict]:
+        return protocol.traced_handle(self._obs, msg, self._handle_inner)
+
+    def _handle_inner(self, msg: dict) -> Optional[dict]:
+        """One request on a persistent connection (``None`` = drop)."""
+        drop = os.environ.get("DT_DROP_MSG")
+        if drop and _drop_rng.random() * 100 < float(drop):
+            logger.debug("DT_DROP_MSG: dropping %s", msg.get("cmd"))
+            return None
+        plan = faults.active_plan()
+        if plan is not None and \
+                not plan.on_recv(msg.get("cmd"), msg.get("host")):
+            return None
+        token = msg.get("token")
+        if token is not None:
+            cached = self._tokens.get(token)
+            if cached is not None:
+                self._obs.counter("tokens.dedup_hits")
+                return cached
+        try:
+            resp = self._dispatch(msg)
+        except Exception as e:
+            logger.exception("serve gateway handler error")
+            return {"error": repr(e)}
+        if token is not None and "error" not in resp and \
+                msg.get("cmd") not in _TOKEN_EXEMPT:
+            self._tokens.put(token, resp)
+        return resp
+
+    def _dispatch(self, msg: dict) -> dict:
+        cmd = msg.get("cmd")
+        if cmd == "infer":
+            x = np.asarray(msg["x"])
+            wait = bool(msg.get("wait", True))
+            rid = msg.get("rid")
+            n = int(x.shape[0]) if x.ndim else 0
+            with self._cond:
+                if self._draining:
+                    return {"error": "draining"}
+                if n > self._batcher.max_batch or n <= 0:
+                    return {"error": f"request rows {n} outside "
+                                     f"(0, {self._batcher.max_batch}]"}
+                if not self._batcher.admit(self._queued_rows, n):
+                    self._obs.counter("serve.shed")
+                    return {"shed": True}
+                req = _Pending(rid, x, time.monotonic() * 1000.0)
+                self._pending.append(req)
+                self._queued_rows += n
+                self._obs.counter("serve.requests")
+                self._obs.counter("serve.rows", n)
+                self._cond.notify()
+            if not wait:
+                return {"queued": True, "rid": rid}
+            if not req.event.wait(self._wait_s) or req.result is None:
+                return {"error": "serve timeout"}
+            return dict(req.result)
+        if cmd == "infer_result":
+            # read-only poll (registry class read_only — DT013 checks
+            # this arm never mutates); pruning happens in the executor
+            with self._results_lock:
+                res = self._results.get(msg["rid"])
+            if res is None:
+                return {"done": False}
+            out = dict(res)
+            out["done"] = True
+            return out
+        if cmd == "serve_stats":
+            return self.stats()
+        if cmd == "weight_refresh":
+            return self._refresh(int(msg["step"]), msg.get("manifest"))
+        if cmd == "shutdown":
+            self.close()
+            return {}
+        return {"error": f"unknown cmd {cmd!r} (serve gateway)"}
+
+    # ------------------------------------------------------------------
+    # rolling weight refresh (drain-then-swap)
+    # ------------------------------------------------------------------
+
+    def _refresh(self, step: int, manifest: Optional[dict]) -> dict:
+        with self._exec_lock:  # waits out the in-flight batch
+            if step <= self._weights_step:
+                # idempotent by step key: re-applying the step already
+                # being served (a refresher retry) is a no-op
+                return {"weights_step": self._weights_step,
+                        "applied": False}
+            if self._refresh_loader is None:
+                return {"error": f"no refresh loader for step {step}"}
+            loaded = self._refresh_loader(step, manifest)
+            if loaded is None:
+                return {"error": f"refresh loader returned nothing for "
+                                 f"step {step}"}
+            params, batch_stats = loaded if isinstance(loaded, tuple) \
+                else (loaded, None)
+            self._predictor.swap_params(params, batch_stats)
+            self._weights_step = step
+            self._refreshes += 1
+        self._obs.event("serve.refresh", {"step": step})
+        logger.info("weights refreshed to step %d", step)
+        return {"weights_step": step, "applied": True}
+
+    # ------------------------------------------------------------------
+    # executor
+    # ------------------------------------------------------------------
+
+    def _exec_loop(self):
+        while True:
+            with self._cond:
+                while not self._stop.is_set():
+                    now_ms = time.monotonic() * 1000.0
+                    k = self._batcher.plan(
+                        [(int(p.x.shape[0]), p.enq_ms)
+                         for p in self._pending], now_ms)
+                    if k:
+                        break
+                    if self._pending:
+                        wake = self._batcher.next_wakeup_ms(
+                            self._pending[0].enq_ms)
+                        self._cond.wait(
+                            max(wake - now_ms, 1.0) / 1000.0)
+                    else:
+                        self._cond.wait(0.2)
+                if self._stop.is_set():
+                    return
+                batch = self._pending[:k]
+                del self._pending[:k]
+                self._queued_rows -= sum(int(p.x.shape[0])
+                                         for p in batch)
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        rows = sum(int(p.x.shape[0]) for p in batch)
+        t0 = self._obs.begin("serve.batch")
+        err = None
+        with self._exec_lock:
+            step = self._weights_step
+            x = batch[0].x if len(batch) == 1 else \
+                np.concatenate([p.x for p in batch])
+            try:
+                y = self._predictor.predict(x)
+            except Exception as e:  # answer the batch, don't kill it
+                logger.exception("serve batch failed")
+                err = repr(e)
+        self._obs.complete_span(
+            "serve.batch", t0,
+            {"rows": rows, "requests": len(batch),
+             "bucket": self._batcher.bucket_of(rows)})
+        self._obs.counter("serve.batches")
+        done = time.monotonic()
+        reg = obs_metrics.registry()
+        off = 0
+        for p in batch:
+            n = int(p.x.shape[0])
+            if err is not None:
+                resp = {"error": err}
+            else:
+                resp = {"y": y[off:off + n], "weights_step": step}
+            off += n
+            lat_ms = done * 1000.0 - p.enq_ms
+            with self._lat_lock:
+                self._lat.append((done, lat_ms))
+            reg.observe("serve.latency_ms", lat_ms)
+            if p.rid is not None:
+                with self._results_lock:
+                    self._results[p.rid] = resp
+                    while len(self._results) > self._RESULT_CAP:
+                        self._results.popitem(last=False)
+            p.result = resp
+            p.event.set()
+        self.gauges()  # refresh the local metrics plane per batch
+
+    def close(self):
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,249 @@
+"""Serving replica — a Predictor + Gateway wired into the control plane.
+
+Reference: ``src/c_api/c_predict_api.cc:278`` (``MXPredCreate``) stands
+up ONE predictor in ONE process with no fleet awareness.  A dt_tpu
+replica is that predictor behind a :class:`~dt_tpu.serve.gateway.Gateway`
+plus a :class:`ServeClient` that makes it a FLEET member: it registers
+with the Scheduler (``serve_register``), heartbeats the live serve
+gauges (``serve_heartbeat`` — queue depth feeds the
+:class:`~dt_tpu.policy.engine.ServePolicy` autoscaler), and honors the
+drain flag the scheduler raises on scale-down.
+
+Failover: the client rotates through ``DT_CTRL_ENDPOINTS`` exactly like
+the training ``WorkerClient`` (docs/ha.md) — a heartbeat answered by a
+freshly-promoted standby whose serve table is empty comes back
+``registered: false`` and the client re-registers, so the serving view
+reconverges within one heartbeat interval and NO in-flight request is
+touched (inference traffic never crosses the scheduler).
+
+``python -m dt_tpu.serve.replica`` is the subprocess entry the serve
+bench and chaos plans launch: a deterministic toy linear model
+(``params_for_step`` — weights derived from the refresh step, so the
+rolling-refresh drills can assert exact served values) or an ONNX
+artifact via ``--onnx``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from dt_tpu import config
+from dt_tpu.elastic import protocol
+from dt_tpu.elastic.client import parse_endpoints
+from dt_tpu.serve.gateway import Gateway
+
+logger = logging.getLogger("dt_tpu.serve")
+
+
+class ServeClient:
+    """Control-plane side of a replica: register + heartbeat with
+    endpoint rotation (``DT_CTRL_ENDPOINTS``), drain callback."""
+
+    def __init__(self, endpoints: Union[str, Sequence[Tuple[str, int]]],
+                 host: str, addr: Tuple[str, int],
+                 gauges_fn: Callable[[], dict],
+                 weights_fn: Callable[[], int],
+                 refreshes_fn: Callable[[], int],
+                 drain_cb: Optional[Callable[[], None]] = None,
+                 heartbeat_s: float = 0.25):
+        self.addrs = parse_endpoints(endpoints) \
+            if isinstance(endpoints, str) else [tuple(a) for a in endpoints]
+        if not self.addrs:
+            raise ValueError("ServeClient needs at least one scheduler "
+                             "endpoint")
+        self.host = host
+        self.addr = tuple(addr)
+        self._gauges_fn = gauges_fn
+        self._weights_fn = weights_fn
+        self._refreshes_fn = refreshes_fn
+        self._drain_cb = drain_cb
+        self._interval = float(heartbeat_s)
+        self._lock = threading.Lock()
+        self._leader = 0  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _req(self, msg: dict, timeout: float = 5.0) -> dict:
+        """One control request with leader rotation (docs/ha.md)."""
+        last: Optional[BaseException] = None
+        delay = 0.05
+        for _ in range(max(len(self.addrs), 1) * 4):
+            with self._lock:
+                host, port = self.addrs[self._leader]
+            try:
+                resp = protocol.request(host, port, dict(msg),
+                                        timeout=timeout)
+            except (ConnectionError, OSError) as e:
+                last = e
+                self._rotate()
+                time.sleep(delay)
+                delay = protocol.next_backoff(delay, 0.05, 0.5)
+                continue
+            if resp.get("error") in ("not_leader", "fenced"):
+                self._rotate()
+                continue
+            return resp
+        raise ConnectionError(f"no scheduler endpoint answered "
+                              f"{msg.get('cmd')!r}: {last!r}")
+
+    def _rotate(self) -> None:
+        with self._lock:
+            self._leader = (self._leader + 1) % len(self.addrs)
+
+    def register(self) -> None:
+        self._req({"cmd": "serve_register", "host": self.host,
+                   "addr": list(self.addr),
+                   "weights_step": self._weights_fn()})
+        logger.info("replica %s registered gateway %s:%d", self.host,
+                    self.addr[0], self.addr[1])
+
+    def start(self) -> None:
+        self.register()
+        self._thread = threading.Thread(target=self._beat_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                resp = self._req(
+                    {"cmd": "serve_heartbeat", "host": self.host,
+                     "gauges": self._gauges_fn(),
+                     "weights_step": self._weights_fn(),
+                     "refreshes": self._refreshes_fn()})
+            except ConnectionError:
+                continue  # keep beating; rotation already advanced
+            if not resp.get("registered"):
+                # a freshly-promoted standby with an empty serve table:
+                # re-register so the serving view reconverges
+                try:
+                    self.register()
+                except ConnectionError:
+                    pass
+            if resp.get("drain") and self._drain_cb is not None:
+                self._drain_cb()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class Replica:
+    """Gateway + Predictor + ServeClient, one serving fleet member."""
+
+    def __init__(self, predictor, host: str,
+                 scheduler: Union[str, Sequence[Tuple[str, int]]],
+                 port: int = 0,
+                 refresh_loader: Optional[Callable] = None,
+                 heartbeat_s: float = 0.25,
+                 advertise_host: Optional[str] = None):
+        self.host = host
+        self.gateway = Gateway(predictor, port=port,
+                               name=f"serve-{host}",
+                               refresh_loader=refresh_loader)
+        addr = (advertise_host or protocol.advertise_host(),
+                self.gateway.port)
+        self.client = ServeClient(
+            scheduler, host, addr,
+            gauges_fn=self.gateway.gauges,
+            weights_fn=lambda: self.gateway.weights_step,
+            refreshes_fn=lambda: self.gateway.stats()["refreshes"],
+            drain_cb=self.gateway.drain,
+            heartbeat_s=heartbeat_s)
+        self.client.start()
+
+    def close(self) -> None:
+        self.client.close()
+        self.gateway.close()
+
+    def serve_forever(self) -> None:  # pragma: no cover - CLI path
+        while not self.gateway._stop.wait(0.5):
+            pass
+
+
+def params_for_step(features: int, classes: int, step: int) -> dict:
+    """Deterministic toy weights keyed by the refresh step — the drills
+    assert exact served values per step, so this must be a pure
+    function of (shapes, step)."""
+    w = ((np.arange(features * classes, dtype=np.float64)
+          .reshape(features, classes) * (step + 1)) % 7 - 3) * 0.1
+    return {"w": w.astype(np.float32)}
+
+
+def toy_predictor(features: int = 8, classes: int = 4,
+                  max_batch: int = 64,
+                  buckets: Optional[Sequence[int]] = None,
+                  step: int = 0):
+    """A ``Predictor.from_fn`` linear model with :func:`params_for_step`
+    weights — the serve bench / chaos / test replica."""
+    from dt_tpu.predictor import Predictor
+
+    def fwd(params, _stats, x):
+        return x @ params["w"]
+
+    return Predictor.from_fn(fwd, params_for_step(features, classes,
+                                                  step),
+                             batch_buckets=buckets, max_batch=max_batch)
+
+
+def main() -> None:  # pragma: no cover - exercised via serve_bench/chaos
+    """CLI entry: ``python -m dt_tpu.serve.replica --scheduler h:p
+    --host w0`` — toy linear model unless ``--onnx`` names an artifact."""
+    import argparse
+    config.maybe_force_cpu()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scheduler", required=True,
+                    help="DT_CTRL_ENDPOINTS-style spec host:port[,h:p]")
+    ap.add_argument("--host", required=True)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--features", type=int, default=8)
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--max-batch", type=int,
+                    default=int(config.env("DT_SERVE_MAX_BATCH")))
+    ap.add_argument("--weights-step", type=int, default=0)
+    ap.add_argument("--onnx", default=None,
+                    help="serve this ONNX artifact instead of the toy "
+                         "linear model (no refresh loader)")
+    ap.add_argument("--port-file", default=None,
+                    help="write the bound gateway port here (harness "
+                         "discovery)")
+    args = ap.parse_args()
+
+    if args.onnx:
+        from dt_tpu.predictor import Predictor
+        pred = Predictor.from_onnx(args.onnx, max_batch=args.max_batch)
+        loader = None
+    else:
+        pred = toy_predictor(args.features, args.classes,
+                             max_batch=args.max_batch,
+                             step=args.weights_step)
+
+        def loader(step, _manifest):
+            return params_for_step(args.features, args.classes, step)
+
+    if not args.onnx:
+        pred.warmup(feature_shape=(args.features,))
+    rep = Replica(pred, args.host, args.scheduler, port=args.port,
+                  refresh_loader=loader, advertise_host="127.0.0.1")
+    if args.weights_step:
+        # the CLI starts mid-history (a restarted replica): align the
+        # gateway's step so refresh idempotency holds
+        rep.gateway._weights_step = int(args.weights_step)
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(rep.gateway.port))
+        os.replace(tmp, args.port_file)
+    try:
+        rep.serve_forever()
+    except KeyboardInterrupt:
+        rep.close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

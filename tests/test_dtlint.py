@@ -306,6 +306,10 @@ _PROTO_CLOSURE = (
     "dt_tpu/elastic/dataplane.py",
     "dt_tpu/elastic/journal.py",
     "dt_tpu/elastic/commands.py",
+    "dt_tpu/serve/gateway.py",
+    "dt_tpu/serve/client.py",
+    "dt_tpu/serve/replica.py",
+    "dt_tpu/serve/refresh.py",
     "dt_tpu/obs/names.py",
     "tools/chaos_run.py",
     "tools/dtop.py",

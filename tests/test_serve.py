@@ -1,0 +1,549 @@
+"""dt_tpu.serve — gateway batching math, padded-bucket correctness,
+shed accounting, idempotent retry dedup (incl. across scheduler
+failover), rolling refresh old-or-new-never-torn, autoscale policy, and
+the dtop serving board (docs/serving.md).
+
+Batcher numbers are pinned against a fake clock; served values assert
+against the ``Predictor.predict`` path and the plain numpy oracle
+``x @ params_for_step(...)["w"]`` (exact — CPU mesh, float32).
+"""
+
+import json
+import os
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from dt_tpu.elastic import protocol
+from dt_tpu.elastic.scheduler import Scheduler
+from dt_tpu.policy.engine import ServePolicy
+from dt_tpu.serve.client import InferClient
+from dt_tpu.serve.gateway import DynamicBatcher, Gateway
+from dt_tpu.serve.refresh import RollingRefresher
+from dt_tpu.serve.replica import Replica, params_for_step, toy_predictor
+
+
+# ---------------------------------------------------------------------------
+# DynamicBatcher: pure math vs a fake clock (pinned number-by-number)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_plan_pinned():
+    b = DynamicBatcher(buckets=[1, 2, 4, 8], deadline_ms=50.0,
+                       queue_rows=64)
+    t0 = 1000.0
+    # empty queue: nothing to do
+    assert b.plan([], t0) == 0
+    # one small request inside the wait budget: hold for coalescing
+    assert b.plan([(1, t0)], t0) == 0
+    assert b.plan([(1, t0)], t0 + 24.9) == 0
+    # half the deadline (25ms) spent waiting: launch the partial batch
+    assert b.plan([(1, t0)], t0 + 25.0) == 1
+    # queue fills the largest bucket exactly: launch immediately
+    assert b.plan([(4, t0), (4, t0 + 1)], t0 + 1) == 2
+    # prefix 3+4=7 <= 8 but adding 5 overflows: the batch cannot get
+    # fuller, launch the prefix NOW (a request is waiting behind it)
+    assert b.plan([(3, t0), (4, t0 + 1), (5, t0 + 2)], t0 + 2) == 2
+    # 8 single-row requests = one full bucket
+    assert b.plan([(1, t0 + i) for i in range(8)], t0 + 7) == 8
+    # 9 queued: launch the 8-row prefix immediately
+    assert b.plan([(1, t0 + i) for i in range(9)], t0 + 8) == 8
+    # wakeup math: absolute deadline for the oldest enqueue
+    assert b.next_wakeup_ms(t0) == t0 + 25.0
+
+
+def test_batcher_admission():
+    b = DynamicBatcher(buckets=[2, 4], deadline_ms=10.0, queue_rows=6)
+    assert b.admit(0, 4)
+    assert not b.admit(0, 5)  # single request larger than max bucket
+    assert not b.admit(0, 0)
+    assert b.admit(2, 4)
+    assert not b.admit(3, 4)  # would exceed the queue-row cap
+    assert b.bucket_of(1) == 2 and b.bucket_of(3) == 4
+    assert b.bucket_of(99) == 4  # callers cap at max_batch beforehand
+
+
+# ---------------------------------------------------------------------------
+# Gateway: served values vs Predictor.predict and the numpy oracle
+# ---------------------------------------------------------------------------
+
+F, C = 4, 3  # toy linear model: features, classes
+
+
+def _gateway(step=0, **kw):
+    pred = toy_predictor(F, C, max_batch=8, step=step)
+    pred.warmup(feature_shape=(F,))
+    return Gateway(pred, name=f"test-{uuid.uuid4().hex[:6]}", **kw), pred
+
+
+def test_gateway_padded_bucket_oracle():
+    gw, pred = _gateway()
+    try:
+        c = InferClient(replicas=[("127.0.0.1", gw.port)])
+        rng = np.random.RandomState(0)
+        w = params_for_step(F, C, 0)["w"]
+        # sizes that pad (3 -> bucket 4), fill exactly (8), and an
+        # empty-adjacent minimum (1)
+        for n in (1, 3, 5, 8):
+            x = rng.randn(n, F).astype(np.float32)
+            got = c.infer(x)
+            assert got["weights_step"] == 0
+            np.testing.assert_array_equal(got["y"], pred.predict(x))
+            np.testing.assert_allclose(got["y"], x @ w, rtol=1e-5)
+        # oversized request: explicit error, not a silent truncation
+        with pytest.raises(ConnectionError):
+            InferClient(replicas=[("127.0.0.1", gw.port)],
+                        tries=1).infer(rng.randn(9, F).astype(np.float32))
+    finally:
+        gw.close()
+
+
+def test_gateway_coalesces_concurrent_requests():
+    gw, _ = _gateway(deadline_ms=100.0)
+    try:
+        c = InferClient(replicas=[("127.0.0.1", gw.port)])
+        rng = np.random.RandomState(1)
+        xs = [rng.randn(2, F).astype(np.float32) for _ in range(4)]
+        outs = [None] * 4
+
+        def call(i):
+            outs[i] = c.infer(xs[i])
+
+        ts = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        w = params_for_step(F, C, 0)["w"]
+        for i in range(4):
+            np.testing.assert_allclose(outs[i]["y"], xs[i] @ w,
+                                       rtol=1e-5)
+        st = c.stats(("127.0.0.1", gw.port))
+        # 4 concurrent 2-row requests coalesce into at most 2 batches
+        # (8 rows fill one bucket; thread-start skew may split once)
+        assert st["requests"] == 4 and st["rows"] == 8
+        assert 1 <= st["batches"] <= 2
+    finally:
+        gw.close()
+
+
+def test_gateway_shed_accounting():
+    # tiny queue (4 rows) + an executor that cannot drain while we
+    # flood: shed + served must account for every submission
+    gw, _ = _gateway(queue_rows=4, deadline_ms=200.0)
+    try:
+        addr = ("127.0.0.1", gw.port)
+        c = InferClient(replicas=[addr])
+        x = np.ones((2, F), np.float32)
+        shed = served = 0
+        rids = []
+        for i in range(8):  # 16 rows at a 4-row cap, queued faster
+            resp = protocol.request(addr[0], addr[1],
+                                    {"cmd": "infer", "x": x,
+                                     "wait": False, "rid": f"r{i}"})
+            if resp.get("shed"):
+                shed += 1
+            else:
+                rids.append(f"r{i}")
+        for rid in rids:
+            out = c.result(rid, addr, wait_s=30.0)
+            np.testing.assert_allclose(
+                out["y"], x @ params_for_step(F, C, 0)["w"], rtol=1e-5)
+            served += 1
+        assert shed >= 1, "flood at a 4-row cap must shed"
+        assert served + shed == 8
+        st = c.stats(addr)
+        assert st["shed"] == shed and st["requests"] == served
+    finally:
+        gw.close()
+
+
+def test_infer_retry_dedup_same_token():
+    gw, _ = _gateway()
+    try:
+        addr = ("127.0.0.1", gw.port)
+        x = np.ones((2, F), np.float32)
+        tok = uuid.uuid4().hex
+        r1 = protocol.request(addr[0], addr[1],
+                              {"cmd": "infer", "x": x, "token": tok})
+        # the retry (same token) is served the CACHED answer: the
+        # gateway must not execute a second time
+        r2 = protocol.request(addr[0], addr[1],
+                              {"cmd": "infer", "x": x, "token": tok})
+        np.testing.assert_array_equal(r1["y"], r2["y"])
+        st = InferClient(replicas=[addr]).stats(addr)
+        assert st["requests"] == 1, "retry with one token re-executed"
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# control plane: registration, failover, refresh, autoscale
+# ---------------------------------------------------------------------------
+
+
+def _mk_replica(host, endpoints, **kw):
+    pred = toy_predictor(F, C, max_batch=8)
+    pred.warmup(feature_shape=(F,))
+    return Replica(pred, host, endpoints, heartbeat_s=0.1,
+                   refresh_loader=lambda s, _m: params_for_step(F, C, s),
+                   advertise_host="127.0.0.1", **kw)
+
+
+def test_replica_discovery_and_refresh_never_torn(tmp_path):
+    sched = Scheduler(initial_workers=[],
+                      host_worker_file=str(tmp_path / "hosts"))
+    reps = []
+    try:
+        eps = f"127.0.0.1:{sched.port}"
+        reps = [_mk_replica("s0", eps), _mk_replica("s1", eps)]
+        c = InferClient(scheduler=eps)
+        deadline = time.time() + 10
+        while len(c.refresh_endpoints()) < 2:
+            assert time.time() < deadline
+            time.sleep(0.05)
+
+        ws = {s: params_for_step(F, C, s)["w"] for s in (0, 7)}
+        stop = threading.Event()
+        bad = []
+
+        def hammer():
+            rng = np.random.RandomState(os.getpid() & 0xffff)
+            while not stop.is_set():
+                x = rng.randn(3, F).astype(np.float32)
+                out = c.infer(x)
+                # every answer must be ENTIRELY the weights of the step
+                # it claims — torn old/new mixes show up as mismatches
+                expect = x @ ws[out["weights_step"]]
+                if not np.allclose(out["y"], expect, rtol=1e-5):
+                    bad.append(out["weights_step"])
+
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        out = RollingRefresher(eps).poll_once(step=7, manifest=None)
+        assert sorted(out["applied"]) == ["s0", "s1"], out
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not bad, f"torn answers at steps {bad}"
+        # post-wave: everyone answers at step 7
+        assert c.infer(np.ones((2, F), np.float32))["weights_step"] == 7
+        # the serving view converges too (heartbeats carry the step)
+        deadline = time.time() + 10
+        while True:
+            v = protocol.request("127.0.0.1", sched.port,
+                                 {"cmd": "serve_endpoints"})
+            if all(e["weights_step"] == 7
+                   for e in v["replicas"].values()):
+                break
+            assert time.time() < deadline
+            time.sleep(0.05)
+    finally:
+        for r in reps:
+            r.close()
+        sched.close()
+
+
+def test_serve_survives_scheduler_failover(tmp_path):
+    jp = str(tmp_path / "ctrl.journal")
+    lp = str(tmp_path / "ctrl.lease")
+    standby = Scheduler(standby=True, journal_path=jp, lease_path=lp,
+                        lease_s=2.0)
+    primary = Scheduler(initial_workers=[], journal_path=jp,
+                        lease_path=lp, lease_s=2.0,
+                        host_worker_file=str(tmp_path / "hosts"))
+    eps = f"127.0.0.1:{primary.port},127.0.0.1:{standby.port}"
+    rep = None
+    try:
+        rep = _mk_replica("s0", eps)
+        c = InferClient(scheduler=eps)
+        deadline = time.time() + 10
+        while not c.refresh_endpoints():
+            assert time.time() < deadline
+            time.sleep(0.05)
+        x = np.ones((2, F), np.float32)
+        tok = uuid.uuid4().hex
+        before = c.infer(x, token=tok)
+
+        primary.close()  # the process dying, connections severed
+
+        # the data plane never touches the scheduler: the SAME token
+        # retried against the replica mid-failover returns the cached
+        # answer (exactly-once across the control-plane switch)
+        addr = ("127.0.0.1", rep.gateway.port)
+        again = protocol.request(addr[0], addr[1],
+                                 {"cmd": "infer", "x": x, "token": tok})
+        np.testing.assert_array_equal(before["y"], again["y"])
+        st = InferClient(replicas=[addr]).stats(addr)
+        assert st["requests"] == 1
+
+        # the replica's ServeClient rotates to the standby and
+        # re-registers; discovery reconverges without replica restarts
+        deadline = time.time() + 20
+        while True:
+            v = protocol.request("127.0.0.1", standby.port,
+                                 {"cmd": "serve_endpoints"})
+            if "error" not in v and "s0" in (v.get("replicas") or {}):
+                break
+            assert time.time() < deadline
+            time.sleep(0.1)
+        assert standby.is_leader()
+        c2 = InferClient(scheduler=f"127.0.0.1:{standby.port}")
+        np.testing.assert_allclose(
+            c2.infer(x)["y"], x @ params_for_step(F, C, 0)["w"],
+            rtol=1e-5)
+    finally:
+        if rep is not None:
+            rep.close()
+        standby.close()
+        primary.close()
+
+
+def test_from_onnx_replica_e2e(tmp_path):
+    from dt_tpu import onnx as donnx
+    from dt_tpu.predictor import Predictor
+
+    w = params_for_step(F, C, 0)["w"]
+    x0 = np.ones((2, F), np.float32)
+    blob = donnx.export_onnx(lambda x: x @ w, x0)
+    pred = Predictor.from_onnx(blob, max_batch=8)
+    sched = Scheduler(initial_workers=[],
+                      host_worker_file=str(tmp_path / "hosts"))
+    rep = None
+    try:
+        rep = Replica(pred, "onnx0", f"127.0.0.1:{sched.port}",
+                      heartbeat_s=0.1, advertise_host="127.0.0.1")
+        c = InferClient(scheduler=f"127.0.0.1:{sched.port}")
+        deadline = time.time() + 10
+        while not c.refresh_endpoints():
+            assert time.time() < deadline
+            time.sleep(0.05)
+        x = np.random.RandomState(3).randn(5, F).astype(np.float32)
+        out = c.infer(x)
+        np.testing.assert_allclose(out["y"], x @ w, rtol=1e-5)
+    finally:
+        if rep is not None:
+            rep.close()
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# ServePolicy: pure decide math + the scheduler's decision log
+# ---------------------------------------------------------------------------
+
+
+def test_serve_policy_decide_pinned():
+    p = ServePolicy(q_hi=8.0, q_lo=0.5, up_after=3, down_after=2,
+                    min_replicas=1, max_replicas=3)
+    live, base = ["a", "b"], {"a"}
+    hot = {"a": 9.0, "b": 9.0}
+    # streak accrual: hold, hold, then fire at up_after=3
+    d = p.decide(live, base, hot, 0, 0)
+    assert d.action == "hold" and (d.hi_streak, d.lo_streak) == (1, 0)
+    assert d.breached == ["a", "b"]
+    d = p.decide(live, base, hot, d.hi_streak, 0)
+    assert d.action == "hold" and d.hi_streak == 2
+    d = p.decide(live, base, hot, d.hi_streak, 0)
+    assert d.action == "scale_up" and d.want == 1
+    # at the fleet bound: saturated streak, never re-fires
+    d = p.decide(["a", "b", "c"], base, {"a": 9.0, "b": 9.0, "c": 9.0},
+                 3, 0)
+    assert d.action == "hold" and d.hi_streak == 3
+    # idle: mean 0 <= q_lo; base replica never drained
+    d = p.decide(live, base, {}, 0, 1)
+    assert d.action == "scale_down" and d.host == "b"
+    d = p.decide(["a"], base, {}, 0, 99)
+    assert d.action == "hold"  # at min_replicas
+    # mid-band resets both streaks
+    d = p.decide(live, base, {"a": 2.0, "b": 2.0}, 2, 1)
+    assert (d.hi_streak, d.lo_streak) == (0, 0)
+
+
+def test_scheduler_autoscale_decision_log(tmp_path, monkeypatch):
+    monkeypatch.setenv("DT_SERVE_POLICY", "1")
+    monkeypatch.setenv("DT_SERVE_QHI", "4")
+    monkeypatch.setenv("DT_SERVE_QLO", "0.5")
+    monkeypatch.setenv("DT_SERVE_UP_AFTER", "2")
+    monkeypatch.setenv("DT_SERVE_DOWN_AFTER", "2")
+    monkeypatch.setenv("DT_SERVE_MIN_REPLICAS", "1")
+    monkeypatch.setenv("DT_SERVE_MAX_REPLICAS", "2")
+    sched = Scheduler(initial_workers=[],
+                      host_worker_file=str(tmp_path / "hosts"))
+    try:
+        def beat(host, depth):
+            return protocol.request(
+                "127.0.0.1", sched.port,
+                {"cmd": "serve_heartbeat", "host": host,
+                 "gauges": {"serve.queue_depth": depth},
+                 "weights_step": 0, "refreshes": 0})
+
+        protocol.request("127.0.0.1", sched.port,
+                         {"cmd": "serve_register", "host": "s0",
+                          "addr": ["127.0.0.1", 1], "weights_step": 0})
+        # sustained pressure -> exactly one scale_up (evaluations are
+        # rate-limited to 4/s, so pace the beats past the throttle)
+        deadline = time.time() + 20
+        while True:
+            v = protocol.request("127.0.0.1", sched.port,
+                                 {"cmd": "serve_endpoints"})
+            if v["want"] == 2:
+                break
+            assert time.time() < deadline
+            beat("s0", 9.0)
+            time.sleep(0.15)
+        # the wanted replica arrives; sustained idle -> one scale_down
+        # draining the non-base replica
+        protocol.request("127.0.0.1", sched.port,
+                         {"cmd": "serve_register", "host": "s1",
+                          "addr": ["127.0.0.1", 2], "weights_step": 0})
+        deadline = time.time() + 20
+        while True:
+            v = protocol.request("127.0.0.1", sched.port,
+                                 {"cmd": "serve_endpoints"})
+            if v["want"] == 1:
+                break
+            assert time.time() < deadline
+            beat("s0", 0.0)
+            beat("s1", 0.0)
+            time.sleep(0.15)
+        assert v["replicas"]["s1"]["draining"] is True
+        assert not v["replicas"]["s0"]["draining"]
+        # the decision log carries exactly the two non-hold decisions,
+        # deterministic fields only (no wall clocks)
+        assert v["decisions"] == [
+            {"seq": 0, "kind": "scale_up", "n_before": 1, "n_after": 2},
+            {"seq": 1, "kind": "scale_down", "n_before": 2,
+             "n_after": 1, "host": "s1"}]
+        json.dumps(v["decisions"], sort_keys=True)  # byte-stable
+        # a drained replica re-registering cannot launder its flag
+        protocol.request("127.0.0.1", sched.port,
+                         {"cmd": "serve_register", "host": "s1",
+                          "addr": ["127.0.0.1", 2], "weights_step": 0})
+        v = protocol.request("127.0.0.1", sched.port,
+                             {"cmd": "serve_endpoints"})
+        assert v["replicas"]["s1"]["draining"] is True
+        assert v["want"] == 1
+        # status + obs_dump carry the serving section
+        st = protocol.request("127.0.0.1", sched.port, {"cmd": "status"})
+        assert st["serving"]["want"] == 1
+        assert st["serving"]["decisions"] == 2
+        dump = sched.obs_dump()
+        assert sorted(dump["serving"]["replicas"]) == ["s0", "s1"]
+    finally:
+        sched.close()
+
+
+def test_drain_rejects_new_but_finishes_queued():
+    gw, _ = _gateway(deadline_ms=100.0)
+    try:
+        addr = ("127.0.0.1", gw.port)
+        x = np.ones((2, F), np.float32)
+        protocol.request(addr[0], addr[1],
+                         {"cmd": "infer", "x": x, "wait": False,
+                          "rid": "q0"})
+        gw.drain()
+        resp = protocol.request(addr[0], addr[1],
+                                {"cmd": "infer", "x": x})
+        assert resp.get("error") == "draining"
+        # the queued request still completes
+        out = InferClient(replicas=[addr]).result("q0", addr,
+                                                  wait_s=30.0)
+        np.testing.assert_allclose(
+            out["y"], x @ params_for_step(F, C, 0)["w"], rtol=1e-5)
+        deadline = time.time() + 10
+        while not gw.drained():
+            assert time.time() < deadline
+            time.sleep(0.02)
+    finally:
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# export + dtop serving board (render contract, like the device golden)
+# ---------------------------------------------------------------------------
+
+
+def _serve_job():
+    """A pinned serving section covering every board row kind."""
+    return {
+        "tracks": {"control-plane": {
+            "records": [["i", 1, "serve.scale", 1000, None, 1, None,
+                         None, {"kind": "scale_up", "host": None,
+                                "replicas": 3}]],
+            "counters": {}, "dropped": 0}},
+        "serving": {
+            "enabled": True,
+            "want": 2,
+            "replicas": {
+                "s0": {"addr": ["127.0.0.1", 1],
+                       "gauges": {"serve.qps": 123.4,
+                                  "serve.p99_ms": 41.5,
+                                  "serve.queue_depth": 3.0},
+                       "weights_step": 8, "refreshes": 1,
+                       "draining": False},
+                "s1": {"addr": ["127.0.0.1", 2],
+                       "gauges": {"serve.qps": 0.0,
+                                  "serve.p99_ms": 0.0,
+                                  "serve.queue_depth": 0.0},
+                       "weights_step": 0, "refreshes": 0,
+                       "draining": True}},
+            "decisions": [
+                {"seq": 0, "kind": "scale_up", "n_before": 1,
+                 "n_after": 2},
+                {"seq": 1, "kind": "scale_down", "n_before": 2,
+                 "n_after": 1, "host": "s1"}]}}
+
+
+def test_export_threads_serving_section():
+    from dt_tpu.obs import export as obs_export
+    chrome = obs_export.chrome_trace(_serve_job())
+    summary = obs_export.summarize_chrome(chrome)
+    assert summary["serving"]["want"] == 2
+    assert summary["serving"]["replicas"]["s0"]["weights_step"] == 8
+    assert [d["kind"] for d in summary["serving"]["decisions"]] == \
+        ["scale_up", "scale_down"]
+    assert summary["serve_events"] == [
+        {"track": "control-plane", "ts": 1000, "what": "serve.scale",
+         "kind": "scale_up", "host": None, "replicas": 3}]
+
+
+def test_dtop_serving_board_golden(tmp_path):
+    import subprocess
+    import sys
+
+    from dt_tpu.obs import export as obs_export
+    chrome = obs_export.chrome_trace(_serve_job())
+    trace = str(tmp_path / "t.json")
+    with open(trace, "w") as f:
+        json.dump(chrome, f)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "dtop.py"), trace],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    start = r.stdout.index("serving board")
+    section = r.stdout[start:].split("\n\n")[0] + "\n"
+    golden = os.path.join(repo, "tests", "fixtures",
+                          "serve_board.golden")
+    assert section == open(golden).read(), section
+
+
+def test_stats_counters_mirror_obs_plane():
+    # satellite 1: Predictor.stats is a VIEW — the same numbers land on
+    # the predict.* obs counters
+    from dt_tpu.obs import trace as obs_trace
+    pred = toy_predictor(F, C, max_batch=8)
+    pred.warmup(feature_shape=(F,))
+    tr = obs_trace.tracer()
+    before = tr.get_counter("predict.requests")
+    rows_before = tr.get_counter("predict.rows")
+    pred.predict(np.ones((3, F), np.float32))
+    assert pred.stats["requests"] == 1 and pred.stats["rows"] == 3
+    assert tr.get_counter("predict.requests") == before + 1
+    assert tr.get_counter("predict.rows") == rows_before + 3

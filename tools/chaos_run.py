@@ -126,6 +126,8 @@ Usage::
     python tools/chaos_run.py --plan hang          # flight-recorder drill
     python tools/chaos_run.py --plan preempt       # graceful-drain drill
     python tools/chaos_run.py --plan outage        # kill + resume drill
+    python tools/chaos_run.py --plan serve         # serving kill drills
+    python tools/chaos_run.py --plan serve_load    # serving autoscale drill
 
 Prints one JSON summary line and exits non-zero on any failed check.
 """
@@ -647,7 +649,7 @@ def main():
     ap.add_argument("--plan", default="default",
                     choices=["default", "noise", "crash-only", "none",
                              "straggler", "nan", "hang", "preempt",
-                             "outage"]
+                             "outage", "serve", "serve_load"]
                     + sorted(SCHED_KILL_SITES))
     ap.add_argument("--resume-workers", type=int, default=len(HOSTS),
                     help="outage plan: phase-2 fleet size (2/4 = the "
@@ -710,6 +712,27 @@ def main():
             print(f"chaos_run: dtlint {what}; fix that (or pass "
                   f"--no-lint) before the drill", file=sys.stderr)
             return 1
+
+    if args.plan in ("serve", "serve_load"):
+        # r21 serving-plane drills (docs/serving.md): delegate to the
+        # serve_bench scenario engine — real replica subprocesses +
+        # open-loop load with per-answer oracle verification.  "serve"
+        # runs BOTH kill variants (one replica SIGKILLed; the primary
+        # scheduler SIGKILLed under a warm standby) gating zero lost
+        # requests and post-recovery p99 under the deadline;
+        # "serve_load" runs the autoscale load step twice at one seed
+        # gating the deterministic [scale_up, scale_down] decision log.
+        sys.path.insert(0, HERE)
+        import serve_bench
+        names = ["replica_kill", "sched_kill"] if args.plan == "serve" \
+            else ["load_step"]
+        rows = serve_bench.run_scenarios(names, args.seed, smoke=False)
+        ok = all(r["pass"] for r in rows)
+        print(json.dumps({"plan": args.plan, "seed": args.seed,
+                          "pass": ok,
+                          "gates": {r["scenario"]: r["gates"]
+                                    for r in rows}}))
+        return 0 if ok else 1
 
     ha_plan = args.plan in SCHED_KILL_SITES
     policy_plan = args.plan == "straggler"

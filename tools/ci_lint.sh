@@ -14,3 +14,6 @@ python tools/dtlint.py --no-cache --sarif "$SARIF"
 
 echo "== linter tier-1 tests =="
 python -m pytest tests/test_dtlint.py -q
+
+echo "== serve bench smoke (r21) =="
+python tools/serve_bench.py --smoke --seed 0

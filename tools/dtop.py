@@ -7,8 +7,11 @@ h2d), the straggler board, the r14 policy-decisions section (current
 batch shares, breach streaks, decision timeline — ``docs/policy.md``),
 the r15 health board (active SLO breaches with the blamed worker,
 breach/clear timeline, per-worker training-health gauges —
-``dt_tpu/obs/metrics.py``), per-worker retry/fault counts, and the
-membership/leadership timeline from either a merged chrome trace
+``dt_tpu/obs/metrics.py``), the r21 serving board (per-replica QPS /
+p99 / queue-depth gauges, served weights step, refresh counts, and the
+autoscale decision log — ``docs/serving.md``), per-worker retry/fault
+counts, and the membership/leadership timeline from either a merged
+chrome trace
 written by ``dt_tpu.obs.export`` (e.g. ``tools/chaos_run.py --trace
 out.json``) or a LIVE scheduler (the ``obs_dump`` control command — the
 job-level counterpart of the reference's remote profiler dump,
@@ -271,6 +274,49 @@ def render(summary) -> str:
                 lines.append(f"  recompile {track}: {e.get('what')} "
                              f"changed={e.get('changed')} "
                              f"cache={e.get('cache', '-')}")
+    # r21 serving board (dt_tpu/serve): per-replica QPS / latency /
+    # queue-depth gauges with the served weights step and refresh
+    # count, plus the autoscale decision log (docs/serving.md)
+    srv = summary.get("serving", {})
+    srv_events = summary.get("serve_events") or []
+    if srv.get("replicas") or srv.get("decisions") or srv_events:
+        lines.append("")
+        want = srv.get("want")
+        lines.append("serving board"
+                     + (f"  want={want}" if want is not None else "")
+                     + ":")
+        for host, r in sorted((srv.get("replicas") or {}).items()):
+            g = r.get("gauges") or {}
+            parts = [f"qps={g.get('serve.qps', 0.0):.1f}",
+                     f"p99={g.get('serve.p99_ms', 0.0):.1f}ms",
+                     f"queue={g.get('serve.queue_depth', 0.0):.0f}",
+                     f"weights=step {r.get('weights_step', 0)}",
+                     f"refreshes={r.get('refreshes', 0)}"]
+            if r.get("draining"):
+                parts.append("DRAINING")
+            lines.append(f"  {host:<20}" + "  ".join(parts))
+        for d in srv.get("decisions") or []:
+            row = (f"  scale decision {d.get('seq')}: {d.get('kind')} "
+                   f"{d.get('n_before')} -> {d.get('n_after')}")
+            if d.get("host"):
+                row += f"  drain={d['host']}"
+            lines.append(row)
+        for ev in srv_events:
+            # the refresh/scale timeline (serve.refresh / serve.scale
+            # trace events), chronological across tracks
+            ts = (ev.get("ts") or 0) / 1e6
+            if ev.get("what") == "serve.refresh":
+                lines.append(f"  [{ts:10.3f}s] {ev.get('track')}: "
+                             f"weights refreshed to step "
+                             f"{ev.get('step')}")
+            else:
+                row = (f"  [{ts:10.3f}s] {ev.get('track')}: scale "
+                       f"{ev.get('kind')}")
+                if ev.get("host"):
+                    row += f" host={ev['host']}"
+                if ev.get("replicas") is not None:
+                    row += f" replicas={ev['replicas']}"
+                lines.append(row)
     causal = summary.get("causal", {})
     if causal.get("client_spans"):
         lines.append("")
@@ -581,6 +627,13 @@ def render_status(resp: dict) -> str:
             f"policy: seq={pol.get('seq', 0)} lr_scale="
             f"{pol.get('lr_scale', 1.0)} shares=" + (" ".join(
                 f"{h}:{u}" for h, u in sorted(shares.items())) or "-"))
+    srv = resp.get("serving") or {}
+    if srv:
+        lines.append(f"serving: {len(srv.get('replicas') or [])} "
+                     f"replica(s) want={srv.get('want')} "
+                     f"decisions={srv.get('decisions', 0)}  ("
+                     + (", ".join(srv.get("replicas") or []) or "-")
+                     + ")")
     return "\n".join(lines)
 
 
